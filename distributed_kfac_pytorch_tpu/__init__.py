@@ -1,0 +1,13 @@
+"""TPU-native distributed K-FAC: a JAX/XLA/Pallas rebuild of the
+capabilities of MLHPC/Distributed_KFAC_Pytorch (kfac-pytorch 0.3.1).
+
+Current public surface: the ``ops`` (factor statistics, dense linalg) and
+``parallel`` (mesh placement) subpackages. The top-level ``KFAC`` /
+``CommMethod`` / ``KFACParamScheduler`` API (parity with reference
+kfac/__init__.py:1-5) lands as the preconditioner core is built out.
+"""
+
+__version__ = '0.1.0'
+
+from distributed_kfac_pytorch_tpu import ops
+from distributed_kfac_pytorch_tpu import parallel

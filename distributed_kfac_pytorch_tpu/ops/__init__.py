@@ -1,0 +1,26 @@
+"""Pure-jnp math ops: factor statistics and dense linear algebra."""
+
+from distributed_kfac_pytorch_tpu.ops import factors
+from distributed_kfac_pytorch_tpu.ops import linalg
+from distributed_kfac_pytorch_tpu.ops.factors import (
+    append_bias_ones,
+    collapse_batch_dims,
+    conv2d_a_factor,
+    conv2d_g_factor,
+    embedding_a_factor,
+    extract_conv2d_patches,
+    fill_triu,
+    get_cov,
+    get_triu,
+    linear_a_factor,
+    linear_g_factor,
+    update_running_avg,
+)
+from distributed_kfac_pytorch_tpu.ops.linalg import (
+    get_eigendecomp,
+    get_elementwise_inverse,
+    get_inverse,
+    precondition_diag_a,
+    precondition_eigen,
+    precondition_inv,
+)

@@ -1,0 +1,9 @@
+"""Mesh topology, collectives, and static work placement."""
+
+from distributed_kfac_pytorch_tpu.parallel.placement import (
+    WorkerAllocator,
+    get_block_boundary,
+    load_balance,
+    partition_grad_ranks,
+    partition_inv_ranks,
+)

@@ -1,0 +1,166 @@
+"""Static work placement for distributed K-FAC on a TPU mesh.
+
+This module is *host-side, trace-time* logic: assignments are computed once in
+Python and baked into the jitted SPMD program as static masks / gather indices.
+Nothing here touches devices.
+
+Semantics match the reference implementation's scheduling spec
+(reference: kfac/utils.py:59-212, validated by the golden tests in
+reference tests/load_balance.py, tests/worker_allocator.py,
+tests/block_divide.py), but the *mechanism* differs: where the reference
+builds NCCL/Horovod broadcast groups (kfac/utils.py:120-128), we describe
+rank subsets that the mesh layer turns into sub-axis collectives
+(psum/ppermute over a reshaped device axis).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+
+def load_balance(n_workers: int, work: Sequence[float]) -> list[int]:
+    """Greedy longest-processing-time assignment of work items to workers.
+
+    Items are considered in decreasing order of cost (ties keep original
+    order); each goes to the least-loaded worker (ties -> lowest worker id).
+
+    Reference parity: kfac/utils.py:169-196 (spec: tests/load_balance.py).
+
+    Args:
+      n_workers: number of workers to assign over.
+      work: per-item cost estimates (e.g. n^3 for an eigendecomposition).
+
+    Returns:
+      List of worker indices, one per work item (same order as ``work``).
+    """
+    if n_workers < 1:
+        raise ValueError(f'n_workers must be >= 1, got {n_workers}')
+    if len(work) == 0:
+        raise ValueError('work list must be non-empty')
+    order = sorted(range(len(work)), key=lambda i: (-work[i], i))
+    loads = [0.0] * n_workers
+    assignment = [0] * len(work)
+    for i in order:
+        worker = loads.index(min(loads))  # lowest id wins ties
+        assignment[i] = worker
+        loads[worker] += work[i]
+    return assignment
+
+
+def partition_grad_ranks(size: int, grad_workers: int) -> list[list[int]]:
+    """Strided partition of ``range(size)`` into gradient-broadcast groups.
+
+    Group ``i`` is ``[i, i + grad_workers, i + 2*grad_workers, ...]``: each
+    group contains exactly one of the ``grad_workers`` ranks that computed the
+    preconditioned gradient for a layer, plus the ranks it must be sent to.
+
+    Reference parity: kfac/utils.py:150-153 (spec: tests/worker_allocator.py).
+    """
+    return [list(range(i, size, grad_workers)) for i in range(grad_workers)]
+
+
+def partition_inv_ranks(size: int, grad_workers: int) -> list[list[int]]:
+    """Contiguous partition of ``range(size)`` into inverse-broadcast groups.
+
+    Each group is a contiguous run of ``grad_workers`` ranks: the set of ranks
+    that all need a layer's factor inverses so each can precondition
+    gradients for that layer.
+
+    Reference parity: kfac/utils.py:156-159 (spec: tests/worker_allocator.py).
+    """
+    return [list(range(i, min(i + grad_workers, size)))
+            for i in range(0, size, grad_workers)]
+
+
+def get_block_boundary(index: int, n_blocks: int,
+                       shape: Sequence[int]) -> tuple[list[int], list[int]]:
+    """Start/end coordinates of the ``index``-th diagonal block of a matrix.
+
+    Splits each dimension of ``shape`` into ``n_blocks`` equal floor-sized
+    blocks, with the final block absorbing the remainder.
+
+    Reference parity: kfac/utils.py:199-212 (spec: tests/block_divide.py).
+    """
+    if index >= n_blocks:
+        raise ValueError(f'block index {index} out of range for '
+                         f'{n_blocks} blocks')
+    if n_blocks > min(shape):
+        raise ValueError(f'cannot split shape {tuple(shape)} into '
+                         f'{n_blocks} blocks')
+    start = [index * (dim // n_blocks) for dim in shape]
+    end = [dim if index == n_blocks - 1 else (index + 1) * (dim // n_blocks)
+           for dim in shape]
+    return start, end
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerAllocator:
+    """KAISA grad-worker-fraction topology over a flat device axis.
+
+    Splits ``size`` ranks into:
+      - ``bcast_inv_ranks``: contiguous groups of ``grad_workers`` ranks.
+        All ranks in a group precondition gradients for the same layers and
+        therefore share factor inverses.
+      - ``bcast_grad_ranks``: strided groups of ``size // grad_workers``
+        ranks. One rank per group holds a layer's preconditioned gradient
+        and shares it with the rest.
+
+    Unlike the reference (kfac/utils.py:59-147), which materializes NCCL
+    broadcast groups, this object is a pure description; the mesh layer maps
+    groups onto sub-axes of a reshaped device axis, where the contiguous /
+    strided structures become the two axes of a
+    ``(inv_groups, grad_workers)`` view of the device array, and broadcasts
+    become sub-axis ``psum`` of masked contributions.
+
+    Attributes:
+      size: world size (number of devices on the K-FAC axis).
+      grad_workers: number of ranks that precondition each layer's gradient.
+    """
+
+    size: int
+    compute_grad_fraction: float
+
+    def __post_init__(self):
+        if not (0.0 <= self.compute_grad_fraction <= 1.0):
+            raise ValueError('compute_grad_fraction must be in [0, 1], got '
+                             f'{self.compute_grad_fraction}')
+        if self.size % self.grad_workers != 0:
+            raise ValueError(
+                'compute_grad_fraction must produce equally sized groups: '
+                f'world size {self.size} is not divisible by '
+                f'{self.grad_workers} grad workers')
+
+    @property
+    def grad_workers(self) -> int:
+        return max(1, round(self.size * self.compute_grad_fraction))
+
+    @property
+    def bcast_grad_ranks(self) -> list[list[int]]:
+        return partition_grad_ranks(self.size, self.grad_workers)
+
+    @property
+    def bcast_inv_ranks(self) -> list[list[int]]:
+        return partition_inv_ranks(self.size, self.grad_workers)
+
+    @property
+    def grad_groups(self) -> int:
+        return len(self.bcast_grad_ranks)
+
+    @property
+    def inv_groups(self) -> int:
+        return len(self.bcast_inv_ranks)
+
+    def get_grad_ranks(self, rank: int) -> list[int]:
+        """Gradient-broadcast group containing ``rank``."""
+        return self.bcast_grad_ranks[rank % self.grad_workers]
+
+    def get_inv_ranks(self, rank: int) -> list[int]:
+        """Inverse-broadcast group containing ``rank``."""
+        return self.bcast_inv_ranks[rank // self.grad_workers]
+
+    def grad_group_index(self, rank: int) -> int:
+        return rank % self.grad_workers
+
+    def inv_group_index(self, rank: int) -> int:
+        return rank // self.grad_workers

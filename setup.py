@@ -1,0 +1,11 @@
+from setuptools import find_packages, setup
+
+setup(
+    name='distributed-kfac-tpu',
+    version='0.1.0',
+    description=('TPU-native distributed K-FAC gradient preconditioner '
+                 '(JAX/XLA/Pallas)'),
+    packages=find_packages(exclude=('tests', 'examples', 'scripts')),
+    python_requires='>=3.10',
+    install_requires=['jax', 'flax', 'optax'],
+)

@@ -1,0 +1,30 @@
+"""Test harness: run everything on 8 virtual CPU devices.
+
+The reference could only test its distributed logic on real multi-GPU
+allocations (SURVEY.md §4); here the whole mesh path runs on a simulated
+8-device CPU topology, so `pytest -q tests/` validates single-device
+numerics AND multi-chip sharding with no TPU pod.
+"""
+
+import os
+
+# pytest plugins pre-import jax, so env-var config is too late; the backend
+# itself is not initialized until first use, so jax.config still works here.
+# Overrides any inherited platform choice: unit tests always run on the
+# virtual CPU mesh.
+os.environ['JAX_PLATFORMS'] = 'cpu'
+xla_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in xla_flags:
+    os.environ['XLA_FLAGS'] = (
+        xla_flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_num_cpu_devices', 8)
+jax.config.update('jax_enable_x64', False)
+
+assert jax.default_backend() == 'cpu', (
+    'tests must run on the virtual CPU mesh, got ' + jax.default_backend())
+assert jax.device_count() == 8, (
+    f'expected 8 virtual CPU devices, got {jax.device_count()}')

@@ -1,0 +1,225 @@
+"""Numerics tests for factor statistics and linear algebra ops.
+
+Goes beyond the reference (which had no numerics unit tests — SURVEY.md §4):
+covariance/eigh/inverse identities are checked against numpy oracles, and
+the conv im2col path is checked against a brute-force patch extraction.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_kfac_pytorch_tpu.ops import factors, linalg
+
+
+def rand(*shape, seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
+
+
+class TestCov:
+    def test_matches_definition(self):
+        a = rand(32, 5)
+        got = factors.get_cov(a)
+        want = np.asarray(a).T @ np.asarray(a) / 32
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        np.testing.assert_allclose(got, got.T, rtol=0, atol=0)  # exact sym
+
+    def test_two_tensor_form(self):
+        a, b = rand(16, 4, seed=1), rand(16, 4, seed=2)
+        got = factors.get_cov(a, b)
+        want = np.asarray(a).T @ np.asarray(b) / 16
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_scale_override(self):
+        a = rand(8, 3)
+        np.testing.assert_allclose(
+            factors.get_cov(a, scale=2.0),
+            np.asarray(a).T @ np.asarray(a) / 2.0, rtol=1e-5)
+
+    def test_rejects_non_2d(self):
+        with pytest.raises(ValueError):
+            factors.get_cov(rand(2, 3, 4))
+
+
+class TestRunningAvg:
+    def test_ewma(self):
+        new, cur = rand(4, 4, seed=3), rand(4, 4, seed=4)
+        got = factors.update_running_avg(new, cur, alpha=0.95)
+        np.testing.assert_allclose(
+            got, 0.95 * np.asarray(cur) + 0.05 * np.asarray(new), rtol=1e-6)
+
+
+class TestLinearFactors:
+    def test_a_with_bias(self):
+        a = rand(10, 6)
+        got = factors.linear_a_factor(a, has_bias=True)
+        aug = np.concatenate([np.asarray(a), np.ones((10, 1))], axis=1)
+        np.testing.assert_allclose(got, aug.T @ aug / 10, rtol=1e-5)
+        assert got.shape == (7, 7)
+
+    def test_a_collapses_time_dim(self):
+        a = rand(4, 5, 6)  # (batch, time, dim)
+        got = factors.linear_a_factor(a, has_bias=False)
+        flat = np.asarray(a).reshape(20, 6)
+        np.testing.assert_allclose(got, flat.T @ flat / 20, rtol=1e-5)
+
+    def test_g(self):
+        g = rand(10, 3)
+        np.testing.assert_allclose(
+            factors.linear_g_factor(g),
+            np.asarray(g).T @ np.asarray(g) / 10, rtol=1e-5)
+
+
+def _patches_bruteforce(x, kh, kw, sh, sw, pad):
+    """Reference im2col in numpy, feature order (kh, kw, c)."""
+    x = np.pad(np.asarray(x), ((0, 0), (pad[0], pad[0]), (pad[1], pad[1]),
+                               (0, 0)))
+    b, h, w, c = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    out = np.zeros((b, oh, ow, kh * kw * c), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, i * sh:i * sh + kh, j * sw:j * sw + kw, :]
+            out[:, i, j, :] = patch.reshape(b, -1)
+    return out
+
+
+class TestConvFactors:
+    @pytest.mark.parametrize('pad_mode,pad', [('VALID', (0, 0)),
+                                              ('SAME', (1, 1))])
+    def test_patches_match_bruteforce(self, pad_mode, pad):
+        x = rand(2, 5, 5, 3, seed=5)
+        got = factors.extract_conv2d_patches(x, (3, 3), (1, 1), pad_mode)
+        want = _patches_bruteforce(x, 3, 3, 1, 1, pad)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_patch_order_matches_flax_kernel_flatten(self):
+        # conv(x) == patches @ kernel.reshape(-1, cout): the basis contract
+        # that makes A consistent with the flattened gradient.
+        x = rand(2, 6, 6, 3, seed=6)
+        k = rand(3, 3, 3, 4, seed=7)  # HWIO
+        y = jax.lax.conv_general_dilated(
+            x, k, (1, 1), 'SAME', dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+        patches = factors.extract_conv2d_patches(x, (3, 3), (1, 1), 'SAME')
+        y2 = patches @ np.asarray(k).reshape(-1, 4)
+        np.testing.assert_allclose(y, y2, rtol=1e-4, atol=1e-5)
+
+    def test_a_factor_scaling(self):
+        x = rand(2, 4, 4, 3, seed=8)
+        got = factors.conv2d_a_factor(x, (3, 3), (1, 1), 'SAME',
+                                      has_bias=True)
+        p = _patches_bruteforce(x, 3, 3, 1, 1, (1, 1)).reshape(-1, 27)
+        p = np.concatenate([p, np.ones((p.shape[0], 1), np.float32)], 1)
+        s = 16  # 4*4 spatial
+        want = (p / s).T @ (p / s) / p.shape[0]
+        want = (want + want.T) / 2
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+    def test_g_factor_scaling(self):
+        g = rand(2, 4, 4, 5, seed=9)
+        got = factors.conv2d_g_factor(g)
+        g2 = np.asarray(g).reshape(-1, 5) / 16
+        want = g2.T @ g2 / g2.shape[0]
+        want = (want + want.T) / 2
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+class TestEmbeddingFactor:
+    def test_frequency_diagonal(self):
+        ids = jnp.array([[0, 1, 1], [3, 1, 0]])
+        got = factors.embedding_a_factor(ids, vocab_size=5)
+        np.testing.assert_allclose(got, [2 / 6, 3 / 6, 0, 1 / 6, 0],
+                                   rtol=1e-6)
+
+
+class TestTriu:
+    def test_roundtrip(self):
+        x = rand(6, 6, seed=10)
+        x = (x + x.T) / 2
+        flat = factors.get_triu(x)
+        assert flat.shape == (21,)
+        back = factors.fill_triu((6, 6), flat)
+        np.testing.assert_allclose(back, x, rtol=1e-6)
+
+    def test_rectangular_roundtrip(self):
+        # rows < cols is supported (reference fill_triu handles it);
+        # the lower triangle of the square block is mirrored.
+        x = np.zeros((2, 4), np.float32)
+        x[np.triu_indices(2, m=4)] = np.arange(1, 8)
+        x[1, 0] = x[0, 1]  # symmetric square block
+        flat = factors.get_triu(jnp.asarray(x))
+        back = factors.fill_triu((2, 4), flat)
+        np.testing.assert_allclose(back, x, rtol=1e-6)
+
+    def test_more_rows_than_cols_rejected(self):
+        with pytest.raises(ValueError):
+            factors.get_triu(jnp.zeros((4, 2)))
+        with pytest.raises(ValueError):
+            factors.fill_triu((4, 2), jnp.zeros(5))
+
+
+def spd(n, seed=0):
+    m = np.asarray(rand(n, n, seed=seed))
+    return jnp.asarray(m @ m.T + n * np.eye(n, dtype=np.float32))
+
+
+class TestLinalg:
+    def test_eigh_reconstructs(self):
+        x = spd(8, seed=11)
+        q, d = linalg.get_eigendecomp(x)
+        np.testing.assert_allclose(np.asarray(q) * d @ np.asarray(q).T, x,
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_eigh_clip(self):
+        x = jnp.diag(jnp.array([-1.0, 2.0]))
+        _, d = linalg.get_eigendecomp(x, clip=0.0)
+        assert float(d.min()) >= 0.0
+
+    def test_damped_cholesky_inverse(self):
+        x = spd(10, seed=12)
+        inv = linalg.get_inverse(x, damping=0.5)
+        want = np.linalg.inv(np.asarray(x) + 0.5 * np.eye(10))
+        np.testing.assert_allclose(inv, want, rtol=1e-3, atol=1e-4)
+
+    def test_elementwise_inverse_keeps_zeros(self):
+        v = jnp.array([2.0, 0.0, 4.0])
+        np.testing.assert_allclose(linalg.get_elementwise_inverse(v),
+                                   [0.5, 0.0, 0.25])
+
+    def test_precondition_eigen_equals_damped_natural_grad(self):
+        # With running-average factors A, G the eigen path must equal
+        # (G + sqrt(λ))^-1 grad (A + sqrt(λ))^-1 when λ is split evenly —
+        # here checked in the exact form used by the reference: eigenbasis
+        # division by (dG dA^T + λ).
+        a, g = spd(5, seed=13), spd(4, seed=14)
+        grad = rand(4, 5, seed=15)
+        qa, da = linalg.get_eigendecomp(a)
+        qg, dg = linalg.get_eigendecomp(g)
+        lam = 0.1
+        got = linalg.precondition_eigen(grad, qa, qg, da, dg, lam)
+        # Oracle: full Kronecker solve (G⊗A + λI)^-1 vec(grad)
+        kron = np.kron(np.asarray(g), np.asarray(a))
+        vec = np.asarray(grad).reshape(-1)  # row-major: (out, in)
+        want = np.linalg.solve(kron + lam * np.eye(20), vec).reshape(4, 5)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_precondition_inv(self):
+        a, g = spd(3, seed=16), spd(3, seed=17)
+        grad = rand(3, 3, seed=18)
+        a_inv = linalg.get_inverse(a, damping=0.2)
+        g_inv = linalg.get_inverse(g, damping=0.2)
+        got = linalg.precondition_inv(grad, a_inv, g_inv)
+        want = (np.linalg.inv(np.asarray(g) + 0.2 * np.eye(3))
+                @ np.asarray(grad)
+                @ np.linalg.inv(np.asarray(a) + 0.2 * np.eye(3)))
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_batched_via_vmap(self):
+        xs = jnp.stack([spd(6, seed=s) for s in range(4)])
+        qs, ds = jax.vmap(linalg.get_eigendecomp)(xs)
+        for i in range(4):
+            np.testing.assert_allclose(
+                np.asarray(qs[i]) * ds[i] @ np.asarray(qs[i]).T, xs[i],
+                rtol=1e-3, atol=1e-3)

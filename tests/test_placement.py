@@ -1,0 +1,126 @@
+"""Golden tests for static work placement.
+
+The expected values are the behavioral spec pinned by the reference's
+tests/load_balance.py, tests/worker_allocator.py and tests/block_divide.py —
+any framework claiming parity must reproduce them exactly.
+"""
+
+import pytest
+
+from distributed_kfac_pytorch_tpu.parallel import (
+    WorkerAllocator,
+    get_block_boundary,
+    load_balance,
+    partition_grad_ranks,
+    partition_inv_ranks,
+)
+
+
+class TestLoadBalance:
+    def test_empty_work_raises(self):
+        with pytest.raises(ValueError):
+            load_balance(1, [])
+
+    @pytest.mark.parametrize('n_workers,work,expected', [
+        (1, [1], [0]),
+        (1, [1, 2], [0, 0]),
+        (2, [1, 2], [1, 0]),
+        (2, [1, 1, 2], [1, 1, 0]),
+        (2, [1, 1, 1, 1], [0, 1, 0, 1]),
+        (3, [1, 1, 1, 1], [0, 1, 2, 0]),
+        (3, [5, 8, 5, 12, 5, 7, 6], [1, 1, 0, 0, 1, 2, 2]),
+    ])
+    def test_greedy_lpt(self, n_workers, work, expected):
+        assert load_balance(n_workers, work) == expected
+
+
+class TestPartitions:
+    @pytest.mark.parametrize('size,k,expected', [
+        (16, 8, [[0, 8], [1, 9], [2, 10], [3, 11], [4, 12], [5, 13],
+                 [6, 14], [7, 15]]),
+        (16, 2, [[0, 2, 4, 6, 8, 10, 12, 14], [1, 3, 5, 7, 9, 11, 13, 15]]),
+        (8, 8, [[0], [1], [2], [3], [4], [5], [6], [7]]),
+        (8, 5, [[0, 5], [1, 6], [2, 7], [3], [4]]),
+        (8, 4, [[0, 4], [1, 5], [2, 6], [3, 7]]),
+        (8, 3, [[0, 3, 6], [1, 4, 7], [2, 5]]),
+        (8, 2, [[0, 2, 4, 6], [1, 3, 5, 7]]),
+        (8, 1, [[0, 1, 2, 3, 4, 5, 6, 7]]),
+        (2, 1, [[0, 1]]),
+        (2, 2, [[0], [1]]),
+        (1, 1, [[0]]),
+    ])
+    def test_grad_ranks(self, size, k, expected):
+        assert partition_grad_ranks(size, k) == expected
+
+    @pytest.mark.parametrize('size,k,expected', [
+        (16, 8, [[0, 1, 2, 3, 4, 5, 6, 7], [8, 9, 10, 11, 12, 13, 14, 15]]),
+        (8, 8, [[0, 1, 2, 3, 4, 5, 6, 7]]),
+        (8, 5, [[0, 1, 2, 3, 4], [5, 6, 7]]),
+        (8, 4, [[0, 1, 2, 3], [4, 5, 6, 7]]),
+        (8, 3, [[0, 1, 2], [3, 4, 5], [6, 7]]),
+        (8, 2, [[0, 1], [2, 3], [4, 5], [6, 7]]),
+        (8, 1, [[0], [1], [2], [3], [4], [5], [6], [7]]),
+        (2, 1, [[0], [1]]),
+        (2, 2, [[0, 1]]),
+        (1, 1, [[0]]),
+    ])
+    def test_inv_ranks(self, size, k, expected):
+        assert partition_inv_ranks(size, k) == expected
+
+
+class TestBlockBoundary:
+    def test_whole(self):
+        assert get_block_boundary(0, 1, [100, 100]) == ([0, 0], [100, 100])
+
+    def test_halves(self):
+        assert get_block_boundary(0, 2, [100, 100]) == ([0, 0], [50, 50])
+        assert get_block_boundary(1, 2, [100, 100]) == ([50, 50], [100, 100])
+
+    def test_thirds_remainder_to_last(self):
+        assert get_block_boundary(0, 3, [100, 100]) == ([0, 0], [33, 33])
+        assert get_block_boundary(1, 3, [100, 100]) == ([33, 33], [66, 66])
+        assert get_block_boundary(2, 3, [100, 100]) == ([66, 66], [100, 100])
+
+    def test_unit(self):
+        assert get_block_boundary(0, 1, [1, 1]) == ([0, 0], [1, 1])
+
+    def test_fine(self):
+        assert get_block_boundary(42, 100, [100, 100]) == ([42, 42], [43, 43])
+        assert get_block_boundary(42, 100, [100, 1000]) == ([42, 420],
+                                                            [43, 430])
+
+    def test_errors(self):
+        with pytest.raises(ValueError):
+            get_block_boundary(100, 100, [100, 1000])
+        with pytest.raises(ValueError):
+            get_block_boundary(1, 100, [10, 10])
+
+
+class TestWorkerAllocator:
+    def test_topology_8_quarter(self):
+        alloc = WorkerAllocator(8, 0.25)
+        assert alloc.grad_workers == 2
+        assert alloc.bcast_inv_ranks == [[0, 1], [2, 3], [4, 5], [6, 7]]
+        assert alloc.bcast_grad_ranks == [[0, 2, 4, 6], [1, 3, 5, 7]]
+        assert alloc.inv_groups == 4
+        assert alloc.grad_groups == 2
+
+    def test_group_lookup(self):
+        alloc = WorkerAllocator(8, 0.5)
+        assert alloc.get_inv_ranks(5) == [4, 5, 6, 7]
+        assert alloc.get_grad_ranks(5) == [1, 5]
+        assert alloc.inv_group_index(5) == 1
+        assert alloc.grad_group_index(5) == 1
+
+    def test_uneven_fraction_rejected(self):
+        with pytest.raises(ValueError):
+            WorkerAllocator(8, 0.33)  # groups of 3,3,2: invalid
+
+    def test_comm_opt_and_mem_opt_extremes(self):
+        comm_opt = WorkerAllocator(8, 1.0)
+        assert comm_opt.grad_workers == 8
+        assert comm_opt.inv_groups == 1
+        mem_opt = WorkerAllocator(8, 1 / 8)
+        assert mem_opt.grad_workers == 1
+        assert mem_opt.grad_groups == 1
+        assert mem_opt.inv_groups == 8
