@@ -1,13 +1,18 @@
 """TPU-native distributed K-FAC: a JAX/XLA/Pallas rebuild of the
 capabilities of MLHPC/Distributed_KFAC_Pytorch (kfac-pytorch 0.3.1).
 
-Current public surface: the ``ops`` (factor statistics, dense linalg) and
-``parallel`` (mesh placement) subpackages. The top-level ``KFAC`` /
-``CommMethod`` / ``KFACParamScheduler`` API (parity with reference
-kfac/__init__.py:1-5) lands as the preconditioner core is built out.
+Public API (parity with reference kfac/__init__.py:1-5):
+  - ``KFAC``: the K-FAC gradient preconditioner (functional state pytree).
+  - ``CommMethod``: COMM_OPT / MEM_OPT / HYBRID_OPT strategies.
+  - ``KFACParamScheduler``: epoch-schedule decay of damping / update freqs.
+  - ``KFACCapture``: hook-free activation/output-grad capture for flax.
+plus the ``ops``, ``parallel`` and ``layers`` subpackages.
 """
 
 __version__ = '0.1.0'
 
 from distributed_kfac_pytorch_tpu import ops
 from distributed_kfac_pytorch_tpu import parallel
+from distributed_kfac_pytorch_tpu.capture import KFACCapture
+from distributed_kfac_pytorch_tpu.preconditioner import CommMethod, KFAC
+from distributed_kfac_pytorch_tpu.scheduler import KFACParamScheduler

@@ -1,0 +1,292 @@
+"""Activation / output-gradient capture for K-FAC, without hooks.
+
+The reference relies on torch forward/backward hooks to snapshot each
+module's inputs and output-gradients (kfac/preconditioner.py:701-727,
+kfac/layers/base.py:364-379) because autograd hides intermediates. In JAX
+nothing is hidden: this module captures both quantities *functionally* from
+any flax model, unmodified:
+
+  - activations ``a``: a method interceptor (``nn.intercept_methods``) wraps
+    every registered module call and ``sow``s its input into the
+    ``kfac_in`` collection;
+  - output gradients ``g``: the interceptor adds a zero-valued probe to the
+    module output (``Module.perturb``); differentiating the loss wrt the
+    ``kfac_probes`` collection yields exactly dL/dy per module call.
+
+Both arrive as pure outputs of one ``value_and_grad`` — no mutation, no
+graph introspection, jit/vmap/shard_map-safe. Modules called multiple times
+per step (e.g. LSTM cells unrolled over time) get one capture and one probe
+per call, the analogue of the reference's ``accumulate_data`` path
+(kfac/layers/base.py:364-379).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+CAPTURE_COL = 'kfac_in'
+PROBE_COL = 'kfac_probes'
+
+# Module kinds, mirroring the reference's KNOWN_MODULES
+# (kfac/layers/__init__.py:11) plus the embedding layer the reference
+# disabled (kfac/layers/embedding.py:20).
+LINEAR = 'linear'
+CONV2D = 'conv2d'
+EMBEDDING = 'embedding'
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerSpec:
+    """Static description of one registered layer (hashable, trace-safe).
+
+    The functional analogue of a registered ``KFACLayer``'s identity/config
+    (reference kfac/layers/base.py:10-45): everything the factor math needs
+    to interpret this layer's captures and map its gradient to/from the
+    2-D ``(out_dim, in_dim[+1])`` matrix form.
+    """
+    path: tuple[str, ...]          # module path == params subtree path
+    kind: str                      # LINEAR | CONV2D | EMBEDDING
+    has_bias: bool
+    num_calls: int = 1             # calls per training step (e.g. timesteps)
+    # conv2d only:
+    kernel_size: tuple[int, ...] | None = None
+    strides: tuple[int, ...] | None = None
+    padding: Any = None
+    # embedding only:
+    vocab_size: int | None = None
+
+    @property
+    def name(self) -> str:
+        return '/'.join(self.path) if self.path else '<root>'
+
+
+def _canonical_padding(padding, n_spatial: int):
+    if isinstance(padding, str):
+        return padding
+    if isinstance(padding, int):
+        return [(padding, padding)] * n_spatial
+    out = []
+    for p in padding:
+        out.append((p, p) if isinstance(p, int) else tuple(p))
+    return out
+
+
+def _spec_for_module(mod: nn.Module, path: tuple[str, ...],
+                     num_calls: int) -> LayerSpec | None:
+    """Build a LayerSpec for a supported flax module, else None.
+
+    Mirrors the registry dispatch in reference kfac/layers/__init__.py:13-36
+    (module type -> KFACLayer class), with unsupported configurations
+    (grouped/dilated convs) skipped rather than mis-modelled.
+    """
+    if isinstance(mod, nn.Dense):
+        return LayerSpec(path=path, kind=LINEAR, has_bias=mod.use_bias,
+                         num_calls=num_calls)
+    if type(mod) is nn.Conv:
+        if mod.feature_group_count != 1:
+            return None
+        dilation = mod.kernel_dilation
+        if dilation is not None and any(
+                d != 1 for d in (dilation if isinstance(dilation, Sequence)
+                                 else (dilation,))):
+            return None
+        kernel_size = tuple(mod.kernel_size)
+        if len(kernel_size) != 2:
+            return None
+        strides = mod.strides
+        if strides is None:
+            strides = (1, 1)
+        elif isinstance(strides, int):
+            strides = (strides, strides)
+        else:
+            strides = tuple(strides)
+        return LayerSpec(path=path, kind=CONV2D, has_bias=mod.use_bias,
+                         num_calls=num_calls, kernel_size=kernel_size,
+                         strides=strides,
+                         padding=_canonical_padding(mod.padding, 2))
+    if isinstance(mod, nn.Embed):
+        return LayerSpec(path=path, kind=EMBEDDING, has_bias=False,
+                         num_calls=num_calls, vocab_size=mod.num_embeddings)
+    return None
+
+
+class KFACCapture:
+    """Registers supported modules of a flax model and captures (a, g).
+
+    The functional counterpart of ``KFAC.register_model``
+    (reference kfac/preconditioner.py:355-402): walks the model by
+    *intercepting* calls rather than attaching hooks, prunes subtrees whose
+    path component or class name matches ``skip_layers`` (case-insensitive,
+    like reference preconditioner.py:191-200), and exposes
+
+      ``loss_and_grads(loss_fn, params, *args)``
+        -> (loss, aux, param_grads, captures)
+
+    where ``captures`` maps layer name -> {'a': tuple, 'g': tuple} with one
+    entry per module call.
+    """
+
+    def __init__(self, model: nn.Module,
+                 skip_layers: str | Sequence[str] | None = None):
+        self.model = model
+        if skip_layers is None:
+            skip_layers = []
+        elif isinstance(skip_layers, str):
+            skip_layers = [skip_layers]
+        self.skip_layers = frozenset(s.lower() for s in skip_layers)
+        self._specs: dict[str, LayerSpec] | None = None
+
+    # -- registration ------------------------------------------------------
+
+    def _module_path(self, mod: nn.Module) -> tuple[str, ...]:
+        return tuple(mod.path)
+
+    def _skipped(self, mod: nn.Module, path: tuple[str, ...]) -> bool:
+        if type(mod).__name__.lower() in self.skip_layers:
+            return True
+        return any(part.lower() in self.skip_layers for part in path)
+
+    def _make_interceptor(self, record_specs: bool):
+        call_counts: dict[tuple[str, ...], int] = {}
+
+        def interceptor(next_fun, args, kwargs, context):
+            mod = context.module
+            if context.method_name != '__call__' or mod is None:
+                return next_fun(*args, **kwargs)
+            path = self._module_path(mod)
+            if self._skipped(mod, path):
+                return next_fun(*args, **kwargs)
+            if _spec_for_module(mod, path, 1) is None:
+                return next_fun(*args, **kwargs)
+            # Dense/Conv/Embed all name their input 'inputs'; support both
+            # positional and keyword call styles.
+            if args:
+                a_in = args[0]
+            elif 'inputs' in kwargs:
+                a_in = kwargs['inputs']
+            else:
+                return next_fun(*args, **kwargs)
+
+            idx = call_counts.get(path, 0)
+            call_counts[path] = idx + 1
+            mod.sow(CAPTURE_COL, 'a', a_in,
+                    init_fn=tuple, reduce_fn=lambda p, x: p + (x,))
+            y = next_fun(*args, **kwargs)
+            y = mod.perturb(f'probe{idx}', y, collection=PROBE_COL)
+            if record_specs:
+                spec = _spec_for_module(mod, path, call_counts[path])
+                self._specs['/'.join(path)] = spec
+            return y
+
+        return interceptor
+
+    def init(self, rng, *args, **kwargs) -> tuple[dict, dict]:
+        """Init model variables under interception; records layer specs.
+
+        Returns ``(variables, specs)`` (plain dicts). ``variables`` contains 'params' and
+        'kfac_probes' (zeros, shaped for the init batch).
+        """
+        self._specs = {}
+        with nn.intercept_methods(self._make_interceptor(record_specs=True)):
+            variables = self.model.init(rng, *args, **kwargs)
+        variables = dict(variables)
+        variables.pop(CAPTURE_COL, None)
+        return variables, dict(self._specs)
+
+    @property
+    def specs(self) -> dict[str, LayerSpec]:
+        if self._specs is None:
+            raise ValueError('no layers registered: call init() first')
+        return dict(self._specs)
+
+    # -- capture-time application -----------------------------------------
+
+    def zero_probes(self, params, *args, extra_vars=None, mutable_cols=(),
+                    **kwargs):
+        """Zero probe pytree shaped for the given batch (via eval_shape)."""
+        extra_vars = extra_vars or {}
+
+        def shapes(params, extra_vars, *a, **kw):
+            with nn.intercept_methods(
+                    self._make_interceptor(record_specs=False)):
+                _, state = self.model.apply(
+                    {'params': params, **extra_vars}, *a,
+                    mutable=[CAPTURE_COL, PROBE_COL, *mutable_cols], **kw)
+            return state.get(PROBE_COL, {})
+        tree = jax.eval_shape(shapes, params, extra_vars, *args, **kwargs)
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), tree)
+
+    def apply(self, params, probes, *args, extra_vars=None,
+              mutable_cols=(), **kwargs):
+        """Forward pass with capture.
+
+        ``extra_vars`` supplies additional variable collections (e.g.
+        ``{'batch_stats': ...}``); ``mutable_cols`` names the ones the
+        model updates in-pass. Returns
+        ``(out, activations_tree, updated_vars)``.
+        """
+        extra_vars = extra_vars or {}
+        with nn.intercept_methods(self._make_interceptor(record_specs=False)):
+            out, state = self.model.apply(
+                {'params': params, PROBE_COL: probes, **extra_vars}, *args,
+                mutable=[CAPTURE_COL, *mutable_cols], **kwargs)
+        updated = {c: state[c] for c in mutable_cols if c in state}
+        return out, state.get(CAPTURE_COL, {}), updated
+
+    def loss_and_grads(self, loss_fn: Callable, params, *args,
+                       probes=None, extra_vars=None, mutable_cols=(),
+                       has_aux=False, **kwargs):
+        """One backward pass yielding param grads AND per-layer captures.
+
+        ``loss_fn`` receives the model output only — close over labels and
+        any other data: ``lambda out: cross_entropy(out, labels)``. With
+        ``has_aux=True`` it returns ``(loss, aux)``.
+
+        ``extra_vars`` are non-differentiated collections passed to apply
+        (e.g. ``{'batch_stats': ...}``); collections listed in
+        ``mutable_cols`` are updated during the pass and returned.
+
+        Returns ``(loss, aux, grads, captures, updated_vars)`` where
+        ``captures`` maps layer name -> {'a': (per-call activations...),
+        'g': (per-call output grads...)} and ``updated_vars`` holds the
+        new values of ``mutable_cols`` ({} if none).
+        """
+        if probes is None:
+            probes = self.zero_probes(params, *args, extra_vars=extra_vars,
+                                      mutable_cols=mutable_cols, **kwargs)
+
+        def wrapped(params, probes):
+            out, acts, updated = self.apply(
+                params, probes, *args, extra_vars=extra_vars,
+                mutable_cols=mutable_cols, **kwargs)
+            res = loss_fn(out)
+            loss, aux = res if has_aux else (res, None)
+            return loss, (aux, acts, updated)
+
+        (loss, (aux, acts, updated)), (grads, probe_grads) = (
+            jax.value_and_grad(wrapped, argnums=(0, 1), has_aux=True)(
+                params, probes))
+        captures = self.collect(acts, probe_grads)
+        return loss, aux, grads, captures, updated
+
+    def collect(self, acts_tree, probe_grads_tree) -> dict[str, dict]:
+        """Pair sown activations with probe gradients, per layer name."""
+        captures = {}
+        for name, spec in self.specs.items():
+            a_node = _get_path(acts_tree, spec.path)['a']
+            g_node = _get_path(probe_grads_tree, spec.path)
+            gs = tuple(g_node[f'probe{i}'] for i in range(spec.num_calls))
+            captures[name] = {'a': tuple(a_node), 'g': gs}
+        return captures
+
+
+def _get_path(tree, path: tuple[str, ...]):
+    node = tree
+    for part in path:
+        node = node[part]
+    return node

@@ -1,0 +1,10 @@
+"""Per-layer-kind K-FAC math: captures -> factors, grads <-> matrices."""
+
+from distributed_kfac_pytorch_tpu.layers.base import (
+    KNOWN_KINDS,
+    compute_a_factor,
+    compute_g_factor,
+    factor_shapes,
+    grads_to_matrix,
+    matrix_to_grads,
+)

@@ -1,0 +1,142 @@
+"""Layer-kind dispatch: the functional KFACLayer contract.
+
+The reference expresses per-module math as KFACLayer subclasses holding
+mutable state (kfac/layers/{base,linear,conv,embedding}.py); here each kind
+is a set of pure functions over a ``LayerSpec`` and that layer's captures:
+
+  - ``compute_a_factor(spec, a_calls)`` / ``compute_g_factor(spec, g_calls)``
+    (reference contract: kfac/layers/base.py:443-449);
+  - ``grads_to_matrix`` / ``matrix_to_grads`` mapping a flax param subtree
+    to the 2-D ``(out_dim, in_dim[+1])`` form the preconditioner works in
+    (reference: kfac/layers/base.py:310-319, conv override conv.py:17-22).
+
+Multi-call layers (LSTM cells etc.) sum per-call factors like the
+reference's LinearMultiLayer (kfac/layers/linear.py:27-59).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from distributed_kfac_pytorch_tpu.capture import (
+    CONV2D,
+    EMBEDDING,
+    LINEAR,
+    LayerSpec,
+)
+from distributed_kfac_pytorch_tpu.ops import factors as F
+
+KNOWN_KINDS = (LINEAR, CONV2D, EMBEDDING)
+
+
+def compute_a_factor(spec: LayerSpec,
+                     a_calls: Sequence[jax.Array]) -> jax.Array:
+    """Input-covariance factor A from per-call activations."""
+    if spec.kind == LINEAR:
+        out = None
+        for a in a_calls:
+            cur = F.linear_a_factor(a, spec.has_bias)
+            out = cur if out is None else out + cur
+        return out
+    if spec.kind == CONV2D:
+        out = None
+        for a in a_calls:
+            cur = F.conv2d_a_factor(a, spec.kernel_size, spec.strides,
+                                    spec.padding, spec.has_bias)
+            out = cur if out is None else out + cur
+        return out
+    if spec.kind == EMBEDDING:
+        out = None
+        for ids in a_calls:
+            cur = F.embedding_a_factor(ids, spec.vocab_size)
+            out = cur if out is None else out + cur
+        return out
+    raise ValueError(f'unknown layer kind {spec.kind!r}')
+
+
+def compute_g_factor(spec: LayerSpec,
+                     g_calls: Sequence[jax.Array]) -> jax.Array:
+    """Output-gradient covariance factor G from per-call probe grads."""
+    if spec.kind in (LINEAR, EMBEDDING):
+        out = None
+        for g in g_calls:
+            cur = F.linear_g_factor(g)
+            out = cur if out is None else out + cur
+        return out
+    if spec.kind == CONV2D:
+        out = None
+        for g in g_calls:
+            cur = F.conv2d_g_factor(g)
+            out = cur if out is None else out + cur
+        return out
+    raise ValueError(f'unknown layer kind {spec.kind!r}')
+
+
+def grads_to_matrix(spec: LayerSpec, grads: dict) -> jax.Array:
+    """Flax param-grad subtree -> 2-D (out_dim, in_dim[+1]) matrix.
+
+    Layouts: flax Dense kernels are (in, out) [torch is (out, in)], conv
+    kernels (kh, kw, cin, cout) [torch (cout, cin, kh, kw)], embeddings
+    (vocab, dim). The matrix form matches the factor bases produced by
+    compute_a_factor/compute_g_factor.
+    """
+    if spec.kind == LINEAR:
+        mat = grads['kernel'].T
+        if spec.has_bias:
+            mat = jnp.concatenate([mat, grads['bias'][:, None]], axis=1)
+        return mat
+    if spec.kind == CONV2D:
+        k = grads['kernel']
+        mat = k.reshape(-1, k.shape[-1]).T  # (cout, kh*kw*cin)
+        if spec.has_bias:
+            mat = jnp.concatenate([mat, grads['bias'][:, None]], axis=1)
+        return mat
+    if spec.kind == EMBEDDING:
+        # (vocab, dim): A is diagonal over vocab, G is (dim, dim).
+        return grads['embedding']
+    raise ValueError(f'unknown layer kind {spec.kind!r}')
+
+
+def matrix_to_grads(spec: LayerSpec, mat: jax.Array,
+                    like: dict) -> dict:
+    """Inverse of grads_to_matrix, shaped like the param subtree ``like``."""
+    out = dict(like)
+    if spec.kind == LINEAR:
+        if spec.has_bias:
+            out['bias'] = mat[:, -1].reshape(like['bias'].shape)
+            mat = mat[:, :-1]
+        out['kernel'] = mat.T.reshape(like['kernel'].shape)
+        return out
+    if spec.kind == CONV2D:
+        if spec.has_bias:
+            out['bias'] = mat[:, -1].reshape(like['bias'].shape)
+            mat = mat[:, :-1]
+        out['kernel'] = mat.T.reshape(like['kernel'].shape)
+        return out
+    if spec.kind == EMBEDDING:
+        out['embedding'] = mat.reshape(like['embedding'].shape)
+        return out
+    raise ValueError(f'unknown layer kind {spec.kind!r}')
+
+
+def factor_shapes(spec: LayerSpec, params: dict) -> tuple[int, int]:
+    """(A_dim, G_dim) for this layer, from its param subtree shapes.
+
+    Used by worker assignment before any data has flowed — unlike the
+    reference, which must defer assignment until first factors exist
+    (preconditioner.py:499-504), factor dims are static functions of the
+    param shapes.
+    """
+    if spec.kind == LINEAR:
+        in_dim, out_dim = params['kernel'].shape
+        return in_dim + int(spec.has_bias), out_dim
+    if spec.kind == CONV2D:
+        kh, kw, cin, cout = params['kernel'].shape
+        return kh * kw * cin + int(spec.has_bias), cout
+    if spec.kind == EMBEDDING:
+        vocab, dim = params['embedding'].shape
+        return vocab, dim  # A is diagonal (vector of length vocab)
+    raise ValueError(f'unknown layer kind {spec.kind!r}')

@@ -1,0 +1,55 @@
+"""Tests for KFACParamScheduler (spec: reference kfac/scheduler.py)."""
+
+import flax.linen as nn
+
+from distributed_kfac_pytorch_tpu import KFAC, KFACParamScheduler
+
+
+class Tiny(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(2)(x)
+
+
+def make():
+    return KFAC(Tiny(), damping=0.003, factor_update_freq=10,
+                inv_update_freq=100)
+
+
+def test_damping_decay_schedule():
+    sched = KFACParamScheduler(make(), damping_alpha=0.5,
+                               damping_schedule=[2, 4])
+    assert sched.damping == 0.003
+    sched.step()           # epoch 1
+    assert sched.damping == 0.003
+    sched.step()           # epoch 2
+    assert abs(sched.damping - 0.0015) < 1e-12
+    sched.step(4)          # jump to epoch 4: both thresholds passed
+    assert abs(sched.damping - 0.00075) < 1e-12
+
+
+def test_update_freq_scaling_floors_at_one():
+    sched = KFACParamScheduler(make(), update_freq_alpha=0.05,
+                               update_freq_schedule=[1])
+    sched.step()
+    assert sched.factor_update_freq == max(1, int(10 * 0.05))
+    assert sched.inv_update_freq == int(100 * 0.05)
+    assert sched.factor_update_freq >= 1
+
+
+def test_params_feed_kfac_step_kwargs():
+    sched = KFACParamScheduler(make())
+    p = sched.params()
+    assert set(p) == {'damping', 'factor_update_freq', 'inv_update_freq'}
+
+
+def test_state_dict_roundtrip():
+    sched = KFACParamScheduler(make(), damping_alpha=0.5,
+                               damping_schedule=[2])
+    sched.step()
+    sched.step()
+    sd = sched.state_dict()
+    fresh = KFACParamScheduler(make())
+    fresh.load_state_dict(sd)
+    assert fresh.damping == sched.damping
+    assert fresh.factor_update_freq == sched.factor_update_freq
