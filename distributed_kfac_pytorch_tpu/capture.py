@@ -125,7 +125,7 @@ class KFACCapture:
     like reference preconditioner.py:191-200), and exposes
 
       ``loss_and_grads(loss_fn, params, *args)``
-        -> (loss, aux, param_grads, captures)
+        -> (loss, aux, param_grads, captures, updated_vars)
 
     where ``captures`` maps layer name -> {'a': tuple, 'g': tuple} with one
     entry per module call.
@@ -208,17 +208,24 @@ class KFACCapture:
 
     def zero_probes(self, params, *args, extra_vars=None, mutable_cols=(),
                     **kwargs):
-        """Zero probe pytree shaped for the given batch (via eval_shape)."""
+        """Zero probe pytree shaped for the given batch (via eval_shape).
+
+        Everything is closed over rather than passed through ``eval_shape``
+        so non-array arguments (e.g. ``train=True`` flags) stay Python
+        values instead of becoming tracers; ``eval_shape`` never executes
+        compute either way.
+        """
         extra_vars = extra_vars or {}
 
-        def shapes(params, extra_vars, *a, **kw):
+        def shapes():
             with nn.intercept_methods(
                     self._make_interceptor(record_specs=False)):
                 _, state = self.model.apply(
-                    {'params': params, **extra_vars}, *a,
-                    mutable=[CAPTURE_COL, PROBE_COL, *mutable_cols], **kw)
+                    {'params': params, **extra_vars}, *args,
+                    mutable=[CAPTURE_COL, PROBE_COL, *mutable_cols],
+                    **kwargs)
             return state.get(PROBE_COL, {})
-        tree = jax.eval_shape(shapes, params, extra_vars, *args, **kwargs)
+        tree = jax.eval_shape(shapes)
         return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), tree)
 
     def apply(self, params, probes, *args, extra_vars=None,
@@ -275,13 +282,24 @@ class KFACCapture:
         return loss, aux, grads, captures, updated
 
     def collect(self, acts_tree, probe_grads_tree) -> dict[str, dict]:
-        """Pair sown activations with probe gradients, per layer name."""
+        """Pair sown activations with probe gradients, per layer name.
+
+        Call counts are derived from the trees themselves, not the
+        init-time ``spec.num_calls`` — a weight-shared module may be called
+        a different number of times at step time (e.g. a cell unrolled to a
+        different sequence length) and a/g must stay paired per call.
+        """
         captures = {}
         for name, spec in self.specs.items():
-            a_node = _get_path(acts_tree, spec.path)['a']
+            a_node = tuple(_get_path(acts_tree, spec.path)['a'])
             g_node = _get_path(probe_grads_tree, spec.path)
-            gs = tuple(g_node[f'probe{i}'] for i in range(spec.num_calls))
-            captures[name] = {'a': tuple(a_node), 'g': gs}
+            gs = tuple(g_node[f'probe{i}'] for i in range(len(g_node)))
+            if len(a_node) != len(gs):
+                raise ValueError(
+                    f'layer {name}: {len(a_node)} captured activations vs '
+                    f'{len(gs)} probe gradients — activation and probe '
+                    'call counts must match')
+            captures[name] = {'a': a_node, 'g': gs}
         return captures
 
 

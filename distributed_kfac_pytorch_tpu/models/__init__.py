@@ -1,0 +1,10 @@
+"""Flax model zoo: the workloads the reference framework trains.
+
+- ``cifar_resnet``: ResNet-20..1202 for CIFAR-10 (reference
+  examples/cnn_utils/cifar_resnet.py).
+- ``imagenet_resnet``: ResNet-18..152 for ImageNet-1k (reference uses
+  torchvision models in examples/torch_imagenet_resnet.py).
+"""
+
+from distributed_kfac_pytorch_tpu.models import cifar_resnet
+from distributed_kfac_pytorch_tpu.models import imagenet_resnet

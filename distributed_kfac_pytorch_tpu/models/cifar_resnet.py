@@ -1,0 +1,110 @@
+"""CIFAR-10 ResNet family (He et al. arXiv:1512.03385), flax NHWC.
+
+TPU-native counterpart of the reference's CIFAR model zoo
+(examples/cnn_utils/cifar_resnet.py: ResNet-20/32/44/56/110/1202 with
+option-A parameter-free shortcuts). Parameter counts match the paper
+(ResNet-20 0.27M ... ResNet-1202 19.4M). Convs are `nn.Conv` and the head
+is `nn.Dense`, so every FLOP-carrying layer is K-FAC-registrable by
+`KFACCapture`; BatchNorm runs through the `batch_stats` collection.
+
+Layout is NHWC (TPU-native; torch reference is NCHW) and option-A
+downsampling is a strided slice + channel zero-pad, identical math to the
+reference's `LambdaLayer` shortcut (cifar_resnet.py:85-86).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    """3x3 conv -> BN -> relu -> 3x3 conv -> BN + shortcut -> relu.
+
+    Reference parity: cifar_resnet.py:69-98 (option-A shortcut: strided
+    subsample + zero-pad channels, no parameters).
+    """
+
+    planes: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        in_planes = x.shape[-1]
+        y = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
+                    padding=1, use_bias=False, dtype=self.dtype,
+                    kernel_init=nn.initializers.kaiming_normal(),
+                    name='conv1')(x)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, name='bn1')(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False,
+                    dtype=self.dtype,
+                    kernel_init=nn.initializers.kaiming_normal(),
+                    name='conv2')(y)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, name='bn2')(y)
+        if self.stride != 1 or in_planes != self.planes:
+            # Option A: subsample spatially, zero-pad channels (NHWC).
+            sc = x[:, ::2, ::2, :]
+            pad = self.planes // 4
+            sc = jnp.pad(sc, ((0, 0), (0, 0), (0, 0), (pad, pad)))
+        else:
+            sc = x
+        return nn.relu(y + sc)
+
+
+class CifarResNet(nn.Module):
+    """Stacked BasicBlocks over 16/32/64 planes + global-pool Dense head.
+
+    Reference parity: cifar_resnet.py:101-132.
+    """
+
+    num_blocks: Sequence[int]
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        y = nn.Conv(16, (3, 3), padding=1, use_bias=False, dtype=self.dtype,
+                    kernel_init=nn.initializers.kaiming_normal(),
+                    name='conv1')(x)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, name='bn1')(y)
+        y = nn.relu(y)
+        for stage, (planes, stride) in enumerate(
+                zip((16, 32, 64), (1, 2, 2)), start=1):
+            for i in range(self.num_blocks[stage - 1]):
+                y = BasicBlock(planes, stride if i == 0 else 1,
+                               dtype=self.dtype,
+                               name=f'layer{stage}_block{i}')(y, train=train)
+        y = jnp.mean(y, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        kernel_init=nn.initializers.kaiming_normal(),
+                        name='linear')(y)
+
+
+_DEPTHS = {20: (3, 3, 3), 32: (5, 5, 5), 44: (7, 7, 7), 56: (9, 9, 9),
+           110: (18, 18, 18), 1202: (200, 200, 200)}
+
+
+def resnet(depth: int, num_classes: int = 10,
+           dtype: jnp.dtype = jnp.float32) -> CifarResNet:
+    """CIFAR ResNet by depth (20/32/44/56/110/1202)."""
+    if depth not in _DEPTHS:
+        raise ValueError(f'unsupported CIFAR ResNet depth {depth}; '
+                         f'choose from {sorted(_DEPTHS)}')
+    return CifarResNet(num_blocks=_DEPTHS[depth], num_classes=num_classes,
+                       dtype=dtype)
+
+
+def get_model(name: str, num_classes: int = 10,
+              dtype: jnp.dtype = jnp.float32) -> CifarResNet:
+    """Model by name, e.g. 'resnet32' (reference cifar_resnet.py:40-51)."""
+    name = name.lower()
+    if not name.startswith('resnet'):
+        raise ValueError(f'unknown CIFAR model {name!r}')
+    return resnet(int(name[len('resnet'):]), num_classes, dtype)

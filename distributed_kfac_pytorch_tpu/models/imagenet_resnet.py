@@ -1,0 +1,137 @@
+"""ImageNet ResNet-18/34/50/101/152 (He et al.), flax NHWC.
+
+The reference trains torchvision's `resnet50`/`resnet152` (imported in
+examples/torch_imagenet_resnet.py — models come from torchvision, not the
+repo); this is the TPU-native equivalent with the same architecture:
+7x7/2 stem, max-pool, [Basic|Bottleneck] stages, global average pool,
+Dense head. Option-B (projection) shortcuts, as torchvision uses.
+
+All convs are `nn.Conv` and the head `nn.Dense`, so K-FAC registers every
+weight layer; bf16 activations are supported via `dtype` while BatchNorm
+statistics stay fp32 (flax default param dtype).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_KAIMING = nn.initializers.kaiming_normal()
+
+
+def _bn(train: bool, dtype, name: str):
+    return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                        epsilon=1e-5, dtype=dtype, name=name)
+
+
+class BasicBlockV1(nn.Module):
+    """Two 3x3 convs (ResNet-18/34)."""
+
+    planes: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        sc = x
+        y = nn.Conv(self.planes, (3, 3), (self.stride, self.stride),
+                    padding=1, use_bias=False, dtype=self.dtype,
+                    kernel_init=_KAIMING, name='conv1')(x)
+        y = _bn(train, self.dtype, 'bn1')(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False,
+                    dtype=self.dtype, kernel_init=_KAIMING, name='conv2')(y)
+        y = _bn(train, self.dtype, 'bn2')(y)
+        if self.stride != 1 or x.shape[-1] != self.planes:
+            sc = nn.Conv(self.planes, (1, 1), (self.stride, self.stride),
+                         use_bias=False, dtype=self.dtype,
+                         kernel_init=_KAIMING, name='downsample_conv')(x)
+            sc = _bn(train, self.dtype, 'downsample_bn')(sc)
+        return nn.relu(y + sc)
+
+
+class Bottleneck(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck, expansion 4 (ResNet-50/101/152)."""
+
+    planes: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        out_planes = self.planes * self.expansion
+        sc = x
+        y = nn.Conv(self.planes, (1, 1), use_bias=False, dtype=self.dtype,
+                    kernel_init=_KAIMING, name='conv1')(x)
+        y = nn.relu(_bn(train, self.dtype, 'bn1')(y))
+        y = nn.Conv(self.planes, (3, 3), (self.stride, self.stride),
+                    padding=1, use_bias=False, dtype=self.dtype,
+                    kernel_init=_KAIMING, name='conv2')(y)
+        y = nn.relu(_bn(train, self.dtype, 'bn2')(y))
+        y = nn.Conv(out_planes, (1, 1), use_bias=False, dtype=self.dtype,
+                    kernel_init=_KAIMING, name='conv3')(y)
+        y = _bn(train, self.dtype, 'bn3')(y)
+        if self.stride != 1 or x.shape[-1] != out_planes:
+            sc = nn.Conv(out_planes, (1, 1), (self.stride, self.stride),
+                         use_bias=False, dtype=self.dtype,
+                         kernel_init=_KAIMING, name='downsample_conv')(x)
+            sc = _bn(train, self.dtype, 'downsample_bn')(sc)
+        return nn.relu(y + sc)
+
+
+class ImageNetResNet(nn.Module):
+    """Standard ImageNet ResNet: stem + 4 stages + pooled Dense head."""
+
+    stage_sizes: Sequence[int]
+    bottleneck: bool = True
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        y = nn.Conv(64, (7, 7), (2, 2), padding=3, use_bias=False,
+                    dtype=self.dtype, kernel_init=_KAIMING, name='conv1')(x)
+        y = nn.relu(_bn(train, self.dtype, 'bn1')(y))
+        y = nn.max_pool(y, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        block = Bottleneck if self.bottleneck else BasicBlockV1
+        for stage, n_blocks in enumerate(self.stage_sizes, start=1):
+            planes = 64 * 2 ** (stage - 1)
+            for i in range(n_blocks):
+                stride = 2 if (stage > 1 and i == 0) else 1
+                y = block(planes, stride, dtype=self.dtype,
+                          name=f'layer{stage}_block{i}')(y, train=train)
+        y = jnp.mean(y, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        kernel_init=_KAIMING, name='fc')(y)
+
+
+_CONFIGS = {
+    18: ((2, 2, 2, 2), False),
+    34: ((3, 4, 6, 3), False),
+    50: ((3, 4, 6, 3), True),
+    101: ((3, 4, 23, 3), True),
+    152: ((3, 8, 36, 3), True),
+}
+
+
+def resnet(depth: int, num_classes: int = 1000,
+           dtype: jnp.dtype = jnp.float32) -> ImageNetResNet:
+    """ImageNet ResNet by depth (18/34/50/101/152)."""
+    if depth not in _CONFIGS:
+        raise ValueError(f'unsupported ImageNet ResNet depth {depth}; '
+                         f'choose from {sorted(_CONFIGS)}')
+    sizes, bottleneck = _CONFIGS[depth]
+    return ImageNetResNet(stage_sizes=sizes, bottleneck=bottleneck,
+                          num_classes=num_classes, dtype=dtype)
+
+
+def get_model(name: str, num_classes: int = 1000,
+              dtype: jnp.dtype = jnp.float32) -> ImageNetResNet:
+    """Model by name, e.g. 'resnet50' (reference uses torchvision names)."""
+    name = name.lower()
+    if not name.startswith('resnet'):
+        raise ValueError(f'unknown ImageNet model {name!r}')
+    return resnet(int(name[len('resnet'):]), num_classes, dtype)

@@ -85,8 +85,9 @@ class KFAC:
       lr: learning rate used in the KL-clip scale (default 0.1).
       use_eigen_decomp: eigendecomposition method if True, else damped
         Cholesky inverses (default True).
-      factor_dtype: dtype for factor running averages (None keeps capture
-        dtype — bf16 under mixed precision, reference README.md:150-160).
+      factor_dtype: dtype for factor running averages (default fp32; pass
+        ``jnp.bfloat16`` for bf16 factor storage/comm — the analogue of the
+        reference's keep-autocast-dtype policy, README.md:150-160).
       inv_dtype: dtype for stored inverses (default fp32; decompositions
         always *computed* in fp32, reference base.py:432-441).
       skip_layers: module names/classes to skip (case-insensitive, prunes
@@ -182,9 +183,14 @@ class KFAC:
             if spec.kind == EMBEDDING:
                 factors[name] = {'A': jnp.ones((a_dim,), fdt),
                                  'G': jnp.eye(g_dim, dtype=fdt)}
-                inverses[name] = {'A_inv': jnp.zeros((a_dim,), idt),
-                                  'QG': jnp.zeros((g_dim, g_dim), idt),
-                                  'dG': jnp.zeros((g_dim,), idt)}
+                if self.use_eigen_decomp:
+                    inverses[name] = {'A_inv': jnp.zeros((a_dim,), idt),
+                                      'QG': jnp.zeros((g_dim, g_dim), idt),
+                                      'dG': jnp.zeros((g_dim,), idt)}
+                else:
+                    inverses[name] = {'A_inv': jnp.zeros((a_dim,), idt),
+                                      'G_inv': jnp.zeros((g_dim, g_dim),
+                                                         idt)}
             else:
                 factors[name] = {'A': jnp.eye(a_dim, dtype=fdt),
                                  'G': jnp.eye(g_dim, dtype=fdt)}

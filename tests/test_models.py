@@ -1,0 +1,81 @@
+"""Model zoo checks: parameter counts, forward shapes, K-FAC registration.
+
+Param-count goldens come from the papers / reference docstring
+(reference examples/cnn_utils/cifar_resnet.py:12-18: ResNet-20 0.27M,
+ResNet-32 0.46M, ResNet-110 1.7M) and torchvision's published ImageNet
+counts (resnet50 25.56M).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import distributed_kfac_pytorch_tpu as kfac
+from distributed_kfac_pytorch_tpu.models import cifar_resnet, imagenet_resnet
+
+
+def n_params(params):
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+@pytest.mark.parametrize('depth,expected_m', [(20, 0.27), (32, 0.46),
+                                              (56, 0.85), (110, 1.73)])
+def test_cifar_param_counts(depth, expected_m):
+    model = cifar_resnet.resnet(depth)
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 32, 32, 3)), train=False)
+    count = n_params(variables['params'])
+    assert abs(count / 1e6 - expected_m) < 0.02, count
+
+
+def test_cifar_forward_shape():
+    model = cifar_resnet.get_model('resnet20')
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((2, 32, 32, 3)), train=False)
+    out = model.apply(variables, jnp.zeros((2, 32, 32, 3)), train=False)
+    assert out.shape == (2, 10)
+
+
+def test_cifar_kfac_registration():
+    """Every conv + the head Dense registers; BatchNorm does not.
+
+    ResNet-20: 1 stem conv + 9 blocks x 2 convs + 3 shortcut-free = 19
+    convs + 1 dense = 20 registered layers (option-A shortcuts are
+    parameter-free, so exactly depth layers register).
+    """
+    model = cifar_resnet.resnet(20)
+    precond = kfac.KFAC(model)
+    variables, state = precond.init(
+        jax.random.key(0), jnp.zeros((2, 32, 32, 3)), train=False)
+    kinds = {s.kind for s in precond.specs.values()}
+    assert len(precond.specs) == 20
+    assert kinds == {'conv2d', 'linear'}
+    assert set(state['factors']) == set(precond.specs)
+
+
+def test_imagenet_resnet50_param_count():
+    model = imagenet_resnet.resnet(50)
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 224, 224, 3)), train=False)
+    count = n_params(variables['params'])
+    assert abs(count / 1e6 - 25.557) < 0.05, count
+
+
+def test_imagenet_resnet18_forward_and_registration():
+    model = imagenet_resnet.resnet(18, num_classes=13)
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 64, 64, 3)), train=False)
+    out = model.apply(variables, jnp.zeros((2, 64, 64, 3)), train=False)
+    assert out.shape == (2, 13)
+    precond = kfac.KFAC(model)
+    precond.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)), train=False)
+    # 20 convs (stem + 16 block convs + 3 downsample projections) + fc.
+    assert len(precond.specs) == 21
+
+
+def test_skip_layers_prunes():
+    model = cifar_resnet.resnet(20)
+    precond = kfac.KFAC(model, skip_layers='linear')
+    precond.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)
+    assert all(s.kind == 'conv2d' for s in precond.specs.values())
+    assert len(precond.specs) == 19
