@@ -1,5 +1,15 @@
 """Mesh topology, collectives, and static work placement."""
 
+from distributed_kfac_pytorch_tpu.parallel.distributed import (
+    GRAD_WORKER_AXIS,
+    INV_GROUP_AXIS,
+    KFAC_AXES,
+    DistributedKFAC,
+    WorkAssignment,
+    assign_work,
+    make_kfac_mesh,
+    resolve_grad_workers,
+)
 from distributed_kfac_pytorch_tpu.parallel.placement import (
     WorkerAllocator,
     get_block_boundary,

@@ -43,7 +43,7 @@ from distributed_kfac_pytorch_tpu import layers as L
 from distributed_kfac_pytorch_tpu.capture import EMBEDDING, KFACCapture
 from distributed_kfac_pytorch_tpu.ops import factors as F
 from distributed_kfac_pytorch_tpu.ops import linalg
-from distributed_kfac_pytorch_tpu.parallel import load_balance
+from distributed_kfac_pytorch_tpu.parallel.placement import load_balance
 
 
 class CommMethod(enum.Enum):
