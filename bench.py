@@ -1,0 +1,132 @@
+"""Benchmark: K-FAC training-step time on the headline workload.
+
+Measures steady-state wall-clock per iteration of the full K-FAC + SGD
+training step (forward, backward with capture, factor EWMA, amortized
+eigendecompositions, preconditioning, KL clip, SGD update) at the
+reference's default ImageNet cadence (factors every 10 iters, inverses
+every 100 — reference examples/torch_imagenet_resnet.py:75-78).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <ms/iter>, "unit": "ms/iter", "vs_baseline": R}
+
+The reference repo publishes no wall-clock numbers (BASELINE.md), so
+``vs_baseline`` reports the K-FAC overhead ratio ``kfac_ms / sgd_ms``
+against a plain-SGD step of the same model on the same chip — the
+reference papers' own headline framing (K-FAC at small overhead over SGD);
+lower is better, 1.0 means free preconditioning.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_kfac_pytorch_tpu import KFAC
+from distributed_kfac_pytorch_tpu.models import cifar_resnet, imagenet_resnet
+
+
+def loss_fn(out, labels):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        out, labels).mean()
+
+
+def build_steps(model, x, y, factor_freq, inv_freq):
+    kfac = KFAC(model, factor_update_freq=factor_freq,
+                inv_update_freq=inv_freq, damping=0.003, lr=0.1)
+    variables, kstate = kfac.init(jax.random.PRNGKey(0), x)
+    params = variables['params']
+    extra = {k: v for k, v in variables.items() if k != 'params'}
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def kfac_step(params, opt_state, kstate, extra, x, y):
+        loss, _, grads, captures, updated = kfac.capture.loss_and_grads(
+            lambda out: loss_fn(out, y), params, x,
+            extra_vars=extra, mutable_cols=('batch_stats',))
+        precond, kstate = kfac.step(kstate, grads, captures)
+        updates, opt_state = tx.update(precond, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, kstate, {**extra, **updated}, loss
+
+    @jax.jit
+    def sgd_step(params, opt_state, extra, x, y):
+        def wrapped(params):
+            out, updated = model.apply(
+                {'params': params, **extra}, x,
+                mutable=['batch_stats'])
+            return loss_fn(out, y), updated
+        (loss, updated), grads = jax.value_and_grad(
+            wrapped, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {**extra, **updated}, loss
+
+    return kfac_step, sgd_step, params, opt_state, kstate, extra
+
+
+def time_loop(fn, n_iters):
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(n_iters):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n_iters * 1000.0
+
+
+def main():
+    on_tpu = jax.default_backend() == 'tpu'
+    if on_tpu:
+        model = imagenet_resnet.get_model('resnet50')
+        x = jax.random.normal(jax.random.PRNGKey(1), (64, 224, 224, 3))
+        y = jax.random.randint(jax.random.PRNGKey(2), (64,), 0, 1000)
+        metric = 'resnet50_imagenet_kfac_step'
+        n_iters, factor_freq, inv_freq = 100, 10, 100
+    else:
+        # CPU/debug fallback: tiny config so the bench always completes.
+        model = cifar_resnet.get_model('resnet20')
+        x = jax.random.normal(jax.random.PRNGKey(1), (16, 32, 32, 3))
+        y = jax.random.randint(jax.random.PRNGKey(2), (16,), 0, 10)
+        metric = 'resnet20_cifar_kfac_step_cpu'
+        n_iters, factor_freq, inv_freq = 10, 1, 10
+
+    kfac_step, sgd_step, params, opt_state, kstate, extra = build_steps(
+        model, x, y, factor_freq, inv_freq)
+
+    # Warmup: compile both programs and run one full inverse update.
+    state = [params, opt_state, kstate, extra]
+
+    def run_kfac():
+        state[0], state[1], state[2], state[3], loss = kfac_step(
+            state[0], state[1], state[2], state[3], x, y)
+        return loss
+
+    sgd_state = [params, opt_state, extra]
+
+    def run_sgd():
+        sgd_state[0], sgd_state[1], sgd_state[2], loss = sgd_step(
+            sgd_state[0], sgd_state[1], sgd_state[2], x, y)
+        return loss
+
+    jax.block_until_ready(run_kfac())
+    jax.block_until_ready(run_sgd())
+    run_kfac()  # one more warm iter each
+    run_sgd()
+
+    kfac_ms = time_loop(run_kfac, n_iters)
+    sgd_ms = time_loop(run_sgd, n_iters)
+
+    print(json.dumps({
+        'metric': metric,
+        'value': round(kfac_ms, 3),
+        'unit': 'ms/iter',
+        'vs_baseline': round(kfac_ms / sgd_ms, 4),
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -516,9 +516,72 @@ class DistributedKFAC:
                      'inv_stacks': inv_stacks, 'diag_inv': diag_inv}
         return precond, new_state
 
+    # -- checkpointing --------------------------------------------------
+
+    def state_dict(self, state: dict, include_inverses: bool = True
+                   ) -> dict:
+        """Checkpointable state: step + factors (+ inverse stacks).
+
+        Unlike the reference (which recomputes inverses on load and
+        refuses to save them under MEM_OPT, preconditioner.py:294-353),
+        inverse stacks default to *included*: orbax writes each device's
+        shard, so no rank pays for the whole stack and resume needs no
+        recompute. Pass ``include_inverses=False`` for reference-style
+        factor-only checkpoints, then call :meth:`recompute_inverses`
+        after restoring.
+        """
+        out = {'step': state['step'], 'factors': state['factors']}
+        if include_inverses:
+            out['inv_stacks'] = state['inv_stacks']
+            out['diag_inv'] = state['diag_inv']
+        return out
+
+    def load_state_dict(self, sd: dict, params, *,
+                        damping: float | None = None) -> dict:
+        """Rebuild full distributed state from a checkpoint tree.
+
+        Validates layer congruence (reference preconditioner.py:334-336)
+        and recomputes inverses from factors when they were not saved.
+        """
+        state = self.init_state(params)
+        if set(sd['factors']) != set(state['factors']):
+            raise ValueError(
+                'checkpoint layers do not match registered layers: '
+                f'{sorted(sd["factors"])} vs {sorted(state["factors"])}')
+        state = {**state, 'step': jnp.asarray(sd['step'], jnp.int32),
+                 'factors': sd['factors']}
+        if 'inv_stacks' in sd:
+            state = {**state, 'inv_stacks': sd['inv_stacks'],
+                     'diag_inv': sd['diag_inv']}
+        else:
+            state = self.recompute_inverses(state, damping=damping)
+        return state
+
+    def recompute_inverses(self, state: dict,
+                           damping: float | None = None) -> dict:
+        """Eagerly recompute all inverse stacks from current factors.
+
+        The distributed analogue of the reference's post-load
+        ``compute_inverses`` + broadcast (preconditioner.py:347-353).
+        """
+        damping = self.kfac.damping if damping is None else damping
+        kspecs = self.state_pspecs(state)
+
+        def compute(factors):
+            return self._spmd_update_inverses(factors, damping)
+
+        stacks, diag = jax.jit(jax.shard_map(
+            compute, mesh=self.mesh,
+            in_specs=(jax.tree.map(lambda _: P(), state['factors']),),
+            out_specs=(kspecs['inv_stacks'],
+                       jax.tree.map(lambda _: P(), state['diag_inv'])),
+            check_vma=False))(state['factors'])
+        return {**state, 'inv_stacks': stacks, 'diag_inv': diag}
+
     # -- full train step builder ---------------------------------------
 
     def build_train_step(self, loss_fn, tx, *, model_args_fn=None,
+                         metrics_fn=None,
                          mutable_cols: Sequence[str] = (),
                          batch_spec: P | None = None,
                          donate: bool = True):
@@ -537,6 +600,9 @@ class DistributedKFAC:
             gradients.
           model_args_fn: maps a batch pytree to the model's positional
             args; default ``batch[0],`` (i.e. ``(x, y)`` batches).
+          metrics_fn: optional ``metrics_fn(model_out, batch) -> dict`` of
+            scalars, globally averaged and merged into the returned
+            metrics (e.g. train accuracy, reference engine.py:81-83).
           mutable_cols: flax variable collections updated in the forward
             pass (e.g. ``('batch_stats',)``); their updates are
             ``pmean``ed (synchronized batch statistics).
@@ -557,12 +623,19 @@ class DistributedKFAC:
         mutable_cols = tuple(mutable_cols)
 
         def local_step(params, opt_state, kstate, extra_vars, batch, hyper):
-            loss, _, grads, captures, updated = capture.loss_and_grads(
-                lambda out: loss_fn(out, batch), params,
-                *model_args_fn(batch),
-                extra_vars=extra_vars, mutable_cols=mutable_cols)
+            def wrapped_loss(out):
+                extra = metrics_fn(out, batch) if metrics_fn else {}
+                return loss_fn(out, batch), extra
+
+            loss, extra_metrics, grads, captures, updated = (
+                capture.loss_and_grads(
+                    wrapped_loss, params, *model_args_fn(batch),
+                    extra_vars=extra_vars, mutable_cols=mutable_cols,
+                    has_aux=True))
             grads = jax.lax.pmean(grads, KFAC_AXES)
             loss = jax.lax.pmean(loss, KFAC_AXES)
+            metrics = {'loss': loss,
+                       **jax.lax.pmean(extra_metrics, KFAC_AXES)}
             precond, kstate = self.spmd_step(
                 kstate, grads, captures,
                 damping=hyper['damping'], lr=hyper['lr'],
@@ -575,14 +648,10 @@ class DistributedKFAC:
             if updated:
                 extra_vars = {**extra_vars,
                               **jax.lax.pmean(updated, KFAC_AXES)}
-            return params, opt_state, kstate, extra_vars, {'loss': loss}
-
-        def make_specs(kstate):
-            kspecs = self.state_pspecs(kstate)
-            return kspecs
+            return params, opt_state, kstate, extra_vars, metrics
 
         def step(params, opt_state, kstate, extra_vars, batch, hyper):
-            kspecs = make_specs(kstate)
+            kspecs = self.state_pspecs(kstate)
             rep = P()
             in_specs = (
                 jax.tree.map(lambda _: rep, params),
@@ -599,7 +668,7 @@ class DistributedKFAC:
                              is_leaf=lambda x: x is None),
                 kspecs,
                 jax.tree.map(lambda _: rep, extra_vars),
-                {'loss': rep},
+                rep,  # metrics dict: P() prefix covers any keys
             )
             fn = jax.shard_map(local_step, mesh=self.mesh, in_specs=in_specs,
                            out_specs=out_specs, check_vma=False)

@@ -1,0 +1,14 @@
+"""Training-application machinery (reference examples/ L4 layer).
+
+Library-ized counterparts of the reference's example utilities:
+``engine`` (train/eval loops), ``optimizers`` (SGD + K-FAC + scheduler
+factory), ``datasets`` (CIFAR/ImageNet pipelines with synthetic
+fallbacks), ``checkpoint`` (orbax save/auto-resume), ``utils``
+(metrics, label smoothing, LR schedules).
+"""
+
+from distributed_kfac_pytorch_tpu.training import checkpoint
+from distributed_kfac_pytorch_tpu.training import datasets
+from distributed_kfac_pytorch_tpu.training import engine
+from distributed_kfac_pytorch_tpu.training import optimizers
+from distributed_kfac_pytorch_tpu.training import utils

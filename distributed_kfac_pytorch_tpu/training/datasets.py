@@ -1,0 +1,190 @@
+"""Input pipelines: CIFAR-10 and ImageNet, with synthetic fallbacks.
+
+TPU-native counterpart of the reference's torchvision pipelines
+(examples/cnn_utils/datasets.py): numpy-based host loaders feeding
+globally-batched arrays that the jitted step shards over the mesh. Real
+data is read from disk when present (CIFAR-10 python pickle batches;
+ImageNet as a tf.data-readable directory tree); otherwise a deterministic
+synthetic set of the same shapes keeps every example runnable offline
+(the environment has no download egress — the reference instead
+rank-0-downloads via torchvision, datasets.py:21-27).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Iterator
+
+import numpy as np
+
+# Reference normalization constants (examples/cnn_utils/datasets.py:14-17,
+# 37-44 — standard CIFAR/ImageNet mean/std).
+CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR_STD = np.array([0.247, 0.243, 0.262], np.float32)
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+CIFAR_SEARCH_PATHS = (
+    'data/cifar-10-batches-py',
+    '/data/cifar-10-batches-py',
+    os.path.expanduser('~/data/cifar-10-batches-py'),
+)
+
+
+def _load_cifar_pickles(root: str):
+    xs, ys = [], []
+    for name in [f'data_batch_{i}' for i in range(1, 6)]:
+        with open(os.path.join(root, name), 'rb') as f:
+            d = pickle.load(f, encoding='bytes')
+        xs.append(d[b'data'])
+        ys.extend(d[b'labels'])
+    train = (np.concatenate(xs), np.array(ys, np.int32))
+    with open(os.path.join(root, 'test_batch'), 'rb') as f:
+        d = pickle.load(f, encoding='bytes')
+    test = (d[b'data'], np.array(d[b'labels'], np.int32))
+
+    def to_nhwc(flat):
+        return flat.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+
+    return ((to_nhwc(train[0]).astype(np.float32) / 255.0, train[1]),
+            (to_nhwc(test[0]).astype(np.float32) / 255.0, test[1]))
+
+
+def _synthetic_images(n: int, hw: int, n_classes: int, seed: int):
+    """Deterministic class-conditional Gaussian images (learnable).
+
+    Class prototypes are drawn from a fixed seed shared by every split, so
+    a model trained on the synthetic train split generalizes to the
+    synthetic test split; ``seed`` only varies the labels and noise.
+    """
+    protos = np.random.default_rng(1234).normal(
+        size=(n_classes, hw, hw, 3)).astype(np.float32)
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, n_classes, size=n).astype(np.int32)
+    x = 0.5 * protos[labels]
+    x += rng.normal(scale=0.5, size=x.shape).astype(np.float32)
+    return x.astype(np.float32), labels
+
+
+def get_cifar(data_dir: str | None = None, synthetic_size: int = 2048):
+    """((train_x, train_y), (test_x, test_y)) normalized NHWC CIFAR-10.
+
+    Reads pickle batches from ``data_dir`` or the standard search paths;
+    falls back to a synthetic set (``synthetic_size`` train / 1/4 test).
+    """
+    roots = [data_dir] if data_dir else []
+    roots += list(CIFAR_SEARCH_PATHS)
+    for root in roots:
+        if root and os.path.isfile(os.path.join(root, 'data_batch_1')):
+            train, test = _load_cifar_pickles(root)
+            break
+    else:
+        train = _synthetic_images(synthetic_size, 32, 10, seed=0)
+        test = _synthetic_images(synthetic_size // 4, 32, 10, seed=1)
+    norm = lambda x: (x - CIFAR_MEAN) / CIFAR_STD
+    return (norm(train[0]), train[1]), (norm(test[0]), test[1])
+
+
+def get_imagenet(data_dir: str | None = None, image_size: int = 224,
+                 synthetic_size: int = 512, num_classes: int = 1000):
+    """ImageNet pipelines; tf.data directory reader or synthetic.
+
+    The reference uses ``torchvision.ImageFolder`` + DistributedSampler
+    (datasets.py:31-51); here a ``tf.data`` JPEG pipeline when
+    ``data_dir`` exists, else synthetic arrays shaped like ImageNet.
+    Returns ((train_x, train_y), (val_x, val_y)) for the synthetic case or
+    a pair of tf.data datasets for the real case (see ``imagenet_tfdata``).
+    """
+    if data_dir and os.path.isdir(os.path.join(data_dir, 'train')):
+        return imagenet_tfdata(data_dir, image_size)
+    train = _synthetic_images(synthetic_size, image_size, num_classes,
+                              seed=0)
+    val = _synthetic_images(synthetic_size // 4, image_size, num_classes,
+                            seed=1)
+    norm = lambda x: (x - IMAGENET_MEAN) / IMAGENET_STD
+    return (norm(train[0]), train[1]), (norm(val[0]), val[1])
+
+
+def imagenet_tfdata(data_dir: str, image_size: int = 224):
+    """tf.data train/val pipelines over an ImageFolder-style tree.
+
+    Standard augmentation matching the reference (datasets.py:33-44):
+    random-resized crop + horizontal flip for train; resize(256) +
+    center-crop for eval; normalized NHWC float32.
+    """
+    import tensorflow as tf
+
+    def class_table(split_dir):
+        classes = sorted(os.listdir(split_dir))
+        return {c: i for i, c in enumerate(classes)}
+
+    def make(split, training):
+        split_dir = os.path.join(data_dir, split)
+        table = class_table(split_dir)
+        files, labels = [], []
+        for cls, idx in table.items():
+            for fname in os.listdir(os.path.join(split_dir, cls)):
+                files.append(os.path.join(split_dir, cls, fname))
+                labels.append(idx)
+        ds = tf.data.Dataset.from_tensor_slices(
+            (tf.constant(files), tf.constant(labels, tf.int32)))
+        if training:
+            ds = ds.shuffle(len(files), seed=0,
+                            reshuffle_each_iteration=True)
+
+        def load(path, label):
+            img = tf.io.decode_jpeg(tf.io.read_file(path), channels=3)
+            img = tf.cast(img, tf.float32) / 255.0
+            if training:
+                img = tf.image.resize(img, (image_size + 32,
+                                            image_size + 32))
+                img = tf.image.random_crop(
+                    img, (image_size, image_size, 3))
+                img = tf.image.random_flip_left_right(img)
+            else:
+                img = tf.image.resize(img, (256, 256))
+                off = (256 - image_size) // 2
+                img = img[off:off + image_size, off:off + image_size]
+            img = (img - IMAGENET_MEAN) / IMAGENET_STD
+            return img, label
+
+        return ds.map(load, num_parallel_calls=tf.data.AUTOTUNE)
+
+    return make('train', True), make('val', False)
+
+
+def augment_cifar(x: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+    """Pad-4 random crop + horizontal flip (reference datasets.py:14-17)."""
+    n, h, w, c = x.shape
+    padded = np.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)), mode='reflect')
+    out = np.empty_like(x)
+    ys = rng.integers(0, 9, size=n)
+    xs = rng.integers(0, 9, size=n)
+    flip = rng.random(n) < 0.5
+    for i in range(n):
+        img = padded[i, ys[i]:ys[i] + h, xs[i]:xs[i] + w]
+        out[i] = img[:, ::-1] if flip[i] else img
+    return out
+
+
+def epoch_batches(x: np.ndarray, y: np.ndarray, batch_size: int, *,
+                  shuffle: bool = True, seed: int = 0, epoch: int = 0,
+                  augment: bool = False, drop_last: bool = True
+                  ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Global-batch iterator (the mesh shards each batch on device).
+
+    Replaces the reference's DistributedSampler (datasets.py:57-63): under
+    GSPMD there is one logical batch per step; per-epoch reshuffling is
+    seeded like ``sampler.set_epoch`` for reproducibility.
+    """
+    n = x.shape[0]
+    rng = np.random.default_rng(np.random.SeedSequence([seed, epoch]))
+    idx = rng.permutation(n) if shuffle else np.arange(n)
+    end = n - (n % batch_size) if drop_last else n
+    for start in range(0, end, batch_size):
+        sel = idx[start:start + batch_size]
+        xb = x[sel]
+        if augment:
+            xb = augment_cifar(xb, rng)
+        yield xb, y[sel]

@@ -1,0 +1,148 @@
+"""Train/eval epoch loops over the distributed K-FAC step.
+
+Reference parity: examples/cnn_utils/engine.py (train/test loops with
+allreduce-averaged metrics, progress display, TensorBoard scalars). The
+per-step work (forward/backward, K-FAC, SGD, metric averaging) is entirely
+inside the jitted step from ``DistributedKFAC.build_train_step``; the host
+loop only feeds batches and accumulates metrics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+
+from distributed_kfac_pytorch_tpu.parallel.distributed import KFAC_AXES
+from distributed_kfac_pytorch_tpu.training.utils import Metric, accuracy
+
+
+@dataclasses.dataclass
+class TrainState:
+    """Everything a training step threads through (one pytree-of-pytrees).
+
+    The analogue of the reference's (model, optimizer, preconditioner,
+    schedulers) object group (torch_cifar10_resnet.py:153-176).
+    """
+    params: Any
+    opt_state: Any
+    kfac_state: Any
+    extra_vars: dict
+    step: int = 0
+    epoch: int = 0
+
+
+def train_epoch(step_fn, state: TrainState, batches: Iterable,
+                hyper: dict, *, log_writer=None, verbose: bool = False,
+                epoch_len: int | None = None) -> dict[str, float]:
+    """One training epoch; returns averaged metrics.
+
+    ``hyper`` holds this epoch's dynamic hyperparameters ('lr', 'damping',
+    optionally cadence overrides) — the reference adjusts these per epoch
+    via LambdaLR/KFACParamScheduler (engine.py:84-93).
+    """
+    meters: dict[str, Metric] = {}
+    t0 = time.perf_counter()
+    n_batches = 0
+    for batch in batches:
+        (state.params, state.opt_state, state.kfac_state, state.extra_vars,
+         metrics) = step_fn(state.params, state.opt_state, state.kfac_state,
+                            state.extra_vars, batch, hyper)
+        state.step += 1
+        n_batches += 1
+        for k, v in metrics.items():
+            meters.setdefault(k, Metric(k)).update(v)
+    elapsed = time.perf_counter() - t0
+    out = {k: m.avg for k, m in meters.items()}
+    out['time_s'] = elapsed
+    out['ms_per_iter'] = elapsed / max(n_batches, 1) * 1000.0
+    if log_writer is not None:
+        for k, v in out.items():
+            log_writer.scalar(f'train/{k}', v, state.epoch)
+    if verbose:
+        shown = {k: round(v, 4) for k, v in out.items()}
+        print(f'epoch {state.epoch}: train {shown}')
+    state.epoch += 1
+    return out
+
+
+def make_eval_step(model, loss_fn, mesh=None, *,
+                   model_args_fn=None, metrics_fn=None):
+    """Jitted eval step: global-mean loss/accuracy over the mesh.
+
+    Reference parity: engine.py:96-125 (test loop). With a mesh, the batch
+    is sharded over the K-FAC axes and metrics are ``pmean``ed; without,
+    it is a plain jitted forward.
+    """
+    if model_args_fn is None:
+        model_args_fn = lambda batch: (batch[0],)
+    if metrics_fn is None:
+        metrics_fn = lambda out, batch: {'acc': accuracy(out, batch[1])}
+
+    def compute(params, extra_vars, batch):
+        out = model.apply({'params': params, **extra_vars},
+                          *model_args_fn(batch))
+        metrics = {'loss': loss_fn(out, batch), **metrics_fn(out, batch)}
+        if mesh is not None:
+            metrics = jax.lax.pmean(metrics, KFAC_AXES)
+        return metrics
+
+    if mesh is None:
+        return jax.jit(compute)
+
+    from jax.sharding import PartitionSpec as P
+    rep = P()
+
+    def step(params, extra_vars, batch):
+        return jax.shard_map(
+            compute, mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: rep, params),
+                      jax.tree.map(lambda _: rep, extra_vars),
+                      jax.tree.map(lambda _: P(KFAC_AXES), batch)),
+            out_specs=rep, check_vma=False)(params, extra_vars, batch)
+
+    return jax.jit(step)
+
+
+def evaluate(eval_step, state: TrainState, batches: Iterable, *,
+             log_writer=None, verbose: bool = False) -> dict[str, float]:
+    """Run the eval loop; returns averaged metrics."""
+    meters: dict[str, Metric] = {}
+    for batch in batches:
+        metrics = eval_step(state.params, state.extra_vars, batch)
+        for k, v in metrics.items():
+            meters.setdefault(k, Metric(k)).update(v)
+    out = {k: m.avg for k, m in meters.items()}
+    if log_writer is not None:
+        for k, v in out.items():
+            log_writer.scalar(f'val/{k}', v, state.epoch)
+    if verbose:
+        shown = {k: round(v, 4) for k, v in out.items()}
+        print(f'epoch {state.epoch}: val {shown}')
+    return out
+
+
+class TensorBoardWriter:
+    """Thin tf.summary wrapper (reference uses torch SummaryWriter,
+    engine.py:89-93); no-ops cleanly if tensorflow is unavailable."""
+
+    def __init__(self, log_dir: str):
+        try:
+            import tensorflow as tf
+            self._writer = tf.summary.create_file_writer(log_dir)
+            self._tf = tf
+        except Exception:
+            self._writer = None
+
+    def scalar(self, tag: str, value, step: int):
+        if self._writer is None:
+            return
+        with self._writer.as_default():
+            self._tf.summary.scalar(tag, float(value), step=step)
+
+    def flush(self):
+        if self._writer is not None:
+            self._writer.flush()

@@ -1,0 +1,256 @@
+"""Training applications layer: utils, datasets, engine, checkpointing.
+
+Covers the reference L4 machinery (SURVEY.md §2 C13-C19): metric
+averaging, label smoothing, LR schedule shape, data pipelines, the full
+train/eval epoch loop, and checkpoint save/auto-resume round-trips.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_kfac_pytorch_tpu import KFAC, CommMethod
+from distributed_kfac_pytorch_tpu.models import cifar_resnet
+from distributed_kfac_pytorch_tpu.parallel import distributed as D
+from distributed_kfac_pytorch_tpu.training import (
+    checkpoint as ckpt_lib,
+    datasets,
+    engine,
+    optimizers,
+    utils,
+)
+
+
+class TestUtils:
+    def test_metric_weighted_average(self):
+        m = utils.Metric('loss')
+        m.update(1.0, n=1)
+        m.update(3.0, n=3)
+        assert m.avg == pytest.approx(2.5)
+
+    def test_accuracy(self):
+        logits = jnp.array([[0.1, 0.9], [0.8, 0.2]])
+        assert float(utils.accuracy(logits, jnp.array([1, 1]))) == 0.5
+
+    def test_label_smoothing_matches_plain_at_zero(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (4, 7))
+        labels = jnp.array([0, 1, 2, 3])
+        plain = utils.label_smooth_loss(logits, labels, 0.0)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        assert float(plain) == pytest.approx(float(ce), rel=1e-6)
+
+    def test_label_smoothing_penalizes_confidence(self):
+        logits = jnp.array([[10.0, -10.0]])
+        labels = jnp.array([0])
+        assert float(utils.label_smooth_loss(logits, labels, 0.1)) > \
+            float(utils.label_smooth_loss(logits, labels, 0.0))
+
+    def test_lr_schedule_warmup_and_decay(self):
+        # Reference semantics (examples/utils.py:50-61): factor 1 at epoch
+        # 0, `workers` after warmup, x alpha at each decay epoch.
+        f = utils.create_lr_schedule(workers=8, warmup_epochs=5,
+                                     decay_schedule=[35, 75], alpha=0.1)
+        assert f(0) == pytest.approx(1.0)
+        assert f(5) == pytest.approx(8.0)
+        assert f(34) == pytest.approx(8.0)
+        assert f(35) == pytest.approx(0.8)
+        assert f(75) == pytest.approx(0.08)
+
+
+class TestDatasets:
+    def test_synthetic_cifar_shapes(self):
+        (tx, ty), (vx, vy) = datasets.get_cifar(None, synthetic_size=256)
+        assert tx.shape == (256, 32, 32, 3) and ty.shape == (256,)
+        assert vx.shape == (64, 32, 32, 3)
+        assert tx.dtype == np.float32 and ty.dtype == np.int32
+
+    def test_synthetic_splits_share_prototypes(self):
+        # Same class -> correlated images across splits (learnable val).
+        (tx, ty), (vx, vy) = datasets.get_cifar(None, synthetic_size=512)
+        c = 3
+        t_mean = tx[ty == c].mean(axis=0).ravel()
+        v_mean = vx[vy == c].mean(axis=0).ravel()
+        corr = np.corrcoef(t_mean, v_mean)[0, 1]
+        assert corr > 0.5
+
+    def test_epoch_batches_deterministic_and_complete(self):
+        x = np.arange(40, dtype=np.float32).reshape(10, 2, 2, 1)
+        y = np.arange(10, dtype=np.int32)
+        b1 = list(datasets.epoch_batches(x, y, 4, seed=7, epoch=3))
+        b2 = list(datasets.epoch_batches(x, y, 4, seed=7, epoch=3))
+        assert len(b1) == 2  # drop_last
+        for (xa, ya), (xb, yb) in zip(b1, b2):
+            np.testing.assert_array_equal(xa, xb)
+            np.testing.assert_array_equal(ya, yb)
+        b3 = list(datasets.epoch_batches(x, y, 4, seed=7, epoch=4))
+        assert not all(np.array_equal(a[1], b[1]) for a, b in zip(b1, b3))
+
+    def test_augment_preserves_shape_and_stats(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
+        out = datasets.augment_cifar(x, rng)
+        assert out.shape == x.shape
+        assert np.isfinite(out).all()
+
+
+class TestOptimizers:
+    def test_sgd_matches_torch_semantics(self):
+        """wd folded before momentum: p -= lr*(m*buf + g + wd*p)."""
+        cfg = optimizers.OptimConfig(base_lr=0.1, momentum=0.9,
+                                     weight_decay=0.01,
+                                     kfac_inv_update_freq=0)
+        tx = optimizers.make_sgd(cfg)
+        p = {'w': jnp.array([1.0])}
+        g = {'w': jnp.array([0.5])}
+        s = tx.init(p)
+        u1, s = tx.update(g, s, p)
+        # step 1: buf = g + wd*p = 0.51; update = -lr*buf
+        np.testing.assert_allclose(u1['w'], -0.1 * 0.51, rtol=1e-6)
+        p2 = optax.apply_updates(p, u1)
+        u2, s = tx.update(g, s, p2)
+        buf2 = 0.9 * 0.51 + (0.5 + 0.01 * float(p2['w'][0]))
+        np.testing.assert_allclose(u2['w'], -0.1 * buf2, rtol=1e-6)
+
+    def test_get_optimizer_wires_kfac(self):
+        model = cifar_resnet.get_model('resnet20')
+        cfg = optimizers.OptimConfig(kfac_inv_update_freq=10,
+                                     kfac_cov_update_freq=2,
+                                     comm_method='hybrid-opt')
+        tx, lr_sched, kfac, sched = optimizers.get_optimizer(model, cfg)
+        assert kfac is not None and sched is not None
+        assert kfac.inv_update_freq == 10
+        assert kfac.factor_update_freq == 2
+        assert kfac.comm_method is CommMethod.HYBRID_OPT
+        assert lr_sched(0) == pytest.approx(cfg.base_lr)
+
+    def test_kfac_disabled_when_freq_zero(self):
+        model = cifar_resnet.get_model('resnet20')
+        cfg = optimizers.OptimConfig(kfac_inv_update_freq=0)
+        _, _, kfac, sched = optimizers.get_optimizer(model, cfg)
+        assert kfac is None and sched is None
+
+    def test_set_lr(self):
+        cfg = optimizers.OptimConfig(kfac_inv_update_freq=0)
+        tx = optimizers.make_sgd(cfg)
+        p = {'w': jnp.zeros(1)}
+        s = tx.init(p)
+        s = optimizers.set_lr(s, 0.42)
+        g = {'w': jnp.array([1.0])}
+        u, _ = tx.update(g, s, p)
+        np.testing.assert_allclose(u['w'], -0.42, rtol=1e-6)
+
+
+def _small_setup(n_epoch_batches=2, batch=32):
+    model = cifar_resnet.get_model('resnet20')
+    kfac = KFAC(model, factor_update_freq=1, inv_update_freq=2,
+                damping=0.003, lr=0.1)
+    x0 = jnp.zeros((2, 16, 16, 3))
+    variables, _ = kfac.init(jax.random.PRNGKey(0), x0)
+    params = variables['params']
+    extra = {'batch_stats': variables['batch_stats']}
+    mesh = D.make_kfac_mesh(comm_method=CommMethod.HYBRID_OPT,
+                            grad_worker_fraction=0.5)
+    dkfac = D.DistributedKFAC(kfac, mesh, params)
+    kstate = dkfac.init_state(params)
+    tx = optax.sgd(0.05, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def loss_fn(out, b):
+        return utils.label_smooth_loss(out, b[1], 0.0)
+
+    step_fn = dkfac.build_train_step(
+        loss_fn, tx, mutable_cols=('batch_stats',),
+        metrics_fn=lambda out, b: {'acc': utils.accuracy(out, b[1])},
+        donate=False)
+    rng = np.random.default_rng(0)
+    data = [(rng.normal(size=(batch, 16, 16, 3)).astype(np.float32),
+             rng.integers(0, 10, batch).astype(np.int32))
+            for _ in range(n_epoch_batches)]
+    state = engine.TrainState(params=params, opt_state=opt_state,
+                              kfac_state=kstate, extra_vars=extra)
+    return model, dkfac, tx, step_fn, state, data, mesh, loss_fn
+
+
+class TestEngine:
+    def test_train_epoch_and_eval(self):
+        (model, dkfac, tx, step_fn, state, data, mesh,
+         loss_fn) = _small_setup()
+        hyper = {'lr': 0.05, 'damping': 0.003}
+        m = engine.train_epoch(step_fn, state, data, hyper)
+        assert set(m) >= {'loss', 'acc', 'time_s', 'ms_per_iter'}
+        assert np.isfinite(m['loss'])
+        assert state.step == len(data)
+        assert state.epoch == 1
+
+        eval_step = engine.make_eval_step(
+            model, loss_fn, mesh, model_args_fn=lambda b: (b[0], False))
+        em = engine.evaluate(eval_step, state, data)
+        assert np.isfinite(em['loss']) and 0.0 <= em['acc'] <= 1.0
+
+    def test_eval_step_single_device(self):
+        model = cifar_resnet.get_model('resnet20')
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((2, 16, 16, 3)), train=False)
+        eval_step = engine.make_eval_step(
+            model, lambda out, b: utils.label_smooth_loss(out, b[1]),
+            mesh=None, model_args_fn=lambda b: (b[0], False))
+        x = np.zeros((4, 16, 16, 3), np.float32)
+        y = np.zeros((4,), np.int32)
+        m = eval_step(variables['params'],
+                      {'batch_stats': variables['batch_stats']}, (x, y))
+        assert np.isfinite(float(m['loss']))
+
+
+class TestCheckpoint:
+    def test_roundtrip_and_auto_resume(self, tmp_path):
+        (model, dkfac, tx, step_fn, state, data, mesh,
+         loss_fn) = _small_setup()
+        hyper = {'lr': 0.05, 'damping': 0.003}
+        engine.train_epoch(step_fn, state, data, hyper)
+
+        mgr = ckpt_lib.CheckpointManager(str(tmp_path / 'ckpt'))
+        tree = ckpt_lib.bundle_state(
+            state.params, state.opt_state,
+            dkfac.state_dict(state.kfac_state), state.extra_vars,
+            step=state.step)
+        mgr.save(0, tree)
+        assert mgr.latest_epoch() == 0
+
+        restored = mgr.restore(like=tree)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+            restored['params'], state.params)
+        kstate2 = dkfac.load_state_dict(restored['kfac'], state.params)
+        np.testing.assert_allclose(
+            int(kstate2['step']), int(state.kfac_state['step']))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+            kstate2['factors'], state.kfac_state['factors'])
+        mgr.close()
+
+    def test_factor_only_checkpoint_recomputes_inverses(self, tmp_path):
+        (model, dkfac, tx, step_fn, state, data, mesh,
+         loss_fn) = _small_setup()
+        hyper = {'lr': 0.05, 'damping': 0.003}
+        engine.train_epoch(step_fn, state, data, hyper)
+        sd = dkfac.state_dict(state.kfac_state, include_inverses=False)
+        assert 'inv_stacks' not in sd
+        kstate2 = dkfac.load_state_dict(sd, state.params)
+        # Inverses recomputed from factors: nonzero and finite.
+        leaves = jax.tree.leaves(kstate2['inv_stacks'])
+        assert all(np.isfinite(np.asarray(x)).all() for x in leaves)
+        assert any(np.abs(np.asarray(x)).sum() > 0 for x in leaves)
+
+    def test_layer_mismatch_rejected(self):
+        (model, dkfac, tx, step_fn, state, data, mesh,
+         loss_fn) = _small_setup()
+        sd = dkfac.state_dict(state.kfac_state)
+        sd = {**sd, 'factors': {'bogus': sd['factors'][
+            list(sd['factors'])[0]]}}
+        with pytest.raises(ValueError, match='do not match'):
+            dkfac.load_state_dict(sd, state.params)
