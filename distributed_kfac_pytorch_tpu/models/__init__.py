@@ -8,3 +8,4 @@
 
 from distributed_kfac_pytorch_tpu.models import cifar_resnet
 from distributed_kfac_pytorch_tpu.models import imagenet_resnet
+from distributed_kfac_pytorch_tpu.models import lstm_lm

@@ -1,0 +1,44 @@
+"""LSTM language model (reference examples/rnn_utils/lstm.py).
+
+Embedding -> K-FAC-friendly LSTM stack -> Dense decoder, with optional
+tied embedding/decoder weights (reference lstm.py:38-41). With
+``tie_weights`` the decoder uses ``Embed.attend`` — one shared parameter,
+the flax-native form of the reference's ``register_shared_module``
+(kfac/preconditioner.py:404-470); K-FAC then preconditions the shared
+weight through its embedding registration only.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_kfac_pytorch_tpu.modules.lstm import LSTM
+
+
+class LSTMLanguageModel(nn.Module):
+    vocab_size: int
+    embedding_dim: int = 650
+    hidden_dim: int = 650
+    num_layers: int = 2
+    dropout: float = 0.5
+    tie_weights: bool = False
+    kfac_cell: bool = True
+
+    @nn.compact
+    def __call__(self, ids, states=None, *, train: bool = True):
+        if self.tie_weights and self.embedding_dim != self.hidden_dim:
+            raise ValueError('tie_weights requires embedding_dim == '
+                             'hidden_dim (reference rnn lstm.py:38-41)')
+        embed = nn.Embed(self.vocab_size, self.embedding_dim, name='embed')
+        x = embed(ids)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x, states = LSTM(self.hidden_dim, num_layers=self.num_layers,
+                         dropout=self.dropout, kfac_cell=self.kfac_cell,
+                         name='lstm')(x, states, train=train)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        if self.tie_weights:
+            logits = embed.attend(x)
+        else:
+            logits = nn.Dense(self.vocab_size, name='decoder')(x)
+        return logits, states

@@ -1,0 +1,8 @@
+"""K-FAC-friendly recurrent modules (reference kfac/modules)."""
+
+from distributed_kfac_pytorch_tpu.modules.lstm import (
+    LSTM,
+    LSTMCell,
+    LSTMCellKFAC,
+    LSTMLayer,
+)

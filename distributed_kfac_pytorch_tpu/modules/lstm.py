@@ -1,0 +1,141 @@
+"""LSTM built from Dense submodules so K-FAC sees every gate.
+
+The reference reimplements LSTM out of ``nn.Linear`` because cuDNN's
+fused kernel hides per-timestep activations from hooks
+(reference kfac/modules/lstm.py:1-225, README.md:200-201). In JAX nothing
+is hidden, but the same decomposition is still what *defines* the K-FAC
+blocks: each gate (or fused gate stack) is a Dense module that
+``KFACCapture`` registers, with one capture per timestep — the analogue of
+the reference's per-timestep factor summation
+(``LinearMultiLayer``, kfac/layers/linear.py:27-59).
+
+The timestep loop is a Python unroll (not ``lax.scan``): each call sows
+its own activation/probe pair, exactly the ``accumulate_data`` contract
+(reference kfac/layers/base.py:364-379). Sequence lengths are static per
+training setup (BPTT truncation, reference torch_language_model.py:52),
+so the unroll compiles once.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class LSTMCellKFAC(nn.Module):
+    """LSTM cell with 8 per-gate Dense modules (one K-FAC block per gate).
+
+    Reference parity: LSTMCellKFAC (kfac/modules/lstm.py:41-68). Gate
+    order (i, f, g, o); biases live on the input-side projections like
+    torch's ``bias_ih``/``bias_hh`` pair collapsed to one.
+    """
+    hidden_size: int
+
+    @nn.compact
+    def __call__(self, x, state):
+        h, c = state
+        gates = {}
+        for name in ('i', 'f', 'g', 'o'):
+            wx = nn.Dense(self.hidden_size, use_bias=True,
+                          name=f'w_{name}x')(x)
+            wh = nn.Dense(self.hidden_size, use_bias=True,
+                          name=f'w_{name}h')(h)
+            gates[name] = wx + wh
+        i = nn.sigmoid(gates['i'])
+        f = nn.sigmoid(gates['f'])
+        g = nn.tanh(gates['g'])
+        o = nn.sigmoid(gates['o'])
+        new_c = f * c + i * g
+        new_h = o * nn.tanh(new_c)
+        return new_h, (new_h, new_c)
+
+
+class LSTMCell(nn.Module):
+    """LSTM cell with 2 fused 4H Dense modules (input and recurrent).
+
+    Reference parity: LSTMCell (kfac/modules/lstm.py:71-88) — the standard
+    torch parameterization; two big MXU-friendly matmuls per step and two
+    K-FAC blocks per cell.
+    """
+    hidden_size: int
+
+    @nn.compact
+    def __call__(self, x, state):
+        h, c = state
+        zx = nn.Dense(4 * self.hidden_size, use_bias=True, name='w_ih')(x)
+        zh = nn.Dense(4 * self.hidden_size, use_bias=True, name='w_hh')(h)
+        z = zx + zh
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        new_c = nn.sigmoid(f) * c + nn.sigmoid(i) * nn.tanh(g)
+        new_h = nn.sigmoid(o) * nn.tanh(new_c)
+        return new_h, (new_h, new_c)
+
+
+class LSTMLayer(nn.Module):
+    """One direction of one layer: Python-unrolled timestep loop.
+
+    Reference parity: LSTMLayer (kfac/modules/lstm.py:91-118). Input is
+    batch-major ``(batch, time, features)``; returns the full output
+    sequence and final state.
+    """
+    hidden_size: int
+    kfac_cell: bool = True    # 8 per-gate blocks vs 2 fused blocks
+    reverse: bool = False
+
+    @nn.compact
+    def __call__(self, xs, state=None):
+        cell_cls = LSTMCellKFAC if self.kfac_cell else LSTMCell
+        cell = cell_cls(self.hidden_size, name='cell')
+        batch = xs.shape[0]
+        if state is None:
+            h = jnp.zeros((batch, self.hidden_size), xs.dtype)
+            state = (h, h)
+        steps = range(xs.shape[1])
+        if self.reverse:
+            steps = reversed(list(steps))
+        outs = []
+        for t in steps:
+            y, state = cell(xs[:, t], state)
+            outs.append(y)
+        if self.reverse:
+            outs = outs[::-1]
+        return jnp.stack(outs, axis=1), state
+
+
+class LSTM(nn.Module):
+    """Multi-layer (optionally bidirectional) K-FAC-friendly LSTM.
+
+    Reference parity: LSTM (kfac/modules/lstm.py:120-225): per-layer
+    dropout between stacked layers, batch-major IO, and concatenated
+    directions. State is a list (one (h, c) per layer-direction).
+    """
+    hidden_size: int
+    num_layers: int = 1
+    dropout: float = 0.0
+    bidirectional: bool = False
+    kfac_cell: bool = True
+
+    @nn.compact
+    def __call__(self, xs, states=None, *, train: bool = True):
+        n_dirs = 2 if self.bidirectional else 1
+        if states is None:
+            states = [None] * (self.num_layers * n_dirs)
+        new_states = []
+        out = xs
+        for layer in range(self.num_layers):
+            dirs = []
+            for d in range(n_dirs):
+                idx = layer * n_dirs + d
+                seq, st = LSTMLayer(
+                    self.hidden_size, kfac_cell=self.kfac_cell,
+                    reverse=(d == 1), name=f'layer{layer}_d{d}')(
+                        out, states[idx])
+                dirs.append(seq)
+                new_states.append(st)
+            out = dirs[0] if n_dirs == 1 else jnp.concatenate(dirs, -1)
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                out = nn.Dropout(self.dropout, deterministic=not train)(out)
+        return out, new_states

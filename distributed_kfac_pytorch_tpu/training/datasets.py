@@ -154,6 +154,80 @@ def imagenet_tfdata(data_dir: str, image_size: int = 224):
     return make('train', True), make('val', False)
 
 
+# ---------------------------------------------------------------------------
+# Language-model corpora (reference examples/rnn_utils/utils.py,
+# torch_language_model.py — PTB/WikiText-2 via torchnlp there; here plain
+# tokenized text files with a synthetic fallback).
+# ---------------------------------------------------------------------------
+
+def get_lm_corpus(data_dir: str | None = None, *,
+                  synthetic_size: int = 200_000,
+                  vocab_size: int = 1000):
+    """(train_ids, val_ids, vocab_size) token streams for LM training.
+
+    Reads whitespace-tokenized ``train.txt`` / ``valid.txt`` under
+    ``data_dir`` (PTB/WikiText layout), building the vocabulary from the
+    train split. Without data, generates a synthetic Markov-chain corpus
+    (learnable bigram structure, shared between splits).
+    """
+    if data_dir and os.path.isfile(os.path.join(data_dir, 'train.txt')):
+        def read(split):
+            with open(os.path.join(data_dir, f'{split}.txt')) as f:
+                return f.read().replace('\n', ' <eos> ').split()
+        train_tok = read('train')
+        val_tok = read('valid')
+        vocab = {w: i for i, w in enumerate(
+            sorted(set(train_tok)) + ['<unk>'])}
+        unk = vocab['<unk>']
+        to_ids = lambda toks: np.array(
+            [vocab.get(w, unk) for w in toks], np.int32)
+        return to_ids(train_tok), to_ids(val_tok), len(vocab)
+
+    # Synthetic: a sparse random bigram chain — the next token depends on
+    # the current one, so an LSTM LM can beat the unigram entropy.
+    rng = np.random.default_rng(1234)
+    n_next = 8
+    trans = rng.integers(0, vocab_size, size=(vocab_size, n_next))
+
+    def gen(n, seed):
+        r = np.random.default_rng(seed)
+        out = np.empty(n, np.int32)
+        tok = 0
+        for i in range(n):
+            out[i] = tok
+            tok = trans[tok, r.integers(0, n_next)]
+        return out
+
+    return (gen(synthetic_size, 0), gen(synthetic_size // 10, 1),
+            vocab_size)
+
+
+def bptt_batches(ids: np.ndarray, batch_size: int, bptt: int, *,
+                 shuffle_offset: bool = False, seed: int = 0,
+                 epoch: int = 0):
+    """(inputs, targets) BPTT chunks of shape (batch, bptt).
+
+    The stream is folded into ``batch_size`` parallel contiguous tracks
+    (reference rnn_utils/utils.py:7-73 batchify + BPTT sampler); targets
+    are inputs shifted by one. Hidden state can be carried across
+    consecutive chunks of the same epoch.
+    """
+    n = ids.shape[0]
+    track = (n - 1) // batch_size
+    off = 0
+    if shuffle_offset and track > bptt:
+        off = int(np.random.default_rng(
+            np.random.SeedSequence([seed, epoch])).integers(0, bptt))
+    x = ids[off:off + batch_size * track].reshape(batch_size, track)
+    t = ids[off + 1:off + 1 + batch_size * track].reshape(batch_size,
+                                                          track)
+    for start in range(0, track - 1, bptt):
+        stop = min(start + bptt, track)
+        if stop - start < bptt:
+            break  # keep shapes static for jit
+        yield x[:, start:stop], t[:, start:stop]
+
+
 def augment_cifar(x: np.ndarray, rng: np.random.Generator) -> np.ndarray:
     """Pad-4 random crop + horizontal flip (reference datasets.py:14-17)."""
     n, h, w, c = x.shape
