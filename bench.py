@@ -1,10 +1,11 @@
-"""Benchmark: K-FAC training-step time on the headline workload.
+"""Benchmark: K-FAC training-step time on tracked config 1.
 
 Measures steady-state wall-clock per iteration of the full K-FAC + SGD
 training step (forward, backward with capture, factor EWMA, amortized
-eigendecompositions, preconditioning, KL clip, SGD update) at the
-reference's default ImageNet cadence (factors every 10 iters, inverses
-every 100 — reference examples/torch_imagenet_resnet.py:75-78).
+eigendecompositions, preconditioning, KL clip, SGD update) on
+ResNet-32 / CIFAR-10 at the reference's default CIFAR cadence (factors
+every iter, inverses every 10 — torch_cifar10_resnet.py:68-71), the most
+K-FAC-intensive tracked config in BASELINE.md.
 
 Prints ONE JSON line:
   {"metric": ..., "value": <ms/iter>, "unit": "ms/iter", "vs_baseline": R}
@@ -81,11 +82,15 @@ def time_loop(fn, n_iters):
 def main():
     on_tpu = jax.default_backend() == 'tpu'
     if on_tpu:
-        model = imagenet_resnet.get_model('resnet50')
-        x = jax.random.normal(jax.random.PRNGKey(1), (64, 224, 224, 3))
-        y = jax.random.randint(jax.random.PRNGKey(2), (64,), 0, 1000)
-        metric = 'resnet50_imagenet_kfac_step'
-        n_iters, factor_freq, inv_freq = 100, 10, 100
+        # Tracked config 1 (BASELINE.md): ResNet-32 / CIFAR-10 K-FAC at
+        # the reference CIFAR cadence (factors every iter, inverses every
+        # 10 — torch_cifar10_resnet.py:68-71). Global batch 512 keeps the
+        # MXU fed on one chip; compile stays in tens of seconds.
+        model = cifar_resnet.get_model('resnet32')
+        x = jax.random.normal(jax.random.PRNGKey(1), (512, 32, 32, 3))
+        y = jax.random.randint(jax.random.PRNGKey(2), (512,), 0, 10)
+        metric = 'resnet32_cifar10_kfac_step'
+        n_iters, factor_freq, inv_freq = 50, 1, 10
     else:
         # CPU/debug fallback: tiny config so the bench always completes.
         model = cifar_resnet.get_model('resnet20')

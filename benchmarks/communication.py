@@ -1,0 +1,84 @@
+"""Grouped-collective microbenchmark over the K-FAC mesh.
+
+TPU-native counterpart of the reference's distributed comm benchmark
+(tests/communication.py:13-57 + launch scripts): for every divisor group
+size of the device count it times the collectives the K-FAC pipeline
+actually issues — ``psum`` over the full mesh (factor allreduce), the
+``all_gather`` over the grad-worker axis (inverse broadcast), and the
+``psum`` over the inverse-group axis (gradient broadcast) — using the
+``@trace`` utility (reference kfac/utils.py:8-56).
+
+Run on any topology (virtual CPU mesh, single chip, pod):
+    python benchmarks/communication.py [--size 100] [--iters 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+from distributed_kfac_pytorch_tpu import utils
+from distributed_kfac_pytorch_tpu.parallel.distributed import (
+    GRAD_WORKER_AXIS,
+    INV_GROUP_AXIS,
+    KFAC_AXES,
+)
+
+
+def divisors(n: int) -> list[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def bench_group_size(devices, grad_workers: int, size: int, iters: int):
+    n = len(devices)
+    mesh = Mesh(np.asarray(devices).reshape(n // grad_workers,
+                                            grad_workers), KFAC_AXES)
+    x = jnp.ones((size, size), jnp.float32)
+
+    def make(op):
+        fn = jax.jit(jax.shard_map(
+            op, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))
+        fn(x)  # compile
+        return fn
+
+    ops = {
+        f'allreduce_world[gw={grad_workers}]':
+            make(lambda v: jax.lax.psum(v, KFAC_AXES) / n),
+        f'gather_inv_group[gw={grad_workers}]':
+            make(lambda v: jax.lax.psum(v, GRAD_WORKER_AXIS)),
+        f'bcast_grad_group[gw={grad_workers}]':
+            make(lambda v: jax.lax.psum(v, INV_GROUP_AXIS)),
+    }
+    for name, fn in ops.items():
+        timed = utils.trace(sync=True, name=name)(fn)
+        for _ in range(iters):
+            timed(x)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--size', type=int, default=100,
+                   help='square tensor edge (reference: 100x100)')
+    p.add_argument('--iters', type=int, default=20)
+    args = p.parse_args(argv)
+
+    devices = jax.devices()
+    print(f'{len(devices)} devices ({jax.default_backend()}); '
+          f'tensor {args.size}x{args.size}; {args.iters} iters')
+    utils.clear_trace()
+    for gw in divisors(len(devices)):
+        bench_group_size(devices, gw, args.size, args.iters)
+    utils.print_trace(average=True)
+
+
+if __name__ == '__main__':
+    main()

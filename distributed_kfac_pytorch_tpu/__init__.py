@@ -13,6 +13,12 @@ __version__ = '0.1.0'
 
 from distributed_kfac_pytorch_tpu import ops
 from distributed_kfac_pytorch_tpu import parallel
+from distributed_kfac_pytorch_tpu import utils
 from distributed_kfac_pytorch_tpu.capture import KFACCapture
+from distributed_kfac_pytorch_tpu.optim import kfac_transform
+from distributed_kfac_pytorch_tpu.parallel.distributed import (
+    DistributedKFAC,
+    make_kfac_mesh,
+)
 from distributed_kfac_pytorch_tpu.preconditioner import CommMethod, KFAC
 from distributed_kfac_pytorch_tpu.scheduler import KFACParamScheduler

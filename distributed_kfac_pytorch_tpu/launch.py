@@ -1,0 +1,68 @@
+"""Multi-host launch: process-group init and host-local data feeding.
+
+Reference L5 parity (scripts/launch_node_torch_imagenet.sh,
+scripts/slurm/*.slurm): where the reference bridges mpiexec/SLURM rank
+env-vars into ``torch.distributed.launch`` per node
+(launch_node_torch_imagenet.sh:45-48), the JAX runtime replaces the whole
+MPI machinery with ``jax.distributed.initialize`` — on TPU pods the
+coordinator and process ranks come from the TPU metadata, on SLURM from
+the SLURM env (both auto-detected), or explicitly from arguments.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+
+def initialize_multihost(coordinator_address: str | None = None,
+                         num_processes: int | None = None,
+                         process_id: int | None = None) -> dict:
+    """Initialize the JAX multi-host runtime (idempotent, single-host safe).
+
+    Auto-detects TPU pod / SLURM / Open MPI environments like
+    ``jax.distributed.initialize`` does; explicit arguments override.
+    Returns a summary dict (process_index, process_count, device counts).
+    """
+    explicit = coordinator_address or num_processes or process_id
+    multi_env = any(v in os.environ for v in (
+        'SLURM_JOB_ID', 'OMPI_COMM_WORLD_SIZE', 'TPU_WORKER_HOSTNAMES',
+        'JAX_COORDINATOR_ADDRESS'))
+    if explicit or multi_env:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+        except RuntimeError:
+            pass  # already initialized
+    return {'process_index': jax.process_index(),
+            'process_count': jax.process_count(),
+            'local_devices': jax.local_device_count(),
+            'global_devices': jax.device_count()}
+
+
+def host_local_batch_to_global(mesh, batch, pspec):
+    """Assemble a global sharded batch from per-host local arrays.
+
+    Multi-host analogue of the reference's DistributedSampler sharding
+    (each rank loads its slice, examples/cnn_utils/datasets.py:57-63):
+    each host feeds its local shard; the result is one global jax.Array
+    laid out per ``pspec`` over the mesh.
+    """
+    from jax.sharding import NamedSharding
+
+    def make(x, spec):
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_process_local_data(sharding,
+                                                      np.asarray(x))
+
+    return jax.tree.map(lambda x: make(x, pspec), batch)
+
+
+def process_local_slice(n_global: int) -> slice:
+    """Index range of this host's share of a globally-indexed dataset."""
+    per = n_global // jax.process_count()
+    start = jax.process_index() * per
+    return slice(start, start + per)

@@ -1,0 +1,55 @@
+"""optax adapter: K-FAC as a GradientTransformation.
+
+The reference's KFAC subclasses ``torch.optim.Optimizer``
+(kfac/preconditioner.py:39,203-214) so it slots into torch training
+loops; the JAX-native equivalent is an
+``optax.GradientTransformationExtraArgs`` that preconditions incoming
+gradients, so K-FAC chains with any optax optimizer:
+
+    tx = optax.chain(
+        kfac_transform(kfac),
+        optax.sgd(lr, momentum=0.9),
+    )
+    updates, state = tx.update(grads, state, params,
+                               captures=captures, lr=lr)
+
+``captures`` (from ``KFACCapture.loss_and_grads``) ride through optax's
+extra-args mechanism; cadence/strength hyperparameters are dynamic.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import optax
+
+from distributed_kfac_pytorch_tpu.preconditioner import KFAC
+
+
+class KFACTransformState(NamedTuple):
+    kfac_state: dict
+
+
+def kfac_transform(kfac: KFAC) -> optax.GradientTransformationExtraArgs:
+    """Wrap a (post-``init``) KFAC preconditioner as an optax transform.
+
+    ``update`` requires ``captures=`` and accepts the same dynamic
+    hyperparameters as :meth:`KFAC.step` (``lr``, ``damping``,
+    ``factor_decay``, ``factor_update_freq``, ``inv_update_freq``).
+    """
+
+    def init_fn(params):
+        return KFACTransformState(kfac_state=kfac.init_state(params))
+
+    def update_fn(updates, state, params=None, *, captures, lr=None,
+                  damping=None, factor_decay=None, factor_update_freq=None,
+                  inv_update_freq=None, **extra):
+        del params, extra
+        precond, new_state = kfac.step(
+            state.kfac_state, updates, captures, lr=lr, damping=damping,
+            factor_decay=factor_decay,
+            factor_update_freq=factor_update_freq,
+            inv_update_freq=inv_update_freq)
+        return precond, KFACTransformState(kfac_state=new_state)
+
+    return optax.GradientTransformationExtraArgs(init_fn, update_fn)

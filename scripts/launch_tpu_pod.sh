@@ -1,0 +1,22 @@
+#!/bin/bash
+# Launch a training example on every worker of a Cloud TPU pod slice.
+#
+# Reference L5 parity: scripts/launch_node_torch_imagenet.sh bridges
+# mpiexec + per-node torch.distributed.launch with MVAPICH2-GDR env; on
+# TPU the pod runtime already provides rendezvous, so launch is one ssh
+# fan-out and jax.distributed.initialize() inside the script
+# (distributed_kfac_pytorch_tpu/launch.py) picks up the topology.
+#
+# Usage:
+#   ./scripts/launch_tpu_pod.sh <tpu-name> <zone> examples/train_imagenet_resnet.py [args...]
+set -euo pipefail
+
+TPU_NAME=${1:?tpu name}
+ZONE=${2:?zone}
+shift 2
+SCRIPT=${1:?training script}
+shift || true
+
+gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone "${ZONE}" \
+  --worker=all \
+  --command="cd ~/distributed_kfac_pytorch_tpu && python ${SCRIPT} $*"
