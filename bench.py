@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import optax
 
 from distributed_kfac_pytorch_tpu import KFAC
-from distributed_kfac_pytorch_tpu.models import cifar_resnet, imagenet_resnet
+from distributed_kfac_pytorch_tpu.models import cifar_resnet
 
 
 def loss_fn(out, labels):

@@ -54,7 +54,8 @@ def bench_group_size(devices, grad_workers: int, size: int, iters: int):
         f'allreduce_world[gw={grad_workers}]':
             make(lambda v: jax.lax.psum(v, KFAC_AXES) / n),
         f'gather_inv_group[gw={grad_workers}]':
-            make(lambda v: jax.lax.psum(v, GRAD_WORKER_AXIS)),
+            make(lambda v: jax.lax.all_gather(v, GRAD_WORKER_AXIS,
+                                              tiled=True)),
         f'bcast_grad_group[gw={grad_workers}]':
             make(lambda v: jax.lax.psum(v, INV_GROUP_AXIS)),
     }

@@ -35,8 +35,12 @@ def initialize_multihost(coordinator_address: str | None = None,
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes, process_id=process_id)
-        except RuntimeError:
-            pass  # already initialized
+        except RuntimeError as e:
+            # Only double-init is benign; anything else (unreachable
+            # coordinator, bad env) must fail loudly or every host would
+            # silently train alone on its own shard.
+            if 'already initialized' not in str(e).lower():
+                raise
     return {'process_index': jax.process_index(),
             'process_count': jax.process_count(),
             'local_devices': jax.local_device_count(),

@@ -296,7 +296,19 @@ class DistributedKFAC:
 
     # -- SPMD pipeline stages (call inside shard_map over self.mesh) ----
 
-    def _spmd_update_factors(self, state, captures, factor_decay):
+    def local_factor_contribs(self, captures) -> dict:
+        """Per-layer local covariance contributions {name: {'A', 'G'}}.
+
+        The device-local half of the factor update (reference
+        compute_factors, preconditioner.py:566-575), split out so gradient
+        accumulation can average contributions over micro-batches before
+        the mesh ``pmean``.
+        """
+        return {name: {'A': L.compute_a_factor(spec, captures[name]['a']),
+                       'G': L.compute_g_factor(spec, captures[name]['g'])}
+                for name, spec in self.kfac.specs.items()}
+
+    def _spmd_update_factors(self, state, contribs, factor_decay):
         """Local covariance contributions, ``pmean``ed over the mesh.
 
         The analogue of compute_factors + allreduce_factors (reference
@@ -316,11 +328,9 @@ class DistributedKFAC:
         alpha = kfac.factor_decay if factor_decay is None else factor_decay
         g_scale = 1.0 / (self.n_rows * self.n_cols) ** 2
         new_factors = {}
-        for name, spec in kfac.specs.items():
-            a_new = jax.lax.pmean(
-                L.compute_a_factor(spec, captures[name]['a']), KFAC_AXES)
-            g_new = g_scale * jax.lax.pmean(
-                L.compute_g_factor(spec, captures[name]['g']), KFAC_AXES)
+        for name in kfac.specs:
+            a_new = jax.lax.pmean(contribs[name]['A'], KFAC_AXES)
+            g_new = g_scale * jax.lax.pmean(contribs[name]['G'], KFAC_AXES)
             old = state['factors'][name]
             new_factors[name] = {
                 'A': F.update_running_avg(a_new.astype(old['A'].dtype),
@@ -478,7 +488,8 @@ class DistributedKFAC:
 
     # -- the step -------------------------------------------------------
 
-    def spmd_step(self, state: dict, grads: dict, captures: dict, *,
+    def spmd_step(self, state: dict, grads: dict, captures: dict = None, *,
+                  contribs: dict = None,
                   damping=None, lr=None, factor_decay=None,
                   factor_update_freq=None, inv_update_freq=None
                   ) -> tuple[dict, dict]:
@@ -490,6 +501,12 @@ class DistributedKFAC:
         preconditioner.py:479-482); ``captures`` are this device's *local*
         batch shard captures — factor statistics are averaged globally
         inside (the subtle pre-psum/post-psum contract from SURVEY §7).
+
+        ``contribs`` may be passed instead of ``captures``: precomputed
+        local factor contributions (from :meth:`local_factor_contribs`),
+        e.g. averaged over gradient-accumulation micro-batches (the
+        analogue of the reference's ``accumulate_data`` path,
+        kfac/layers/base.py:364-379).
         """
         kfac = self.kfac
         damping = kfac.damping if damping is None else damping
@@ -499,10 +516,18 @@ class DistributedKFAC:
         i_freq = (kfac.inv_update_freq if inv_update_freq is None
                   else inv_update_freq)
         step = state['step']
+        if contribs is None and captures is None:
+            raise ValueError('pass captures or contribs')
 
         factors = jax.lax.cond(
             step % f_freq == 0,
-            lambda: self._spmd_update_factors(state, captures, factor_decay),
+            # Contraction stays inside the branch: covariance work only
+            # runs (not just gates) on factor-update steps.
+            lambda: self._spmd_update_factors(
+                state,
+                (contribs if contribs is not None
+                 else self.local_factor_contribs(captures)),
+                factor_decay),
             lambda: state['factors'])
 
         inv_stacks, diag_inv = jax.lax.cond(
@@ -584,7 +609,8 @@ class DistributedKFAC:
                          metrics_fn=None,
                          mutable_cols: Sequence[str] = (),
                          batch_spec: P | None = None,
-                         donate: bool = True):
+                         donate: bool = True,
+                         grad_accum_steps: int = 1):
         """Jitted data-parallel train step with distributed K-FAC.
 
         The functional analogue of the reference training engine step
@@ -608,6 +634,16 @@ class DistributedKFAC:
             ``pmean``ed (synchronized batch statistics).
           batch_spec: PartitionSpec of every batch leaf; defaults to
             batch-dim sharding over both mesh axes.
+          grad_accum_steps: micro-batch count per step. The per-device
+            batch shard is split into this many micro-batches processed
+            sequentially under ``lax.scan``, averaging gradients and
+            factor contributions — the analogue of the reference's
+            ``batches_per_allreduce`` sub-batch loop with ``no_sync`` and
+            hook-data accumulation (engine.py:33-65, base.py:364-379).
+            Peak activation memory drops by ~the accumulation factor;
+            numerics match the single-pass step up to fp associativity
+            (G contributions carry the exact ``1/accum**2`` loss-scale
+            correction).
 
         Returns a function
         ``step(params, opt_state, kfac_state, extra_vars, batch, hyper)
@@ -619,10 +655,14 @@ class DistributedKFAC:
             model_args_fn = lambda batch: (batch[0],)
         if batch_spec is None:
             batch_spec = P(KFAC_AXES)
+        if grad_accum_steps < 1:
+            raise ValueError(f'{grad_accum_steps=} must be >= 1')
         capture = self.kfac.capture
         mutable_cols = tuple(mutable_cols)
 
-        def local_step(params, opt_state, kstate, extra_vars, batch, hyper):
+        def fwd_bwd(params, extra_vars, batch):
+            """One micro/full-batch pass -> (loss, metrics, grads,
+            contribs, updated_vars)."""
             def wrapped_loss(out):
                 extra = metrics_fn(out, batch) if metrics_fn else {}
                 return loss_fn(out, batch), extra
@@ -632,12 +672,76 @@ class DistributedKFAC:
                     wrapped_loss, params, *model_args_fn(batch),
                     extra_vars=extra_vars, mutable_cols=mutable_cols,
                     has_aux=True))
+            return loss, extra_metrics, grads, captures, updated
+
+        def accum_fwd_bwd(params, extra_vars, batch, do_factors):
+            """Scan over micro-batches, averaging grads/contribs/metrics.
+
+            Captures are reduced to factor contributions inside the scan
+            so memory stays flat in the accumulation count (unlike the
+            reference, whose hook buffers grow linearly, README.md:144-148);
+            the contraction itself is gated on ``do_factors`` so
+            non-factor-update steps skip the covariance work, like the
+            single-pass path's in-cond contraction.
+            """
+            def split(x):
+                if x.shape[0] % grad_accum_steps:
+                    raise ValueError(
+                        f'per-device batch shard of {x.shape[0]} is not '
+                        f'divisible by {grad_accum_steps=}')
+                return x.reshape((grad_accum_steps,
+                                  x.shape[0] // grad_accum_steps)
+                                 + x.shape[1:])
+
+            micro = jax.tree.map(split, batch)
+
+            def body(carry_extra, mb):
+                loss, extra_metrics, grads, captures, updated = fwd_bwd(
+                    params, carry_extra, mb)
+                shapes = jax.eval_shape(self.local_factor_contribs,
+                                        captures)
+                contribs = jax.lax.cond(
+                    do_factors,
+                    lambda: self.local_factor_contribs(captures),
+                    lambda: jax.tree.map(
+                        lambda s: jnp.zeros(s.shape, s.dtype), shapes))
+                new_extra = ({**carry_extra, **updated} if updated
+                             else carry_extra)
+                return new_extra, (loss, extra_metrics, grads, contribs)
+
+            extra_out, (losses, extras, grads, contribs) = jax.lax.scan(
+                body, extra_vars, micro)
+            mean = lambda t: jax.tree.map(lambda x: jnp.mean(x, 0), t)
+            # g captures come from the micro-mean loss: accum x larger
+            # than the local-batch-mean-loss g; G is quadratic in g.
+            g_fix = 1.0 / grad_accum_steps ** 2
+            contribs = {name: {'A': jnp.mean(c['A'], 0),
+                               'G': g_fix * jnp.mean(c['G'], 0)}
+                        for name, c in contribs.items()}
+            updated = ({c: extra_out[c] for c in mutable_cols
+                        if c in extra_out} if mutable_cols else {})
+            return (mean(losses), mean(extras), mean(grads), contribs,
+                    updated)
+
+        def local_step(params, opt_state, kstate, extra_vars, batch, hyper):
+            if grad_accum_steps == 1:
+                loss, extra_metrics, grads, captures, updated = fwd_bwd(
+                    params, extra_vars, batch)
+                contribs = None
+            else:
+                f_freq = hyper.get('factor_update_freq')
+                if f_freq is None:
+                    f_freq = self.kfac.factor_update_freq
+                do_factors = kstate['step'] % f_freq == 0
+                loss, extra_metrics, grads, contribs, updated = (
+                    accum_fwd_bwd(params, extra_vars, batch, do_factors))
+                captures = None
             grads = jax.lax.pmean(grads, KFAC_AXES)
             loss = jax.lax.pmean(loss, KFAC_AXES)
             metrics = {'loss': loss,
                        **jax.lax.pmean(extra_metrics, KFAC_AXES)}
             precond, kstate = self.spmd_step(
-                kstate, grads, captures,
+                kstate, grads, captures, contribs=contribs,
                 damping=hyper['damping'], lr=hyper['lr'],
                 factor_decay=hyper.get('factor_decay'),
                 factor_update_freq=hyper.get('factor_update_freq'),

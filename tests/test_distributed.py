@@ -243,3 +243,74 @@ def test_resnet20_with_batchnorm_trains():
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]
     assert set(extra) == {'batch_stats'}
+
+
+def test_grad_accumulation_matches_single_pass():
+    """grad_accum_steps=2 == one full-batch pass (reference engine.py:33-65).
+
+    Gradients average linearly and G contributions carry the 1/accum^2
+    loss-scale correction, so the accumulated step must agree with the
+    single-pass step to fp tolerance.
+    """
+    model = SmallCNN()
+    kfac = KFAC(model, factor_update_freq=1, inv_update_freq=2,
+                damping=0.003, lr=0.1)
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, 8, 8, 3))
+    y = jax.random.randint(jax.random.PRNGKey(2), (32,), 0, 10)
+    variables, _ = kfac.init(jax.random.PRNGKey(0), x)
+    params = variables['params']
+
+    dkfac = make_dist(kfac, params, CommMethod.HYBRID_OPT, 0.5)
+    tx = optax.sgd(0.1)
+    hyper = {'lr': 0.1, 'damping': 0.003}
+
+    results = []
+    for accum in (1, 2, 4):
+        step = dkfac.build_train_step(loss_fn, tx, donate=False,
+                                      grad_accum_steps=accum)
+        p = jax.tree.map(jnp.asarray, params)
+        opt_state = tx.init(p)
+        dstate = dkfac.init_state(p)
+        extra = {}
+        for _ in range(3):
+            p, opt_state, dstate, extra, metrics = step(
+                p, opt_state, dstate, extra, (x, y), hyper)
+        results.append((p, dstate, metrics))
+
+    p1, s1, m1 = results[0]
+    for p2, s2, m2 in results[1:]:
+        np.testing.assert_allclose(m2['loss'], m1['loss'], rtol=1e-4)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=1e-2, atol=1e-4), p2, p1)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=1e-2, atol=1e-4),
+            s2['factors'], s1['factors'])
+
+
+def test_grad_accumulation_threads_batch_stats():
+    """Mutable collections update sequentially across micro-batches."""
+    model = cifar_resnet.get_model('resnet20')
+    kfac = KFAC(model, factor_update_freq=1, inv_update_freq=1,
+                damping=0.003, lr=0.1)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 16, 16, 3))
+    y = jax.random.randint(jax.random.PRNGKey(2), (16,), 0, 10)
+    variables, _ = kfac.init(jax.random.PRNGKey(0), x)
+    params = variables['params']
+    extra = {'batch_stats': variables['batch_stats']}
+
+    dkfac = make_dist(kfac, params, CommMethod.COMM_OPT)
+    tx = optax.sgd(0.1)
+    step = dkfac.build_train_step(loss_fn, tx, donate=False,
+                                  grad_accum_steps=2,
+                                  mutable_cols=('batch_stats',))
+    before = jax.tree.map(jnp.asarray, extra['batch_stats'])
+    p, opt_state, dstate = params, tx.init(params), dkfac.init_state(params)
+    p, opt_state, dstate, extra, metrics = step(
+        p, opt_state, dstate, extra, (x, y),
+        {'lr': 0.1, 'damping': 0.003})
+    assert jnp.isfinite(metrics['loss'])
+    changed = jax.tree.leaves(jax.tree.map(
+        lambda a, b: jnp.any(a != b), before, extra['batch_stats']))
+    assert any(bool(c) for c in changed)
