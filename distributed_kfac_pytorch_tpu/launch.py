@@ -36,15 +36,34 @@ def initialize_multihost(coordinator_address: str | None = None,
                 coordinator_address=coordinator_address,
                 num_processes=num_processes, process_id=process_id)
         except RuntimeError as e:
-            # Only double-init is benign; anything else (unreachable
-            # coordinator, bad env) must fail loudly or every host would
+            # Double-init is benign, as is auto-detection firing after the
+            # backend is already live *when the env says single-process*
+            # (notebooks/tests where the platform runtime exports
+            # TPU_WORKER_HOSTNAMES=localhost etc.). A job whose env
+            # declares >1 process must fail loudly, or every host would
             # silently train alone on its own shard.
-            if 'already initialized' not in str(e).lower():
+            msg = str(e).lower()
+            benign = ('should only be called once' in msg
+                      or (_detected_world_size() <= 1
+                          and not explicit
+                          and 'must be called before' in msg))
+            if not benign:
                 raise
     return {'process_index': jax.process_index(),
             'process_count': jax.process_count(),
             'local_devices': jax.local_device_count(),
             'global_devices': jax.device_count()}
+
+
+def _detected_world_size() -> int:
+    """Process count declared by the launch environment (1 if unknown)."""
+    for var in ('SLURM_NTASKS', 'OMPI_COMM_WORLD_SIZE'):
+        if os.environ.get(var, '').isdigit():
+            return int(os.environ[var])
+    hosts = os.environ.get('TPU_WORKER_HOSTNAMES', '')
+    if hosts:
+        return len([h for h in hosts.split(',') if h.strip()])
+    return 1
 
 
 def host_local_batch_to_global(mesh, batch, pspec):

@@ -20,7 +20,10 @@ from distributed_kfac_pytorch_tpu.ops.linalg import (
     get_eigendecomp,
     get_elementwise_inverse,
     get_inverse,
+    newton_schulz_inverse,
     precondition_diag_a,
     precondition_eigen,
     precondition_inv,
 )
+from distributed_kfac_pytorch_tpu.ops import pallas_kernels
+from distributed_kfac_pytorch_tpu.ops.pallas_kernels import batched_inverse

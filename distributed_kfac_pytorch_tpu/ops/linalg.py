@@ -13,6 +13,8 @@ the O(n^3) decompositions as one batched kernel spread across the mesh.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -47,6 +49,52 @@ def get_inverse(x: jax.Array, damping: float | jax.Array | None = None
     eye = jnp.eye(x.shape[-1], dtype=x.dtype)
     inv_l = jax.scipy.linalg.solve_triangular(chol, eye, lower=True)
     return inv_l.T @ inv_l
+
+
+def newton_schulz_inverse(x: jax.Array,
+                          damping: float | jax.Array | None = None,
+                          iters: int = 100,
+                          tol: float = 1e-5) -> jax.Array:
+    """Damped SPD inverse via Newton–Schulz (Hotelling–Bodewig) iteration.
+
+    ``X_{k+1} = X_k (2I - M X_k)`` with ``M = x + damping*I`` and
+    ``X_0 = I / ||M||_inf``. Matmul-only — every FLOP lands on the MXU,
+    unlike the partly-sequential Cholesky/eigh factorizations. The error
+    squares each step, so ``~log2(cond(M)) + 6`` iterations suffice
+    (cond <= ||M||_inf/damping); the loop exits early once the residual
+    ``max|M X - I|`` drops below ``tol``, with ``iters`` as the hard cap
+    for pathologically-conditioned inputs.
+
+    The same trick production TPU second-order optimizers use for inverse
+    matrix roots (distributed Shampoo's coupled Newton iteration); for
+    K-FAC only the plain inverse is needed. Semantically interchangeable
+    with :func:`get_inverse` (the reference's damped Cholesky inverse,
+    kfac/layers/utils.py:76-96) — same operator, different algorithm.
+    """
+    x = x.astype(jnp.float32)
+    n = x.shape[-1]
+    eye = jnp.eye(n, dtype=jnp.float32)
+    m = x if damping is None else x + damping * eye
+    bound = jnp.maximum(jnp.max(jnp.sum(jnp.abs(m), axis=-1)), 1e-30)
+    x0 = eye / bound
+    # Full fp32 matmul precision: with the TPU default (bf16 passes) the
+    # iteration stalls at a ~1e-1 residual floor once ||X|| ~ 1/damping.
+    mm = functools.partial(jnp.matmul, precision=jax.lax.Precision.HIGHEST)
+
+    def cond_fn(state):
+        k, _, res = state
+        return jnp.logical_and(k < iters, res > tol)
+
+    def body(state):
+        k, xk, _ = state
+        y = mm(m, xk)
+        res = jnp.max(jnp.abs(y - eye))  # residual of xk, costs O(n^2)
+        return k + 1, 2.0 * xk - mm(xk, y), res
+
+    _, out, _ = jax.lax.while_loop(
+        cond_fn, body, (jnp.zeros((), jnp.int32), x0,
+                        jnp.full((), jnp.inf, jnp.float32)))
+    return out
 
 
 def get_elementwise_inverse(v: jax.Array,

@@ -1,0 +1,150 @@
+"""Pallas TPU kernels for the K-FAC hot ops.
+
+The O(n^3) factor inversion is the framework's make-or-break kernel
+(SURVEY.md §7 "Hard parts"; reference does it with sequential cuSOLVER
+calls per layer, kfac/layers/base.py:432-441). Two properties make a
+custom kernel pay off on TPU:
+
+  - the iteration that replaces the factorization (Newton–Schulz, see
+    ``ops.linalg.newton_schulz_inverse``) is matmul-only, so it runs on
+    the MXU at full tilt; and
+  - between iterations nothing needs to leave the chip — a VMEM-resident
+    kernel holds ``M`` and the iterate ``X`` on-chip for the whole solve,
+    eliminating the HBM round trip per matmul that a stock XLA lowering
+    of the same loop pays (2 reads + 1 write of n^2 floats per matmul,
+    ~60x the arithmetic-intensity at n=512).
+
+``batched_inverse`` dispatches: Pallas kernel on TPU for matrices that
+fit VMEM (padded to lane multiples), plain-XLA Newton–Schulz elsewhere.
+Both paths are bit-compatible in structure (same iteration, fp32).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Matrices up to this dim run in the VMEM-resident kernel. Measured scoped
+# VMEM on v5e is ~45 B/element (M/out blocks double-buffered by Mosaic +
+# X carry + Y temp): n_pad=640 allocates 18.7 MB and OOMs the 16 MB limit,
+# n_pad=512 ~12 MB fits. Larger factors fall back to the stock-XLA
+# Newton–Schulz (still matmul-only, just HBM-streamed between iterations).
+MAX_PALLAS_DIM = 512
+_LANE = 128
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def _ns_inverse_kernel(m_ref, out_ref, *, iters: int, n_pad: int,
+                       tol: float):
+    """One matrix per grid cell: damped-inverse Newton–Schulz in VMEM.
+
+    The damping is already folded into the input; padding rows/cols carry
+    an identity block so the padded inverse is the inverse of the padded
+    matrix (sliced away by the caller). Early-exits on the residual
+    ``max|M X - I|`` like :func:`ops.linalg.newton_schulz_inverse`.
+    """
+    m = m_ref[0]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 1)
+    eye = (rows == cols).astype(jnp.float32)
+    bound = jnp.maximum(jnp.max(jnp.sum(jnp.abs(m), axis=-1)), 1e-30)
+    x0 = eye * (1.0 / bound)
+
+    dot = functools.partial(jnp.dot, preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.HIGHEST)
+
+    def cond_fn(state):
+        k, _, res = state
+        return jnp.logical_and(k < iters, res > tol)
+
+    def body(state):
+        k, x, _ = state
+        y = dot(m, x)
+        res = jnp.max(jnp.abs(y - eye))
+        return k + 1, 2.0 * x - dot(x, y), res
+
+    _, out, _ = jax.lax.while_loop(
+        cond_fn, body, (jnp.zeros((), jnp.int32), x0,
+                        jnp.full((), jnp.inf, jnp.float32)))
+    out_ref[0] = out
+
+
+@functools.partial(jax.jit, static_argnames=('iters', 'tol', 'interpret'))
+def _pallas_batched_ns_inverse(mats: jax.Array, damping, *,
+                               iters: int = 100, tol: float = 1e-5,
+                               interpret: bool = False) -> jax.Array:
+    """(B, n, n) stack -> damped inverses via the VMEM-resident kernel."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, n, _ = mats.shape
+    n_pad = _round_up(max(n, 8), _LANE)
+    m = mats.astype(jnp.float32)
+    m = m + damping * jnp.eye(n, dtype=jnp.float32)
+    if n_pad != n:
+        # Identity padding block: keeps the padded matrix SPD and leaves
+        # the top-left inverse block equal to the unpadded inverse.
+        m = jnp.pad(m, ((0, 0), (0, n_pad - n), (0, n_pad - n)))
+        pad_eye = (jnp.eye(n_pad, dtype=jnp.float32)
+                   .at[:n, :n].set(0.0))
+        m = m + pad_eye[None]
+
+    kernel = functools.partial(_ns_inverse_kernel, iters=iters, n_pad=n_pad,
+                               tol=tol)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, n_pad, n_pad), jnp.float32),
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, n_pad, n_pad), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, n_pad, n_pad), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(m)
+    return out[:, :n, :n]
+
+
+def batched_inverse(mats: jax.Array, damping, *, iters: int = 100,
+                    tol: float = 1e-5,
+                    force_pallas: bool | None = None,
+                    interpret: bool = False) -> jax.Array:
+    """Damped SPD inverses of a (B, n, n) stack, TPU-kernel accelerated.
+
+    Dispatch is static (trace-time): the Pallas path is taken on TPU
+    backends for dims that fit VMEM, or when ``force_pallas`` is set
+    (tests use ``force_pallas=True, interpret=True`` to exercise the
+    kernel on CPU).
+    """
+    n = mats.shape[-1]
+    use_pallas = force_pallas
+    if use_pallas is None:
+        use_pallas = (jax.default_backend() == 'tpu'
+                      and n <= MAX_PALLAS_DIM)
+    if use_pallas:
+        return _pallas_batched_ns_inverse(mats, damping, iters=iters,
+                                          tol=tol, interpret=interpret)
+    from distributed_kfac_pytorch_tpu.ops import linalg
+    return jax.vmap(
+        lambda m: linalg.newton_schulz_inverse(m, damping, iters=iters,
+                                               tol=tol)
+    )(mats)
+
+
+def damped_inverse_stack(stack: jax.Array, damping, method: str,
+                         iters: int = 100) -> jax.Array:
+    """Shared newton/cholesky dispatch for a same-size factor stack.
+
+    Single point of truth for the single-device bucketed path
+    (preconditioner.KFAC._bucketed_inverse) and the SPMD path
+    (parallel.distributed._spmd_update_inverses), so algorithm changes
+    stay in lockstep across both.
+    """
+    if method == 'newton':
+        return batched_inverse(stack, damping, iters=iters)
+    from distributed_kfac_pytorch_tpu.ops import linalg
+    return jax.vmap(lambda m: linalg.get_inverse(m, damping=damping))(stack)

@@ -54,6 +54,7 @@ from distributed_kfac_pytorch_tpu import layers as L
 from distributed_kfac_pytorch_tpu.capture import EMBEDDING
 from distributed_kfac_pytorch_tpu.ops import factors as F
 from distributed_kfac_pytorch_tpu.ops import linalg
+from distributed_kfac_pytorch_tpu.ops import pallas_kernels
 from distributed_kfac_pytorch_tpu.parallel.placement import load_balance
 from distributed_kfac_pytorch_tpu.preconditioner import KFAC, CommMethod
 
@@ -382,8 +383,9 @@ class DistributedKFAC:
                 stacks[str(dim)] = {'Q': q.astype(kfac.inv_dtype),
                                     'd': d.astype(kfac.inv_dtype)}
             else:
-                inv = jax.vmap(
-                    lambda m: linalg.get_inverse(m, damping=damping))(local)
+                inv = pallas_kernels.damped_inverse_stack(
+                    local, damping, kfac.inverse_method,
+                    iters=kfac.newton_iters)
                 inv = jax.lax.all_gather(
                     inv, GRAD_WORKER_AXIS, tiled=True)
                 stacks[str(dim)] = {'inv': inv.astype(kfac.inv_dtype)}
@@ -694,34 +696,47 @@ class DistributedKFAC:
                                  + x.shape[1:])
 
             micro = jax.tree.map(split, batch)
+            first = jax.tree.map(lambda x: x[0], micro)
+            loss_sh, extras_sh, grads_sh, captures_sh, _ = jax.eval_shape(
+                fwd_bwd, params, extra_vars, first)
+            contribs_sh = jax.eval_shape(self.local_factor_contribs,
+                                         captures_sh)
+            zeros = lambda sh: jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), sh)
 
-            def body(carry_extra, mb):
+            # Running sums live in the carry so peak memory stays at one
+            # micro-batch (a stacked scan output would materialize
+            # accum x every grad/contrib leaf before the reduction).
+            def body(carry, mb):
+                extra_c, sums = carry
                 loss, extra_metrics, grads, captures, updated = fwd_bwd(
-                    params, carry_extra, mb)
-                shapes = jax.eval_shape(self.local_factor_contribs,
-                                        captures)
+                    params, extra_c, mb)
                 contribs = jax.lax.cond(
                     do_factors,
                     lambda: self.local_factor_contribs(captures),
-                    lambda: jax.tree.map(
-                        lambda s: jnp.zeros(s.shape, s.dtype), shapes))
-                new_extra = ({**carry_extra, **updated} if updated
-                             else carry_extra)
-                return new_extra, (loss, extra_metrics, grads, contribs)
+                    lambda: zeros(contribs_sh))
+                new_sums = jax.tree.map(
+                    jnp.add, sums, (loss, extra_metrics, grads, contribs))
+                new_extra = ({**extra_c, **updated} if updated
+                             else extra_c)
+                return (new_extra, new_sums), None
 
-            extra_out, (losses, extras, grads, contribs) = jax.lax.scan(
-                body, extra_vars, micro)
-            mean = lambda t: jax.tree.map(lambda x: jnp.mean(x, 0), t)
+            init = (extra_vars, (zeros(loss_sh), zeros(extras_sh),
+                                 zeros(grads_sh), zeros(contribs_sh)))
+            (extra_out, sums), _ = jax.lax.scan(body, init, micro)
+            loss_sum, extras_sum, grads_sum, contribs_sum = sums
+            inv_n = 1.0 / grad_accum_steps
+            mean = lambda t: jax.tree.map(lambda x: x * inv_n, t)
             # g captures come from the micro-mean loss: accum x larger
             # than the local-batch-mean-loss g; G is quadratic in g.
             g_fix = 1.0 / grad_accum_steps ** 2
-            contribs = {name: {'A': jnp.mean(c['A'], 0),
-                               'G': g_fix * jnp.mean(c['G'], 0)}
-                        for name, c in contribs.items()}
+            contribs = {name: {'A': c['A'] * inv_n,
+                               'G': g_fix * c['G'] * inv_n}
+                        for name, c in contribs_sum.items()}
             updated = ({c: extra_out[c] for c in mutable_cols
                         if c in extra_out} if mutable_cols else {})
-            return (mean(losses), mean(extras), mean(grads), contribs,
-                    updated)
+            return (mean(loss_sum), mean(extras_sum), mean(grads_sum),
+                    contribs, updated)
 
         def local_step(params, opt_state, kstate, extra_vars, batch, hyper):
             if grad_accum_steps == 1:

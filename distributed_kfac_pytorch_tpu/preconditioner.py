@@ -43,6 +43,7 @@ from distributed_kfac_pytorch_tpu import layers as L
 from distributed_kfac_pytorch_tpu.capture import EMBEDDING, KFACCapture
 from distributed_kfac_pytorch_tpu.ops import factors as F
 from distributed_kfac_pytorch_tpu.ops import linalg
+from distributed_kfac_pytorch_tpu.ops import pallas_kernels
 from distributed_kfac_pytorch_tpu.parallel.placement import load_balance
 
 
@@ -84,7 +85,15 @@ class KFAC:
       kl_clip: KL clipping parameter; None disables scaling (default 0.001).
       lr: learning rate used in the KL-clip scale (default 0.1).
       use_eigen_decomp: eigendecomposition method if True, else damped
-        Cholesky inverses (default True).
+        inverses (default True; mutually consistent with
+        ``inverse_method`` — contradictory combinations raise).
+      inverse_method: 'eigen' (same as ``use_eigen_decomp=True``),
+        'cholesky' (XLA Cholesky + triangular solves, the reference's
+        non-eigen method) or 'newton' (matmul-only Newton–Schulz, Pallas
+        VMEM-resident on TPU — see ops.pallas_kernels). Defaults to
+        'eigen'/'cholesky' per ``use_eigen_decomp``.
+      newton_iters: iteration cap for 'newton' (the loop exits early on
+        a 1e-5 residual; ~log2(cond)+6 iterations are used in practice).
       factor_dtype: dtype for factor running averages (default fp32; pass
         ``jnp.bfloat16`` for bf16 factor storage/comm — the analogue of the
         reference's keep-autocast-dtype policy, README.md:150-160).
@@ -105,7 +114,9 @@ class KFAC:
                  inv_update_freq: int = 100,
                  kl_clip: float | None = 0.001,
                  lr: float = 0.1,
-                 use_eigen_decomp: bool = True,
+                 use_eigen_decomp: bool | None = None,
+                 inverse_method: str | None = None,
+                 newton_iters: int = 100,
                  factor_dtype: Any = None,
                  inv_dtype: Any = jnp.float32,
                  skip_layers: str | Sequence[str] | None = None,
@@ -131,7 +142,21 @@ class KFAC:
         self.inv_update_freq = inv_update_freq
         self.kl_clip = kl_clip
         self.lr = lr
-        self.use_eigen_decomp = use_eigen_decomp
+        if inverse_method is None:
+            inverse_method = ('cholesky' if use_eigen_decomp is False
+                              else 'eigen')
+        if inverse_method not in ('eigen', 'cholesky', 'newton'):
+            raise ValueError(
+                "inverse_method must be 'eigen', 'cholesky' or 'newton', "
+                f'got {inverse_method!r}')
+        if use_eigen_decomp is not None and (
+                use_eigen_decomp != (inverse_method == 'eigen')):
+            raise ValueError(
+                f'{use_eigen_decomp=} contradicts {inverse_method=}; '
+                'set one or the other')
+        self.inverse_method = inverse_method
+        self.use_eigen_decomp = inverse_method == 'eigen'
+        self.newton_iters = newton_iters
         self.factor_dtype = factor_dtype
         self.inv_dtype = inv_dtype
         self.assignment_strategy = assignment_strategy
@@ -277,16 +302,29 @@ class KFAC:
         sequential cuSOLVER calls (base.py:432-441), and the unit that
         ``parallel.distributed`` shards across the mesh.
         """
-        buckets: dict[int, list[str]] = {}
-        for name, m in mats.items():
-            buckets.setdefault(m.shape[-1], []).append(name)
         out: dict[str, tuple[jax.Array, jax.Array]] = {}
-        for dim, names in buckets.items():
-            stack = jnp.stack([mats[n].astype(jnp.float32) for n in names])
+        for names, stack in _size_buckets(mats):
             qs, ds = jax.vmap(
                 lambda m: linalg.get_eigendecomp(m, clip=0.0))(stack)
             for i, n in enumerate(names):
                 out[n] = (qs[i], ds[i])
+        return out
+
+    def _bucketed_inverse(self, mats: dict[str, jax.Array], damping
+                          ) -> dict[str, jax.Array]:
+        """Damped-inverse a dict of SPD matrices, batching equal sizes.
+
+        Non-eigen analogue of :meth:`_bucketed_eigh` (reference damped
+        Cholesky inverse, kfac/layers/base.py:432-441): 'newton' runs the
+        matmul-only Newton–Schulz stack (Pallas VMEM-resident on TPU),
+        'cholesky' a vmapped XLA Cholesky inverse.
+        """
+        out: dict[str, jax.Array] = {}
+        for names, stack in _size_buckets(mats):
+            invs = pallas_kernels.damped_inverse_stack(
+                stack, damping, self.inverse_method, iters=self.newton_iters)
+            for i, n in enumerate(names):
+                out[n] = invs[i]
         return out
 
     def update_inverses(self, state: dict, damping) -> dict:
@@ -319,23 +357,16 @@ class KFAC:
                     entry['dA'] = da.astype(self.inv_dtype)
                 new_inv[name] = entry
         else:
+            invs = self._bucketed_inverse(mats, damping)
             for name, spec in self.specs.items():
+                entry = {'G_inv': invs[f'{name}/G'].astype(self.inv_dtype)}
                 if spec.kind == EMBEDDING:
-                    new_inv[name] = {
-                        'A_inv': linalg.get_elementwise_inverse(
-                            state['factors'][name]['A'].astype(jnp.float32),
-                            damping=damping).astype(self.inv_dtype),
-                        'G_inv': linalg.get_inverse(
-                            state['factors'][name]['G'],
-                            damping=damping).astype(self.inv_dtype)}
+                    entry['A_inv'] = linalg.get_elementwise_inverse(
+                        state['factors'][name]['A'].astype(jnp.float32),
+                        damping=damping).astype(self.inv_dtype)
                 else:
-                    new_inv[name] = {
-                        'A_inv': linalg.get_inverse(
-                            state['factors'][name]['A'],
-                            damping=damping).astype(self.inv_dtype),
-                        'G_inv': linalg.get_inverse(
-                            state['factors'][name]['G'],
-                            damping=damping).astype(self.inv_dtype)}
+                    entry['A_inv'] = invs[f'{name}/A'].astype(self.inv_dtype)
+                new_inv[name] = entry
         return new_inv
 
     def precondition(self, state: dict, grads: dict, damping, lr,
@@ -479,6 +510,20 @@ class KFAC:
             state = {**state,
                      'inverses': self.update_inverses(state, self.damping)}
         return state
+
+
+def _size_buckets(mats: dict[str, jax.Array]):
+    """Group a dict of square matrices by size: yields (names, fp32 stack).
+
+    Ordering is deterministic (dict insertion order within a size), so the
+    stacked layout is stable across traces.
+    """
+    buckets: dict[int, list[str]] = {}
+    for name, m in mats.items():
+        buckets.setdefault(m.shape[-1], []).append(name)
+    for dim, names in buckets.items():
+        yield names, jnp.stack([mats[n].astype(jnp.float32)
+                                for n in names])
 
 
 def _get(tree, path: tuple[str, ...]):

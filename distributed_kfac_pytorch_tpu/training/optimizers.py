@@ -46,7 +46,8 @@ class OptimConfig:
     damping: float = 0.003
     factor_decay: float = 0.95
     kl_clip: float = 0.001
-    use_eigen_decomp: bool = True
+    use_eigen_decomp: bool | None = None  # None: follow inverse_method
+    inverse_method: str | None = None     # 'eigen' | 'cholesky' | 'newton'
     skip_layers: Sequence[str] = ()
     comm_method: str = 'comm-opt'
     grad_worker_fraction: float = 0.25
@@ -109,6 +110,7 @@ def get_optimizer(model, cfg: OptimConfig):
             kl_clip=cfg.kl_clip,
             lr=cfg.base_lr,
             use_eigen_decomp=cfg.use_eigen_decomp,
+            inverse_method=cfg.inverse_method,
             skip_layers=list(cfg.skip_layers) or None,
             comm_method=COMM_METHODS[cfg.comm_method.lower()],
             grad_worker_fraction=cfg.grad_worker_fraction)
