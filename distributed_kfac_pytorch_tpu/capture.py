@@ -185,15 +185,23 @@ class KFACCapture:
 
         return interceptor
 
-    def init(self, rng, *args, **kwargs) -> tuple[dict, dict]:
+    def init(self, rng, *args, init_model: nn.Module | None = None,
+             **kwargs) -> tuple[dict, dict]:
         """Init model variables under interception; records layer specs.
 
         Returns ``(variables, specs)`` (plain dicts). ``variables`` contains 'params' and
         'kfac_probes' (zeros, shaped for the init batch).
+
+        ``init_model`` optionally substitutes a structurally-identical
+        single-device twin for the trace — needed when ``self.model``
+        contains collectives that only trace inside ``shard_map`` (e.g. a
+        ring-attention sequence-parallel model): params and layer specs
+        depend only on structure, so the twin's registration is exact.
         """
         self._specs = {}
+        model = self.model if init_model is None else init_model
         with nn.intercept_methods(self._make_interceptor(record_specs=True)):
-            variables = self.model.init(rng, *args, **kwargs)
+            variables = model.init(rng, *args, **kwargs)
         variables = dict(variables)
         variables.pop(CAPTURE_COL, None)
         return variables, dict(self._specs)

@@ -57,7 +57,8 @@ def initialize_multihost(coordinator_address: str | None = None,
 
 def _detected_world_size() -> int:
     """Process count declared by the launch environment (1 if unknown)."""
-    for var in ('SLURM_NTASKS', 'OMPI_COMM_WORLD_SIZE'):
+    for var in ('SLURM_NTASKS', 'OMPI_COMM_WORLD_SIZE',
+                'JAX_NUM_PROCESSES'):
         if os.environ.get(var, '').isdigit():
             return int(os.environ[var])
     hosts = os.environ.get('TPU_WORKER_HOSTNAMES', '')

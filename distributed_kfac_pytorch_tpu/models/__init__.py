@@ -4,8 +4,12 @@
   examples/cnn_utils/cifar_resnet.py).
 - ``imagenet_resnet``: ResNet-18..152 for ImageNet-1k (reference uses
   torchvision models in examples/torch_imagenet_resnet.py).
+- ``lstm_lm``: LSTM language model (reference examples/rnn_utils/lstm.py).
+- ``transformer_lm``: Transformer decoder LM with Linear-layer K-FAC and
+  optional ring-attention sequence parallelism (BASELINE config 4).
 """
 
 from distributed_kfac_pytorch_tpu.models import cifar_resnet
 from distributed_kfac_pytorch_tpu.models import imagenet_resnet
 from distributed_kfac_pytorch_tpu.models import lstm_lm
+from distributed_kfac_pytorch_tpu.models import transformer_lm

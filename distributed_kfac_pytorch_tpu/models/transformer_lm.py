@@ -1,0 +1,128 @@
+"""Transformer decoder language model with Linear-layer K-FAC support.
+
+The BASELINE tracked config 4 ("Transformer-XL-style LM with Linear-layer
+K-FAC") workload. Attention is built from plain ``nn.Dense`` projections —
+not flax's fused ``MultiHeadDotProductAttention`` (whose ``DenseGeneral``
+params are invisible to the K-FAC layer registry, capture.py) — so every
+projection (q/k/v/o) and MLP matmul is preconditioned exactly like the
+reference preconditions LSTM-cell Linears (kfac/layers/linear.py:27-59).
+
+Long contexts: pass ``seq_axis`` to shard the sequence over a mesh axis —
+attention runs as a ring (``parallel.sequence.ring_self_attention``), the
+rest of the network is token-local, and K-FAC factor statistics average
+over the extra axis like any other batch sharding. The reference has no
+analogue (SURVEY.md §5: sequence handling = BPTT truncation only).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_kfac_pytorch_tpu.parallel.sequence import (
+    local_causal_attention,
+    ring_self_attention,
+)
+
+
+class CausalSelfAttention(nn.Module):
+    """Multi-head causal self-attention from four K-FAC-visible Denses."""
+    num_heads: int
+    seq_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        if d_model % self.num_heads:
+            raise ValueError(f'{d_model=} not divisible by '
+                             f'{self.num_heads=}')
+        head_dim = d_model // self.num_heads
+
+        def heads(y):
+            return y.reshape(*y.shape[:-1], self.num_heads, head_dim)
+
+        q = heads(nn.Dense(d_model, name='q_proj')(x))
+        k = heads(nn.Dense(d_model, name='k_proj')(x))
+        v = heads(nn.Dense(d_model, name='v_proj')(x))
+        if self.seq_axis is not None:
+            o = ring_self_attention(q, k, v, axis_name=self.seq_axis)
+        else:
+            o = local_causal_attention(q, k, v)
+        o = o.reshape(*x.shape[:-1], d_model).astype(x.dtype)
+        return nn.Dense(d_model, name='out_proj')(o)
+
+
+class TransformerBlock(nn.Module):
+    """Pre-LN decoder block: LN -> attention -> LN -> GELU MLP."""
+    num_heads: int
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    seq_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        d_model = x.shape[-1]
+        h = CausalSelfAttention(self.num_heads, seq_axis=self.seq_axis,
+                                name='attn')(nn.LayerNorm(name='ln1')(x))
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        x = x + h
+        y = nn.LayerNorm(name='ln2')(x)
+        y = nn.Dense(self.mlp_ratio * d_model, name='mlp_in')(y)
+        y = nn.gelu(y)
+        y = nn.Dense(d_model, name='mlp_out')(y)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        return x + y
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM: embed + learned positions -> blocks -> logits.
+
+    With ``seq_axis`` set, ``ids`` is the device-local contiguous sequence
+    block and ``pos_offset`` must give its global start (device index *
+    local length) so position embeddings line up across the ring.
+    ``tie_weights`` reuses the embedding matrix as the decoder
+    (``Embed.attend``), the flax-native form of the reference's
+    ``register_shared_module`` tied-embedding path
+    (kfac/preconditioner.py:404-470, torch_language_model.py:284-286).
+    """
+    vocab_size: int
+    d_model: int = 512
+    num_layers: int = 6
+    num_heads: int = 8
+    max_len: int = 2048
+    dropout: float = 0.1
+    tie_weights: bool = True
+    seq_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, ids, *, train: bool = True, pos_offset=0):
+        embed = nn.Embed(self.vocab_size, self.d_model, name='embed')
+        x = embed(ids)
+        pos_table = self.param(
+            'pos_embed', nn.initializers.normal(0.02),
+            (self.max_len, self.d_model))
+        pos = pos_offset + jnp.arange(ids.shape[-1])
+        x = x + pos_table[pos]
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        for i in range(self.num_layers):
+            x = TransformerBlock(self.num_heads, dropout=self.dropout,
+                                 seq_axis=self.seq_axis,
+                                 name=f'block{i}')(x, train=train)
+        x = nn.LayerNorm(name='ln_f')(x)
+        if self.tie_weights:
+            return embed.attend(x)
+        return nn.Dense(self.vocab_size, name='decoder')(x)
+
+
+def get_model(vocab_size: int, size: str = 'small',
+              **overrides) -> TransformerLM:
+    """Named configs akin to the reference's model zoo entry points."""
+    configs = {
+        'tiny': dict(d_model=128, num_layers=2, num_heads=4),
+        'small': dict(d_model=512, num_layers=6, num_heads=8),
+        'base': dict(d_model=768, num_layers=12, num_heads=12),
+    }
+    if size not in configs:
+        raise ValueError(f'unknown size {size!r}; have {sorted(configs)}')
+    cfg = {**configs[size], **overrides}
+    return TransformerLM(vocab_size=vocab_size, **cfg)

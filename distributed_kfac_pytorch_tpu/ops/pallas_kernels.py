@@ -121,6 +121,8 @@ def batched_inverse(mats: jax.Array, damping, *, iters: int = 100,
     kernel on CPU).
     """
     n = mats.shape[-1]
+    if damping is None:
+        damping = 0.0  # the Pallas path folds damping into the input
     use_pallas = force_pallas
     if use_pallas is None:
         use_pallas = (jax.default_backend() == 'tpu'

@@ -10,6 +10,11 @@ from distributed_kfac_pytorch_tpu.parallel.distributed import (
     make_kfac_mesh,
     resolve_grad_workers,
 )
+from distributed_kfac_pytorch_tpu.parallel.sequence import (
+    SEQ_AXIS,
+    local_causal_attention,
+    ring_self_attention,
+)
 from distributed_kfac_pytorch_tpu.parallel.placement import (
     WorkerAllocator,
     get_block_boundary,

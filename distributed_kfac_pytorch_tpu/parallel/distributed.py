@@ -47,6 +47,7 @@ from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
@@ -56,9 +57,12 @@ from distributed_kfac_pytorch_tpu.ops import factors as F
 from distributed_kfac_pytorch_tpu.ops import linalg
 from distributed_kfac_pytorch_tpu.ops import pallas_kernels
 from distributed_kfac_pytorch_tpu.parallel.placement import load_balance
+from distributed_kfac_pytorch_tpu.parallel.sequence import SEQ_AXIS
 from distributed_kfac_pytorch_tpu.preconditioner import KFAC, CommMethod
 
-# Mesh axis names. Batch/data parallelism shards over both axes jointly.
+# Mesh axis names. Batch/data parallelism shards over both axes jointly;
+# an optional third SEQ_AXIS ('kfac_sp') shards the sequence dimension for
+# ring-attention context parallelism (parallel.sequence).
 INV_GROUP_AXIS = 'kfac_ig'
 GRAD_WORKER_AXIS = 'kfac_gw'
 KFAC_AXES = (INV_GROUP_AXIS, GRAD_WORKER_AXIS)
@@ -85,21 +89,31 @@ def resolve_grad_workers(size: int, comm_method: CommMethod,
 
 def make_kfac_mesh(devices: Sequence[jax.Device] | None = None, *,
                    comm_method: CommMethod = CommMethod.COMM_OPT,
-                   grad_worker_fraction: float = 0.25) -> Mesh:
-    """Build the ``(n_inv_groups, grad_workers)`` mesh for a strategy.
+                   grad_worker_fraction: float = 0.25,
+                   seq_parallel: int = 1) -> Mesh:
+    """Build the ``(n_inv_groups, grad_workers[, seq])`` mesh.
 
     Contiguous device runs form inverse groups (rows), matching the
     reference's contiguous ``partition_inv_ranks`` (kfac/utils.py:156-159)
     — on a TPU slice, contiguous devices are ICI neighbors, so the
     latency-critical inverse all_gather rides the fastest links.
+
+    ``seq_parallel > 1`` appends a third ``SEQ_AXIS`` of that size as the
+    *innermost* (fastest-varying) axis, so the ring-attention ppermute
+    hops between physically adjacent chips.
     """
-    import numpy as np
     if devices is None:
         devices = jax.devices()
     devices = np.asarray(devices)
-    gw = resolve_grad_workers(devices.size, comm_method,
-                              grad_worker_fraction)
-    return Mesh(devices.reshape(devices.size // gw, gw), KFAC_AXES)
+    if devices.size % seq_parallel:
+        raise ValueError(f'{seq_parallel=} does not divide '
+                         f'{devices.size} devices')
+    dp = devices.size // seq_parallel
+    gw = resolve_grad_workers(dp, comm_method, grad_worker_fraction)
+    if seq_parallel > 1:
+        return Mesh(devices.reshape(dp // gw, gw, seq_parallel),
+                    KFAC_AXES + (SEQ_AXIS,))
+    return Mesh(devices.reshape(dp // gw, gw), KFAC_AXES)
 
 
 # ---------------------------------------------------------------------------
@@ -245,6 +259,13 @@ class DistributedKFAC:
         self.mesh = mesh
         self.n_rows = mesh.shape[INV_GROUP_AXIS]
         self.n_cols = mesh.shape[GRAD_WORKER_AXIS]
+        # Gradient/factor averaging spans every data-bearing axis: the two
+        # K-FAC axes plus the sequence axis when context parallelism is on
+        # (each device then holds a (batch shard, sequence block) tile).
+        self.data_axes = KFAC_AXES + (
+            (SEQ_AXIS,) if SEQ_AXIS in mesh.axis_names else ())
+        self.data_size = int(np.prod([mesh.shape[a]
+                                      for a in self.data_axes]))
         self.assignment = assign_work(
             kfac, params, self.n_rows, self.n_cols,
             distribute_layer_factors=distribute_layer_factors)
@@ -327,11 +348,12 @@ class DistributedKFAC:
         """
         kfac = self.kfac
         alpha = kfac.factor_decay if factor_decay is None else factor_decay
-        g_scale = 1.0 / (self.n_rows * self.n_cols) ** 2
+        g_scale = 1.0 / self.data_size ** 2
         new_factors = {}
         for name in kfac.specs:
-            a_new = jax.lax.pmean(contribs[name]['A'], KFAC_AXES)
-            g_new = g_scale * jax.lax.pmean(contribs[name]['G'], KFAC_AXES)
+            a_new = jax.lax.pmean(contribs[name]['A'], self.data_axes)
+            g_new = g_scale * jax.lax.pmean(contribs[name]['G'],
+                                            self.data_axes)
             old = state['factors'][name]
             new_factors[name] = {
                 'A': F.update_running_avg(a_new.astype(old['A'].dtype),
@@ -608,6 +630,7 @@ class DistributedKFAC:
     # -- full train step builder ---------------------------------------
 
     def build_train_step(self, loss_fn, tx, *, model_args_fn=None,
+                         model_kwargs_fn=None,
                          metrics_fn=None,
                          mutable_cols: Sequence[str] = (),
                          batch_spec: P | None = None,
@@ -628,14 +651,20 @@ class DistributedKFAC:
             gradients.
           model_args_fn: maps a batch pytree to the model's positional
             args; default ``batch[0],`` (i.e. ``(x, y)`` batches).
+          model_kwargs_fn: optional ``batch -> kwargs dict`` evaluated
+            *inside* the shard_map, so it may use ``jax.lax.axis_index``
+            — e.g. a sequence-parallel LM's ``pos_offset`` (the global
+            start of this device's sequence block).
           metrics_fn: optional ``metrics_fn(model_out, batch) -> dict`` of
             scalars, globally averaged and merged into the returned
             metrics (e.g. train accuracy, reference engine.py:81-83).
           mutable_cols: flax variable collections updated in the forward
             pass (e.g. ``('batch_stats',)``); their updates are
             ``pmean``ed (synchronized batch statistics).
-          batch_spec: PartitionSpec of every batch leaf; defaults to
-            batch-dim sharding over both mesh axes.
+          batch_spec: PartitionSpec of every batch leaf (or a pytree of
+            specs matching the batch, e.g. to keep a per-step dropout key
+            replicated while data is sharded); defaults to batch-dim
+            sharding over both K-FAC mesh axes.
           grad_accum_steps: micro-batch count per step. The per-device
             batch shard is split into this many micro-batches processed
             sequentially under ``lax.scan``, averaging gradients and
@@ -669,11 +698,12 @@ class DistributedKFAC:
                 extra = metrics_fn(out, batch) if metrics_fn else {}
                 return loss_fn(out, batch), extra
 
+            kwargs = model_kwargs_fn(batch) if model_kwargs_fn else {}
             loss, extra_metrics, grads, captures, updated = (
                 capture.loss_and_grads(
                     wrapped_loss, params, *model_args_fn(batch),
                     extra_vars=extra_vars, mutable_cols=mutable_cols,
-                    has_aux=True))
+                    has_aux=True, **kwargs))
             return loss, extra_metrics, grads, captures, updated
 
         def accum_fwd_bwd(params, extra_vars, batch, do_factors):
@@ -686,7 +716,15 @@ class DistributedKFAC:
             non-factor-update steps skip the covariance work, like the
             single-pass path's in-cond contraction.
             """
-            def split(x):
+            specs = (jax.tree.map(lambda _: batch_spec, batch)
+                     if isinstance(batch_spec, P) else batch_spec)
+
+            def split(x, spec):
+                if spec == P():
+                    # Fully-replicated per-step leaf (e.g. a dropout PRNG
+                    # key): identical for every micro-batch, not sliced.
+                    return jnp.broadcast_to(x[None],
+                                            (grad_accum_steps,) + x.shape)
                 if x.shape[0] % grad_accum_steps:
                     raise ValueError(
                         f'per-device batch shard of {x.shape[0]} is not '
@@ -695,7 +733,7 @@ class DistributedKFAC:
                                   x.shape[0] // grad_accum_steps)
                                  + x.shape[1:])
 
-            micro = jax.tree.map(split, batch)
+            micro = jax.tree.map(split, batch, specs)
             first = jax.tree.map(lambda x: x[0], micro)
             loss_sh, extras_sh, grads_sh, captures_sh, _ = jax.eval_shape(
                 fwd_bwd, params, extra_vars, first)
@@ -751,10 +789,10 @@ class DistributedKFAC:
                 loss, extra_metrics, grads, contribs, updated = (
                     accum_fwd_bwd(params, extra_vars, batch, do_factors))
                 captures = None
-            grads = jax.lax.pmean(grads, KFAC_AXES)
-            loss = jax.lax.pmean(loss, KFAC_AXES)
+            grads = jax.lax.pmean(grads, self.data_axes)
+            loss = jax.lax.pmean(loss, self.data_axes)
             metrics = {'loss': loss,
-                       **jax.lax.pmean(extra_metrics, KFAC_AXES)}
+                       **jax.lax.pmean(extra_metrics, self.data_axes)}
             precond, kstate = self.spmd_step(
                 kstate, grads, captures, contribs=contribs,
                 damping=hyper['damping'], lr=hyper['lr'],
@@ -766,19 +804,21 @@ class DistributedKFAC:
                                   params, updates)
             if updated:
                 extra_vars = {**extra_vars,
-                              **jax.lax.pmean(updated, KFAC_AXES)}
+                              **jax.lax.pmean(updated, self.data_axes)}
             return params, opt_state, kstate, extra_vars, metrics
 
         def step(params, opt_state, kstate, extra_vars, batch, hyper):
             kspecs = self.state_pspecs(kstate)
             rep = P()
+            batch_specs = (jax.tree.map(lambda _: batch_spec, batch)
+                           if isinstance(batch_spec, P) else batch_spec)
             in_specs = (
                 jax.tree.map(lambda _: rep, params),
                 jax.tree.map(lambda _: rep, opt_state,
                              is_leaf=lambda x: x is None),
                 kspecs,
                 jax.tree.map(lambda _: rep, extra_vars),
-                jax.tree.map(lambda _: batch_spec, batch),
+                batch_specs,
                 jax.tree.map(lambda _: rep, hyper),
             )
             out_specs = (

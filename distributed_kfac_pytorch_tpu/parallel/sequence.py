@@ -1,0 +1,124 @@
+"""Sequence/context parallelism: ring attention over a mesh axis.
+
+The reference has no long-context machinery at all — sequence models are
+handled by BPTT-35 truncation (reference examples/torch_language_model.py:52,
+SURVEY.md §5) because attention/recurrence state never leaves one GPU. On a
+TPU mesh, long contexts are first-class: the sequence dimension is sharded
+over a mesh axis and attention runs as a *ring* — each device keeps its
+query block resident and circulates key/value blocks around the axis via
+``ppermute`` (ICI neighbor exchanges), accumulating softmax online with the
+numerically-stable running-max trick (blockwise/flash attention). Peak
+memory per device is O(T_local^2) for one logits block instead of
+O(T_global^2), and the K/V transfer overlaps with the block matmuls.
+
+``ring_self_attention`` is the in-``shard_map`` building block;
+``local_causal_attention`` is the single-device fallback with identical
+semantics, so models can be written once and run at either scale.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Mesh axis name for sequence/context parallelism.
+SEQ_AXIS = 'kfac_sp'
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, scale, qpos, kpos, causal):
+    """One blockwise attention contribution with positions for masking.
+
+    q: (B, Tq, H, D), k/v: (B, Tk, H, D); qpos/kpos: (Tq,)/(Tk,) global
+    token positions. Returns (scores_max, exp_scores @ v, exp_scores sum)
+    per (B, H, Tq).
+    """
+    logits = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = kpos[None, :] <= qpos[:, None]          # (Tq, Tk)
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    m = jnp.max(logits, axis=-1)                       # (B, H, Tq)
+    p = jnp.exp(logits - m[..., None])
+    if causal:
+        # Fully-masked rows: m == _NEG_INF and p == 1 everywhere; zero them.
+        p = jnp.where((m == _NEG_INF)[..., None], 0.0, p)
+    l = jnp.sum(p, axis=-1)                            # (B, H, Tq)
+    o = jnp.einsum('bhqk,bkhd->bqhd', p, v,
+                   preferred_element_type=jnp.float32)
+    return m, o, l
+
+
+def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        axis_name: str = SEQ_AXIS,
+                        causal: bool = True) -> jax.Array:
+    """Exact attention over the sequence sharded on ``axis_name``.
+
+    Call inside ``shard_map``; ``q``/``k``/``v`` are this device's
+    contiguous sequence block, shape (B, T_local, H, D) — device ``i``
+    holds global tokens ``[i*T_local, (i+1)*T_local)``. K/V blocks rotate
+    around the ring (``ppermute`` to the next axis index) while the local
+    O/M/L accumulators fold each block in with the online-softmax update;
+    after ``axis_size`` steps every query has attended to every key.
+    Returns (B, T_local, H, D) in fp32.
+    """
+    s = jax.lax.psum(1, axis_name)          # axis size (static under SPMD)
+    idx = jax.lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    q = q.astype(jnp.float32)
+    local_pos = jnp.arange(t)
+    qpos = idx * t + local_pos
+
+    perm = [(i, (i + 1) % s) for i in range(s)]
+
+    def fold_block(step, o, m, l, k_cur, v_cur):
+        """Online-softmax accumulation of the currently-held K/V block."""
+        # After `step` rotations we hold the block of device (idx - step).
+        src = (idx - step) % s
+        kpos = src * t + local_pos
+        bm, bo, bl = _block_attend(q, k_cur.astype(jnp.float32),
+                                   v_cur.astype(jnp.float32),
+                                   scale, qpos, kpos, causal)
+        new_m = jnp.maximum(m, bm)
+        corr_old = jnp.exp(m - new_m)
+        corr_new = jnp.exp(bm - new_m)
+        # exp of (-inf) - (-inf) is NaN; fully-masked contributions carry
+        # m == _NEG_INF (finite sentinel), so corr stays finite.
+        l = l * corr_old + bl * corr_new
+        o = (o * jnp.moveaxis(corr_old, 1, 2)[..., None]
+             + bo * jnp.moveaxis(corr_new, 1, 2)[..., None])
+        return o, new_m, l
+
+    def body(step, carry):
+        o, m, l, k_cur, v_cur = carry
+        o, m, l = fold_block(step, o, m, l, k_cur, v_cur)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return o, m, l, k_nxt, v_nxt
+
+    o0 = jnp.zeros((b, t, h, d), jnp.float32)
+    m0 = jnp.full((b, h, t), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    # The last block-attend is peeled out of the loop so the final
+    # (discarded) K/V rotation is never issued: s-1 ppermutes, s folds.
+    o, m, l, k_last, v_last = jax.lax.fori_loop(
+        0, s - 1, body, (o0, m0, l0, k, v))
+    o, m, l = fold_block(s - 1, o, m, l, k_last, v_last)
+    l = jnp.maximum(jnp.moveaxis(l, 1, 2)[..., None], 1e-30)
+    return o / l
+
+
+def local_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                           *, causal: bool = True) -> jax.Array:
+    """Single-device attention with the same contract as the ring path."""
+    b, t, h, d = q.shape
+    pos = jnp.arange(t)
+    m, o, l = _block_attend(q.astype(jnp.float32), k.astype(jnp.float32),
+                            v.astype(jnp.float32), 1.0 / (d ** 0.5),
+                            pos, pos, causal)
+    l = jnp.maximum(jnp.moveaxis(l, 1, 2)[..., None], 1e-30)
+    return o / l

@@ -169,14 +169,21 @@ class KFAC:
     # Registration / state init
     # ------------------------------------------------------------------
 
-    def init(self, rng, *args, **kwargs):
+    def init(self, rng, *args, init_model: nn.Module | None = None,
+             **kwargs):
         """Init model variables and K-FAC state in one pass.
 
         Returns ``(variables, kfac_state)``; layer registration (the
         analogue of reference register_model, preconditioner.py:355-402)
-        happens as a side effect of tracing the model.
+        happens as a side effect of tracing the model. ``init_model``
+        substitutes a structurally-identical single-device twin for the
+        registration trace (see KFACCapture.init) — used by
+        sequence-parallel models whose ring collectives only trace inside
+        ``shard_map``.
         """
-        variables, specs = self.capture.init(rng, *args, **kwargs)
+        variables, specs = self.capture.init(rng, *args,
+                                             init_model=init_model,
+                                             **kwargs)
         self._specs = specs
         if self.verbose:
             for name, spec in specs.items():

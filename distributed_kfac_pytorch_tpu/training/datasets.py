@@ -213,11 +213,11 @@ def bptt_batches(ids: np.ndarray, batch_size: int, bptt: int, *,
     consecutive chunks of the same epoch.
     """
     n = ids.shape[0]
-    track = (n - 1) // batch_size
     off = 0
-    if shuffle_offset and track > bptt:
+    if shuffle_offset and (n - 1) // batch_size > bptt:
         off = int(np.random.default_rng(
             np.random.SeedSequence([seed, epoch])).integers(0, bptt))
+    track = (n - 1 - off) // batch_size
     x = ids[off:off + batch_size * track].reshape(batch_size, track)
     t = ids[off + 1:off + 1 + batch_size * track].reshape(batch_size,
                                                           track)

@@ -70,21 +70,23 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
 
 
 def make_eval_step(model, loss_fn, mesh=None, *,
-                   model_args_fn=None, metrics_fn=None):
+                   model_args_fn=None, model_kwargs=None, metrics_fn=None):
     """Jitted eval step: global-mean loss/accuracy over the mesh.
 
     Reference parity: engine.py:96-125 (test loop). With a mesh, the batch
     is sharded over the K-FAC axes and metrics are ``pmean``ed; without,
-    it is a plain jitted forward.
+    it is a plain jitted forward. ``model_kwargs`` are static keyword
+    arguments for the model call (e.g. ``{'train': False}``).
     """
     if model_args_fn is None:
         model_args_fn = lambda batch: (batch[0],)
     if metrics_fn is None:
         metrics_fn = lambda out, batch: {'acc': accuracy(out, batch[1])}
+    model_kwargs = model_kwargs or {}
 
     def compute(params, extra_vars, batch):
         out = model.apply({'params': params, **extra_vars},
-                          *model_args_fn(batch))
+                          *model_args_fn(batch), **model_kwargs)
         metrics = {'loss': loss_fn(out, batch), **metrics_fn(out, batch)}
         if mesh is not None:
             metrics = jax.lax.pmean(metrics, KFAC_AXES)

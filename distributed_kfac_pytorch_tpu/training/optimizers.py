@@ -79,9 +79,18 @@ def make_sgd(cfg: OptimConfig) -> optax.GradientTransformation:
 
 
 def set_lr(opt_state, lr):
-    """Return opt_state with the injected learning rate replaced."""
-    opt_state.hyperparams['learning_rate'] = lr
-    return opt_state
+    """Return opt_state with the injected learning rate replaced.
+
+    Accepts the bare ``inject_hyperparams`` state or a ``chain`` state
+    containing one (e.g. when gradient clipping is chained in front).
+    """
+    states = (opt_state,) if hasattr(opt_state, 'hyperparams') else (
+        opt_state if isinstance(opt_state, tuple) else ())
+    for s in states:
+        if hasattr(s, 'hyperparams'):
+            s.hyperparams['learning_rate'] = lr
+            return opt_state
+    raise ValueError('no injected learning_rate in optimizer state')
 
 
 def get_optimizer(model, cfg: OptimConfig):

@@ -1,0 +1,253 @@
+"""Language-model training with distributed K-FAC: LSTM or Transformer.
+
+Working TPU-native counterpart of the reference's WIP LM entry point
+(examples/torch_language_model.py — broken as shipped: SURVEY.md §8 notes
+the lr and factory-unpacking bugs at :253,:277). Two architectures:
+
+- ``--arch lstm``: the K-FAC-friendly LSTM LM (reference rnn_utils/lstm.py
+  + kfac/modules/lstm.py), BPTT windows (``--bptt 35``,
+  torch_language_model.py:52), K-FAC on the LSTM-cell Linears with
+  embedding/decoder skipped by default (torch_language_model.py:102-104).
+  Hidden state is reset per window (the reference carries it detached;
+  with windows shuffled per epoch the difference is negligible).
+- ``--arch transformer``: decoder-only Transformer with Linear-layer
+  K-FAC on every projection (BASELINE config 4), and optional
+  ``--seq-parallel N`` ring-attention context parallelism over the mesh
+  (no reference analogue — SURVEY.md §5: long-context machinery absent).
+
+Data: whitespace-tokenized train.txt/valid.txt under --data-dir
+(PTB/WikiText layout), else a synthetic Markov corpus (offline default).
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+from jax.sharding import PartitionSpec as P
+
+from distributed_kfac_pytorch_tpu.models import lstm_lm, transformer_lm
+from distributed_kfac_pytorch_tpu.parallel import distributed as D
+from distributed_kfac_pytorch_tpu.parallel import sequence as seq
+from distributed_kfac_pytorch_tpu.training import (
+    checkpoint as ckpt_lib,
+    datasets,
+    engine,
+    optimizers,
+)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description='LM + distributed K-FAC (TPU-native)')
+    p.add_argument('--data-dir', default=None,
+                   help='dir with train.txt/valid.txt (synthetic if '
+                        'absent)')
+    p.add_argument('--log-dir', default='./logs/lm')
+    p.add_argument('--checkpoint-dir', default='./checkpoints/lm')
+    p.add_argument('--checkpoint-freq', type=int, default=5)
+    p.add_argument('--arch', default='lstm',
+                   choices=['lstm', 'transformer'])
+    # Model size (reference torch_language_model.py:41-50).
+    p.add_argument('--emsize', type=int, default=650)
+    p.add_argument('--nhid', type=int, default=650)
+    p.add_argument('--nlayers', type=int, default=2)
+    p.add_argument('--nheads', type=int, default=10,
+                   help='attention heads (transformer)')
+    p.add_argument('--dropout', type=float, default=0.5)
+    p.add_argument('--tied', action='store_true')
+    p.add_argument('--bptt', type=int, default=35,
+                   help='sequence window (reference :52)')
+    p.add_argument('--batch-size', type=int, default=20)
+    p.add_argument('--epochs', type=int, default=40)
+    p.add_argument('--base-lr', type=float, default=1.0)
+    p.add_argument('--lr-decay', type=int, nargs='+', default=[20, 30])
+    p.add_argument('--warmup-epochs', type=float, default=1)
+    p.add_argument('--momentum', type=float, default=0.9)
+    p.add_argument('--wd', type=float, default=0.0)
+    p.add_argument('--grad-clip', type=float, default=0.25,
+                   help='global-norm clip (reference :205)')
+    p.add_argument('--seed', type=int, default=42)
+    p.add_argument('--no-resume', action='store_true')
+    p.add_argument('--seq-parallel', type=int, default=1,
+                   help='sequence-parallel degree (transformer only)')
+    # K-FAC (reference torch_language_model.py:74-104).
+    p.add_argument('--kfac-update-freq', type=int, default=10,
+                   help='inverse update interval; 0 disables K-FAC')
+    p.add_argument('--kfac-cov-update-freq', type=int, default=1)
+    p.add_argument('--inverse-method', default='eigen',
+                   choices=['eigen', 'cholesky', 'newton'])
+    p.add_argument('--stat-decay', type=float, default=0.95)
+    p.add_argument('--damping', type=float, default=0.003)
+    p.add_argument('--kl-clip', type=float, default=0.001)
+    p.add_argument('--skip-layers', nargs='+', default=None,
+                   help="default: ['embed', 'decoder'] for lstm (the "
+                        'reference preconditions LSTM cells only), [] '
+                        'for transformer')
+    p.add_argument('--comm-method', default='comm-opt',
+                   choices=sorted(optimizers.COMM_METHODS))
+    p.add_argument('--grad-worker-fraction', type=float, default=0.25)
+    return p.parse_args(argv)
+
+
+def build_model(args, vocab_size, seq_axis=None):
+    if args.arch == 'lstm':
+        return lstm_lm.LSTMLanguageModel(
+            vocab_size=vocab_size, embedding_dim=args.emsize,
+            hidden_dim=args.nhid, num_layers=args.nlayers,
+            dropout=args.dropout, tie_weights=args.tied)
+    return transformer_lm.TransformerLM(
+        vocab_size=vocab_size, d_model=args.emsize,
+        num_layers=args.nlayers, num_heads=args.nheads,
+        max_len=max(args.bptt, 16), dropout=args.dropout,
+        tie_weights=args.tied, seq_axis=seq_axis)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    n_dev = jax.device_count()
+    sp = args.seq_parallel
+    if sp > 1 and args.arch != 'transformer':
+        raise SystemExit('--seq-parallel requires --arch transformer')
+    print(f'devices: {n_dev} ({jax.default_backend()}), seq_parallel={sp}')
+
+    train_ids, val_ids, vocab_size = datasets.get_lm_corpus(args.data_dir)
+    print(f'corpus: {len(train_ids)} train / {len(val_ids)} val tokens, '
+          f'vocab {vocab_size}')
+
+    if args.skip_layers is None:
+        args.skip_layers = (['embed', 'decoder'] if args.arch == 'lstm'
+                            else [])
+
+    seq_axis = seq.SEQ_AXIS if sp > 1 else None
+    model = build_model(args, vocab_size, seq_axis=seq_axis)
+
+    cfg = optimizers.OptimConfig(
+        base_lr=args.base_lr, momentum=args.momentum,
+        weight_decay=args.wd, warmup_epochs=args.warmup_epochs,
+        lr_decay=args.lr_decay, workers=1,
+        kfac_inv_update_freq=args.kfac_update_freq,
+        kfac_cov_update_freq=args.kfac_cov_update_freq,
+        damping=args.damping, factor_decay=args.stat_decay,
+        kl_clip=args.kl_clip, inverse_method=args.inverse_method,
+        skip_layers=args.skip_layers, comm_method=args.comm_method,
+        grad_worker_fraction=args.grad_worker_fraction)
+    tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(model, cfg)
+    if kfac is None:
+        raise SystemExit('use --kfac-update-freq >= 1')
+    if args.grad_clip:
+        tx = optax.chain(optax.clip_by_global_norm(args.grad_clip), tx)
+
+    ids0 = jnp.zeros((2, args.bptt), jnp.int32)
+    twin = (build_model(args, vocab_size, seq_axis=None)
+            if seq_axis else None)
+    variables, _ = kfac.init(jax.random.PRNGKey(args.seed), ids0,
+                             train=False, init_model=twin)
+    params = variables['params']
+
+    mesh = D.make_kfac_mesh(
+        comm_method=optimizers.COMM_METHODS[args.comm_method],
+        grad_worker_fraction=args.grad_worker_fraction, seq_parallel=sp)
+    dkfac = D.DistributedKFAC(kfac, mesh, params)
+    kstate = dkfac.init_state(params)
+    opt_state = tx.init(params)
+
+    def logits_of(out):
+        return out[0] if args.arch == 'lstm' else out
+
+    def loss_fn(out, batch):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits_of(out), batch[1]).mean()
+
+    t_local = args.bptt // sp
+
+    def model_kwargs_fn(batch):
+        # Per-device dropout key: fold the step key with the device's
+        # linear mesh index so masks decorrelate across shards.
+        idx = jax.lax.axis_index(D.INV_GROUP_AXIS)
+        for ax in dkfac.data_axes[1:]:
+            idx = idx * jax.lax.psum(1, ax) + jax.lax.axis_index(ax)
+        kwargs = {'train': True,
+                  'rngs': {'dropout': jax.random.fold_in(batch[2], idx)}}
+        if seq_axis:
+            kwargs['pos_offset'] = (
+                jax.lax.axis_index(seq.SEQ_AXIS) * t_local)
+        return kwargs
+
+    data_spec = (P(D.KFAC_AXES, seq.SEQ_AXIS) if seq_axis
+                 else P(D.KFAC_AXES))
+    step_fn = dkfac.build_train_step(
+        loss_fn, tx, model_kwargs_fn=model_kwargs_fn,
+        batch_spec=(data_spec, data_spec, P()))
+
+    def eval_loss(out, batch):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits_of(out), batch[1]).mean()
+
+    eval_step = engine.make_eval_step(
+        build_model(args, vocab_size, seq_axis=None), eval_loss, None,
+        model_args_fn=lambda b: (b[0],), model_kwargs={'train': False},
+        metrics_fn=lambda o, b: {})
+
+    state = engine.TrainState(params=params, opt_state=opt_state,
+                              kfac_state=kstate, extra_vars={})
+    mgr = ckpt_lib.CheckpointManager(args.checkpoint_dir)
+    start_epoch = 0
+    if not args.no_resume and mgr.latest_epoch() is not None:
+        like = ckpt_lib.bundle_state(
+            state.params, state.opt_state, dkfac.state_dict(kstate), {})
+        restored = mgr.restore(like=like)
+        state.params = restored['params']
+        state.opt_state = restored['opt_state']
+        state.kfac_state = dkfac.load_state_dict(restored['kfac'], params)
+        start_epoch = mgr.latest_epoch() + 1
+        state.epoch = start_epoch
+        kfac_sched.step(start_epoch)
+        print(f'resumed from epoch {mgr.latest_epoch()}')
+
+    def batches(epoch):
+        root = jax.random.PRNGKey(args.seed * 1000 + epoch)
+        for i, (x, y) in enumerate(datasets.bptt_batches(
+                train_ids, args.batch_size, args.bptt,
+                shuffle_offset=True, seed=args.seed, epoch=epoch)):
+            yield x, y, jax.random.fold_in(root, i)
+
+    writer = engine.TensorBoardWriter(args.log_dir)
+    t_start = time.perf_counter()
+    for epoch in range(start_epoch, args.epochs):
+        lr = lr_schedule(epoch)
+        state.opt_state = optimizers.set_lr(state.opt_state, lr)
+        hyper = {'lr': lr, **kfac_sched.params()}
+        train_m = engine.train_epoch(step_fn, state, batches(epoch),
+                                     hyper, log_writer=writer,
+                                     verbose=True)
+        val_m = engine.evaluate(
+            eval_step, state,
+            datasets.bptt_batches(val_ids, args.batch_size, args.bptt),
+            log_writer=writer, verbose=True)
+        print(f'epoch {epoch}: train ppl '
+              f'{math.exp(min(train_m["loss"], 20)):.2f}, val ppl '
+              f'{math.exp(min(val_m["loss"], 20)):.2f}')
+        kfac_sched.step(epoch + 1)
+        if (epoch + 1) % args.checkpoint_freq == 0 or \
+                epoch == args.epochs - 1:
+            mgr.save(epoch, ckpt_lib.bundle_state(
+                state.params, state.opt_state,
+                dkfac.state_dict(state.kfac_state), {},
+                schedulers={'kfac': kfac_sched}))
+    writer.flush()
+    print(f'total: {time.perf_counter() - t_start:.1f}s')
+
+
+if __name__ == '__main__':
+    main()
