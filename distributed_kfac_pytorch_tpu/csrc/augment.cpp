@@ -1,0 +1,79 @@
+// Native host-side batch augmentation for the input pipeline.
+//
+// The training-loop host work the reference delegates to torchvision's
+// C-backed transforms (examples/cnn_utils/datasets.py:14-17) — here a
+// single C++ kernel: reflect-pad + random crop + horizontal flip over a
+// whole NHWC float32 batch, threaded across images. Randomness stays in
+// numpy (the caller passes per-image offsets/flips), so results are
+// bit-identical to the pure-numpy fallback in training/datasets.py.
+//
+// Build: see distributed_kfac_pytorch_tpu/native.py (g++ -O3 -shared).
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// np.pad 'reflect' index semantics: mirror without repeating the edge.
+inline int reflect(int idx, int n) {
+  while (idx < 0 || idx >= n) {
+    if (idx < 0) idx = -idx;
+    if (idx >= n) idx = 2 * n - 2 - idx;
+  }
+  return idx;
+}
+
+void augment_range(const float* x, float* out, int begin, int end, int h,
+                   int w, int c, const int32_t* ys, const int32_t* xs,
+                   const uint8_t* flip, int pad) {
+  const size_t img = static_cast<size_t>(h) * w * c;
+  for (int i = begin; i < end; ++i) {
+    const float* src = x + i * img;
+    float* dst = out + i * img;
+    const int oy = ys[i] - pad;  // crop origin in unpadded coords
+    const int ox = xs[i] - pad;
+    const bool fl = flip[i] != 0;
+    for (int r = 0; r < h; ++r) {
+      const int sr = reflect(oy + r, h);
+      const float* srow = src + static_cast<size_t>(sr) * w * c;
+      float* drow = dst + static_cast<size_t>(r) * w * c;
+      for (int col = 0; col < w; ++col) {
+        const int sc = reflect(ox + (fl ? w - 1 - col : col), w);
+        std::memcpy(drow + static_cast<size_t>(col) * c,
+                    srow + static_cast<size_t>(sc) * c,
+                    sizeof(float) * c);
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// x, out: (n, h, w, c) float32 NHWC. ys/xs: crop offsets in the padded
+// image, in [0, 2*pad]. flip: 0/1 per image.
+void augment_batch(const float* x, float* out, int n, int h, int w, int c,
+                   const int32_t* ys, const int32_t* xs,
+                   const uint8_t* flip, int pad, int n_threads) {
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > n) n_threads = n;
+  if (n_threads == 1) {
+    augment_range(x, out, 0, n, h, w, c, ys, xs, flip, pad);
+    return;
+  }
+  std::vector<std::thread> workers;
+  const int chunk = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    const int begin = t * chunk;
+    const int end = begin + chunk < n ? begin + chunk : n;
+    if (begin >= end) break;
+    workers.emplace_back(augment_range, x, out, begin, end, h, w, c, ys,
+                         xs, flip, pad);
+  }
+  for (auto& th : workers) th.join();
+}
+
+}  // extern "C"

@@ -1,0 +1,106 @@
+"""Native (C++) host-runtime components, loaded via ctypes.
+
+The TPU compute path is JAX/XLA/Pallas; the host-side runtime work around
+it — here the input-pipeline augmentation that the reference delegates to
+torchvision's C transforms (examples/cnn_utils/datasets.py:14-17) — is
+native C++ (csrc/), compiled on first use with the local toolchain and
+bound through ctypes (no build-time dependency). Every native entry point
+has a pure-numpy fallback with identical semantics, used when no C++
+toolchain is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(__file__), 'csrc')
+_LIB_PATH = os.path.join(_CSRC, 'libkfac_native.so')
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _build() -> bool:
+    src = os.path.join(_CSRC, 'augment.cpp')
+    # Compile to a per-process temp file and rename atomically: concurrent
+    # first-use builds (every rank of a multi-host job on a shared
+    # filesystem) then each produce a complete library, and dlopen never
+    # sees a partially-written file.
+    tmp = f'{_LIB_PATH}.{os.getpid()}.tmp'
+    cmd = ['g++', '-O3', '-shared', '-fPIC', '-std=c++17', '-pthread',
+           src, '-o', tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB_PATH)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def get_lib():
+    """The loaded native library, or None (build failure is sticky)."""
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH) or (
+                os.path.getmtime(_LIB_PATH)
+                < os.path.getmtime(os.path.join(_CSRC, 'augment.cpp'))):
+            if not _build():
+                _lib_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.augment_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int, ctypes.c_int]
+            lib.augment_batch.restype = None
+            _lib = lib
+        except OSError:
+            _lib_failed = True
+        return _lib
+
+
+def augment_batch(x: np.ndarray, ys: np.ndarray, xs: np.ndarray,
+                  flip: np.ndarray, pad: int = 4,
+                  n_threads: int | None = None) -> np.ndarray | None:
+    """Reflect-pad + crop + flip a float32 NHWC batch natively.
+
+    ``ys``/``xs`` are crop offsets into the padded image (in [0, 2*pad]),
+    ``flip`` a 0/1 byte per image — the caller draws them (numpy RNG), so
+    native and fallback paths are bit-identical. Returns None when the
+    native library is unavailable (caller falls back to numpy).
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, np.float32)
+    n, h, w, c = x.shape
+    out = np.empty_like(x)
+    ys = np.ascontiguousarray(ys, np.int32)
+    xs = np.ascontiguousarray(xs, np.int32)
+    flip = np.ascontiguousarray(flip, np.uint8)
+    if n_threads is None:
+        n_threads = min(8, os.cpu_count() or 1)
+    fptr = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    lib.augment_batch(
+        fptr(x), fptr(out), n, h, w, c,
+        ys.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        xs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        flip.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        pad, n_threads)
+    return out

@@ -229,13 +229,23 @@ def bptt_batches(ids: np.ndarray, batch_size: int, bptt: int, *,
 
 
 def augment_cifar(x: np.ndarray, rng: np.random.Generator) -> np.ndarray:
-    """Pad-4 random crop + horizontal flip (reference datasets.py:14-17)."""
+    """Pad-4 random crop + horizontal flip (reference datasets.py:14-17).
+
+    Random draws happen here (numpy), then the per-pixel work runs in the
+    native C++ kernel (``native.augment_batch``, threaded) when the
+    toolchain built it, else in the numpy fallback — both bit-identical.
+    """
+    from distributed_kfac_pytorch_tpu import native
+
     n, h, w, c = x.shape
+    ys = rng.integers(0, 9, size=n).astype(np.int32)
+    xs = rng.integers(0, 9, size=n).astype(np.int32)
+    flip = (rng.random(n) < 0.5).astype(np.uint8)
+    out = native.augment_batch(x, ys, xs, flip, pad=4)
+    if out is not None:
+        return out
     padded = np.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)), mode='reflect')
     out = np.empty_like(x)
-    ys = rng.integers(0, 9, size=n)
-    xs = rng.integers(0, 9, size=n)
-    flip = rng.random(n) < 0.5
     for i in range(n):
         img = padded[i, ys[i]:ys[i] + h, xs[i]:xs[i] + w]
         out[i] = img[:, ::-1] if flip[i] else img

@@ -69,6 +69,130 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
     return out
 
 
+def _replicated_specs(tree):
+    """P() for every leaf (None leaves included) — shard_map boilerplate."""
+    from jax.sharding import PartitionSpec as P
+    return jax.tree.map(lambda _: P(), tree, is_leaf=lambda x: x is None)
+
+
+def build_sgd_train_step(model, loss_fn, tx, mesh=None, *,
+                         model_args_fn=None, metrics_fn=None,
+                         mutable_cols=(), batch_spec=None,
+                         grad_accum_steps: int = 1,
+                         donate: bool = True):
+    """Plain data-parallel first-order train step (no K-FAC).
+
+    The ``--kfac-update-freq 0`` path: the reference's examples fall back
+    to bare SGD when K-FAC is disabled (cnn_utils/optimizers.py:28), so
+    the same CLI flag must produce a working first-order baseline here.
+    Signature matches ``DistributedKFAC.build_train_step``'s output
+    (the ``kfac_state`` slot is threaded through untouched) so
+    ``train_epoch`` works with either; ``grad_accum_steps`` splits the
+    per-device shard into micro-batches with carry-summed gradients,
+    keeping batch semantics identical to the K-FAC step it is compared
+    against.
+
+    The batch is sharded over the K-FAC data axes (same default as
+    ``DistributedKFAC.build_train_step``); extra mesh axes are still
+    averaged over so the step stays correct on any ``make_kfac_mesh``.
+    """
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_kfac_pytorch_tpu.parallel.distributed import KFAC_AXES
+
+    if model_args_fn is None:
+        model_args_fn = lambda batch: (batch[0],)
+    mutable_cols = tuple(mutable_cols)
+    data_axes = tuple(mesh.axis_names) if mesh is not None else ()
+    if batch_spec is None and mesh is not None:
+        batch_spec = P(tuple(a for a in KFAC_AXES
+                             if a in mesh.axis_names) or data_axes)
+    if grad_accum_steps < 1:
+        raise ValueError(f'{grad_accum_steps=} must be >= 1')
+
+    def fwd_bwd(params, extra_vars, batch):
+        def wrapped(params):
+            out = model.apply({'params': params, **extra_vars},
+                              *model_args_fn(batch),
+                              mutable=list(mutable_cols) or False)
+            out, updated = out if mutable_cols else (out, {})
+            extra = metrics_fn(out, batch) if metrics_fn else {}
+            return loss_fn(out, batch), (extra, dict(updated))
+
+        (loss, (extra_metrics, updated)), grads = jax.value_and_grad(
+            wrapped, has_aux=True)(params)
+        return loss, extra_metrics, updated, grads
+
+    def local_step(params, opt_state, kstate, extra_vars, batch, hyper):
+        if grad_accum_steps == 1:
+            loss, extra_metrics, updated, grads = fwd_bwd(
+                params, extra_vars, batch)
+        else:
+            micro = jax.tree.map(
+                lambda x: x.reshape((grad_accum_steps,
+                                     x.shape[0] // grad_accum_steps)
+                                    + x.shape[1:]), batch)
+            first = jax.tree.map(lambda x: x[0], micro)
+            shapes = jax.eval_shape(fwd_bwd, params, extra_vars, first)
+            zeros = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype),
+                (shapes[0], shapes[1], shapes[3]))
+
+            def body(carry, mb):
+                extra_c, (loss_s, extras_s, grads_s) = carry
+                loss, extra_metrics, updated, grads = fwd_bwd(
+                    params, extra_c, mb)
+                new_extra = ({**extra_c, **updated} if updated
+                             else extra_c)
+                sums = jax.tree.map(jnp.add,
+                                    (loss_s, extras_s, grads_s),
+                                    (loss, extra_metrics, grads))
+                return (new_extra, sums), None
+
+            (extra_out, sums), _ = jax.lax.scan(
+                body, (extra_vars, zeros), micro)
+            inv_n = 1.0 / grad_accum_steps
+            loss, extra_metrics, grads = jax.tree.map(
+                lambda x: x * inv_n, sums)
+            updated = {c: extra_out[c] for c in mutable_cols
+                       if c in extra_out}
+        if data_axes:
+            grads = jax.lax.pmean(grads, data_axes)
+            loss = jax.lax.pmean(loss, data_axes)
+            extra_metrics = jax.lax.pmean(extra_metrics, data_axes)
+            if updated:
+                updated = jax.lax.pmean(updated, data_axes)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        if updated:
+            extra_vars = {**extra_vars, **updated}
+        metrics = {'loss': loss, **extra_metrics}
+        return params, opt_state, kstate, extra_vars, metrics
+
+    if mesh is None:
+        return jax.jit(local_step,
+                       donate_argnums=(0, 1, 3) if donate else ())
+
+    def step(params, opt_state, kstate, extra_vars, batch, hyper):
+        fn = jax.shard_map(
+            local_step, mesh=mesh,
+            in_specs=(_replicated_specs(params),
+                      _replicated_specs(opt_state),
+                      _replicated_specs(kstate),
+                      _replicated_specs(extra_vars),
+                      jax.tree.map(lambda _: batch_spec, batch),
+                      _replicated_specs(hyper)),
+            out_specs=(_replicated_specs(params),
+                       _replicated_specs(opt_state),
+                       _replicated_specs(kstate),
+                       _replicated_specs(extra_vars), P()),
+            check_vma=False)
+        return fn(params, opt_state, kstate, extra_vars, batch, hyper)
+
+    return jax.jit(step, donate_argnums=(0, 1, 3) if donate else ())
+
+
 def make_eval_step(model, loss_fn, mesh=None, *,
                    model_args_fn=None, model_kwargs=None, metrics_fn=None):
     """Jitted eval step: global-mean loss/accuracy over the mesh.
@@ -96,15 +220,14 @@ def make_eval_step(model, loss_fn, mesh=None, *,
         return jax.jit(compute)
 
     from jax.sharding import PartitionSpec as P
-    rep = P()
 
     def step(params, extra_vars, batch):
         return jax.shard_map(
             compute, mesh=mesh,
-            in_specs=(jax.tree.map(lambda _: rep, params),
-                      jax.tree.map(lambda _: rep, extra_vars),
+            in_specs=(_replicated_specs(params),
+                      _replicated_specs(extra_vars),
                       jax.tree.map(lambda _: P(KFAC_AXES), batch)),
-            out_specs=rep, check_vma=False)(params, extra_vars, batch)
+            out_specs=P(), check_vma=False)(params, extra_vars, batch)
 
     return jax.jit(step)
 

@@ -102,20 +102,18 @@ def main(argv=None):
         kfac_update_freq_alpha=args.kfac_update_freq_alpha,
         kfac_update_freq_schedule=args.kfac_update_freq_decay)
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(model, cfg)
-    if kfac is None:
-        raise SystemExit('SGD-only path: use --kfac-update-freq >= 1 '
-                         '(K-FAC is the point of this example)')
 
     x0 = jnp.zeros((2, 32, 32, 3), jnp.float32)
-    variables, _ = kfac.init(jax.random.PRNGKey(args.seed), x0)
+    if kfac is not None:
+        variables, _ = kfac.init(jax.random.PRNGKey(args.seed), x0)
+    else:
+        variables = model.init(jax.random.PRNGKey(args.seed), x0)
     params = variables['params']
     extra = {'batch_stats': variables['batch_stats']}
 
     mesh = D.make_kfac_mesh(
         comm_method=optimizers.COMM_METHODS[args.comm_method],
         grad_worker_fraction=args.grad_worker_fraction)
-    dkfac = D.DistributedKFAC(kfac, mesh, params)
-    kstate = dkfac.init_state(params)
     opt_state = tx.init(params)
 
     def loss_fn(out, batch):
@@ -125,29 +123,53 @@ def main(argv=None):
     def metrics_fn(out, batch):
         return {'acc': utils.accuracy(out, batch[1])}
 
-    step_fn = dkfac.build_train_step(
-        loss_fn, tx, metrics_fn=metrics_fn, mutable_cols=('batch_stats',))
+    if kfac is not None:
+        dkfac = D.DistributedKFAC(kfac, mesh, params)
+        kstate = dkfac.init_state(params)
+        step_fn = dkfac.build_train_step(
+            loss_fn, tx, metrics_fn=metrics_fn,
+            mutable_cols=('batch_stats',))
+    else:  # --kfac-update-freq 0: plain SGD (reference optimizers.py:28)
+        dkfac, kstate = None, None
+        step_fn = engine.build_sgd_train_step(
+            model, loss_fn, tx, mesh, metrics_fn=metrics_fn,
+            mutable_cols=('batch_stats',))
     eval_step = engine.make_eval_step(
         model, lambda out, b: utils.label_smooth_loss(out, b[1], 0.0),
         mesh, model_args_fn=lambda b: (b[0], False))
 
     state = engine.TrainState(params=params, opt_state=opt_state,
                               kfac_state=kstate, extra_vars=extra)
+    if dkfac is None and args.checkpoint_dir == './checkpoints/cifar10':
+        # Keep the SGD comparison's checkpoints apart from a K-FAC run's
+        # (the state trees differ, so cross-mode resume cannot work).
+        args.checkpoint_dir += '-sgd'
     mgr = ckpt_lib.CheckpointManager(args.checkpoint_dir)
     start_epoch = 0
     if not args.no_resume and mgr.latest_epoch() is not None:
         like = ckpt_lib.bundle_state(
-            state.params, state.opt_state, dkfac.state_dict(kstate),
+            state.params, state.opt_state,
+            dkfac.state_dict(kstate) if dkfac else {},
             state.extra_vars)
-        restored = mgr.restore(like=like)
+        try:
+            restored = mgr.restore(like=like)
+        except Exception as e:
+            raise SystemExit(
+                f'cannot resume from {args.checkpoint_dir}: {e}\n'
+                'The checkpoint was likely written with a different '
+                'K-FAC configuration — pass --no-resume or a fresh '
+                '--checkpoint-dir.')
         state.params = restored['params']
         state.opt_state = restored['opt_state']
-        state.kfac_state = dkfac.load_state_dict(restored['kfac'], params)
+        if dkfac:
+            state.kfac_state = dkfac.load_state_dict(restored['kfac'],
+                                                     params)
         state.extra_vars = restored['extra_vars']
         start_epoch = mgr.latest_epoch() + 1
         state.epoch = start_epoch
         state.step = int(restored['scalars'].get('step', 0))
-        kfac_sched.step(start_epoch)
+        if kfac_sched:
+            kfac_sched.step(start_epoch)
         print(f'resumed from epoch {mgr.latest_epoch()}')
 
     writer = engine.TensorBoardWriter(args.log_dir)
@@ -155,7 +177,8 @@ def main(argv=None):
     for epoch in range(start_epoch, args.epochs):
         lr = lr_schedule(epoch)
         state.opt_state = optimizers.set_lr(state.opt_state, lr)
-        hyper = {'lr': lr, **kfac_sched.params()}
+        hyper = {'lr': lr,
+                 **(kfac_sched.params() if kfac_sched else {})}
         batches = datasets.epoch_batches(
             train_x, train_y, args.batch_size, seed=args.seed,
             epoch=epoch, augment=True)
@@ -166,13 +189,16 @@ def main(argv=None):
             augment=False)
         engine.evaluate(eval_step, state, val_batches,
                         log_writer=writer, verbose=True)
-        kfac_sched.step(epoch + 1)
+        if kfac_sched:
+            kfac_sched.step(epoch + 1)
         if (epoch + 1) % args.checkpoint_freq == 0 or \
                 epoch == args.epochs - 1:
             mgr.save(epoch, ckpt_lib.bundle_state(
                 state.params, state.opt_state,
-                dkfac.state_dict(state.kfac_state), state.extra_vars,
-                schedulers={'kfac': kfac_sched}, step=state.step))
+                dkfac.state_dict(state.kfac_state) if dkfac else {},
+                state.extra_vars,
+                schedulers={'kfac': kfac_sched} if kfac_sched else None,
+                step=state.step))
     writer.flush()
     print(f'total: {time.perf_counter() - t_start:.1f}s')
 

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Stage the ImageNet tree to node-local fast storage before training.
+#
+# Reference parity: scripts/cp_imagenet_to_temp.sh (untars ImageNet to
+# /tmp on every node so the input pipeline reads local disk instead of
+# the shared filesystem). TPU-VM equivalent: stage to the local SSD (or
+# a ramdisk) on every worker; the tf.data pipeline in
+# training/datasets.py then reads local JPEGs.
+#
+# Usage: ./scripts/cp_imagenet_to_local.sh /shared/imagenet /tmp/imagenet
+set -euo pipefail
+
+SRC=${1:?source imagenet dir (train/ + val/)}
+DST=${2:-/tmp/imagenet}
+
+mkdir -p "${DST}"
+for split in train val; do
+  if [ -f "${SRC}/${split}.tar" ]; then
+    echo "untarring ${split}.tar -> ${DST}/${split}"
+    mkdir -p "${DST}/${split}"
+    tar -xf "${SRC}/${split}.tar" -C "${DST}/${split}"
+  elif [ -d "${SRC}/${split}" ]; then
+    echo "copying ${split}/ -> ${DST}/${split}"
+    cp -r --no-clobber "${SRC}/${split}" "${DST}/"
+  else
+    echo "missing ${SRC}/${split}(.tar)" >&2
+    exit 1
+  fi
+done
+echo "staged to ${DST}; pass --data-dir ${DST}"

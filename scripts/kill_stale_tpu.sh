@@ -1,0 +1,17 @@
+#!/bin/bash
+# Kill stale training processes holding the TPU on every pod worker.
+#
+# Reference parity: scripts/kill_python_process.sh (clears hung CUDA
+# processes cluster-wide). A crashed JAX process can keep libtpu locked
+# (/tmp/libtpu_lockfile), making the next launch fail with "TPU in use".
+#
+# Usage: ./scripts/kill_stale_tpu.sh <tpu-name> <zone>
+set -euo pipefail
+
+TPU_NAME=${1:?tpu name}
+ZONE=${2:?zone}
+
+gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone "${ZONE}" \
+  --worker=all \
+  --command='pkill -9 -f "python.*train_" || true; \
+             rm -f /tmp/libtpu_lockfile || true'
