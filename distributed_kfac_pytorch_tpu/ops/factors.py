@@ -160,6 +160,54 @@ def embedding_a_factor(ids: jax.Array, vocab_size: int) -> jax.Array:
     return counts / ids.shape[0]
 
 
+def pack_symmetric(m: jax.Array) -> jax.Array:
+    """Pack a symmetric (n, n) matrix into ~half the elements, gather-free.
+
+    Rectangular-full-packed-style layout built purely from
+    triu/tril/slice/concat (no gather/scatter — XLA:CPU miscompiles
+    gathers inside large shard_map programs, and on TPU masked dense ops
+    vectorize better anyway): with ``k = ceil(n/2)`` (n padded to even),
+    the strictly-lower zeros of the top ``k x n`` band of ``triu(m)``
+    are filled with the transposed strict-lower content of the bottom
+    ``k x k`` triangle, and the bottom block's diagonal rides in one
+    extra row. Output shape ``(k + 1, n_pad)`` — about ``n^2/2 + n``
+    elements on the wire instead of ``n^2``.
+    """
+    n = m.shape[-1]
+    n_pad = n + (n % 2)
+    if n_pad != n:
+        m = jnp.pad(m, ((0, 1), (0, 1)))
+    k = n_pad // 2
+    u = jnp.triu(m)
+    top = u[:k, :]                        # (k, n_pad)
+    low = u[k:, k:]                       # (k, k) upper triangular
+    # The strictly-lower slots of top[:, :k] are zero in triu(m); adding
+    # the bottom triangle's strict-lower transpose fills them losslessly.
+    top = top + jnp.concatenate(
+        [jnp.tril(low.T, -1), jnp.zeros((k, n_pad - k), m.dtype)], axis=1)
+    diag_low = jnp.sum(low * jnp.eye(k, dtype=m.dtype), axis=1)
+    extra = jnp.concatenate(
+        [diag_low, jnp.zeros((n_pad - k,), m.dtype)])[None, :]
+    return jnp.concatenate([top, extra], axis=0)
+
+
+def unpack_symmetric(packed: jax.Array, n: int) -> jax.Array:
+    """Inverse of :func:`pack_symmetric` (gather-free)."""
+    n_pad = packed.shape[-1]
+    k = n_pad // 2
+    top = packed[:k]
+    diag_low = packed[k, :k]
+    fill = jnp.tril(top[:, :k], -1)       # strict-lower of bottom block^T
+    low = fill.T + diag_low[:, None] * jnp.eye(k, dtype=packed.dtype)
+    u_top = jnp.concatenate([jnp.triu(top[:, :k]), top[:, k:]], axis=1)
+    u_bot = jnp.concatenate([jnp.zeros((k, k), packed.dtype), low],
+                            axis=1)
+    u = jnp.concatenate([u_top, u_bot], axis=0)
+    diag = jnp.sum(u * jnp.eye(n_pad, dtype=packed.dtype), axis=1)
+    full = u + u.T - diag[:, None] * jnp.eye(n_pad, dtype=packed.dtype)
+    return full[:n, :n]
+
+
 def get_triu(x: jax.Array) -> jax.Array:
     """Flatten the upper triangle of a symmetric 2-D tensor.
 

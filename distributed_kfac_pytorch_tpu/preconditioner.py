@@ -101,6 +101,11 @@ class KFAC:
         always *computed* in fp32, reference base.py:432-441).
       skip_layers: module names/classes to skip (case-insensitive, prunes
         subtrees).
+      symmetry_aware_comm: communicate only the upper triangle of the
+        (symmetric) factor matrices — n(n+1)/2 instead of n^2 elements
+        per allreduce (reference kfac/layers/base.py:120-125). Worth it
+        when factor averaging crosses hosts (DCN-bound); on-chip the
+        pack/unpack gather usually costs more than the halved bytes.
       assignment_strategy: 'compute' (n^3 cost) or 'memory' (n^2) for the
         LPT work balancer (reference preconditioner.py:625-628).
       comm_method / grad_worker_fraction: see CommMethod; consumed by the
@@ -120,6 +125,7 @@ class KFAC:
                  factor_dtype: Any = None,
                  inv_dtype: Any = jnp.float32,
                  skip_layers: str | Sequence[str] | None = None,
+                 symmetry_aware_comm: bool = False,
                  assignment_strategy: str = 'compute',
                  comm_method: CommMethod = CommMethod.COMM_OPT,
                  grad_worker_fraction: float = 0.25,
@@ -159,6 +165,7 @@ class KFAC:
         self.newton_iters = newton_iters
         self.factor_dtype = factor_dtype
         self.inv_dtype = inv_dtype
+        self.symmetry_aware_comm = symmetry_aware_comm
         self.assignment_strategy = assignment_strategy
         self.comm_method = comm_method
         self.grad_worker_fraction = grad_worker_fraction

@@ -129,10 +129,26 @@ def build_sgd_train_step(model, loss_fn, tx, mesh=None, *,
             loss, extra_metrics, updated, grads = fwd_bwd(
                 params, extra_vars, batch)
         else:
-            micro = jax.tree.map(
-                lambda x: x.reshape((grad_accum_steps,
-                                     x.shape[0] // grad_accum_steps)
-                                    + x.shape[1:]), batch)
+            from jax.sharding import PartitionSpec as P
+            specs = (jax.tree.map(lambda _: batch_spec, batch)
+                     if batch_spec is None or isinstance(batch_spec, P)
+                     else batch_spec)
+
+            def split(x, spec):
+                if spec == P():
+                    # Replicated per-step leaf (e.g. a PRNG key):
+                    # broadcast, not sliced (same as the K-FAC step).
+                    return jnp.broadcast_to(
+                        x[None], (grad_accum_steps,) + x.shape)
+                if x.shape[0] % grad_accum_steps:
+                    raise ValueError(
+                        f'per-device batch shard of {x.shape[0]} is not '
+                        f'divisible by {grad_accum_steps=}')
+                return x.reshape((grad_accum_steps,
+                                  x.shape[0] // grad_accum_steps)
+                                 + x.shape[1:])
+
+            micro = jax.tree.map(split, batch, specs)
             first = jax.tree.map(lambda x: x[0], micro)
             shapes = jax.eval_shape(fwd_bwd, params, extra_vars, first)
             zeros = jax.tree.map(

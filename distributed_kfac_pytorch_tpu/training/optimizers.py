@@ -49,6 +49,7 @@ class OptimConfig:
     use_eigen_decomp: bool | None = None  # None: follow inverse_method
     inverse_method: str | None = None     # 'eigen' | 'cholesky' | 'newton'
     skip_layers: Sequence[str] = ()
+    symmetry_aware_comm: bool = False
     comm_method: str = 'comm-opt'
     grad_worker_fraction: float = 0.25
     damping_alpha: float = 1.0
@@ -121,6 +122,7 @@ def get_optimizer(model, cfg: OptimConfig):
             use_eigen_decomp=cfg.use_eigen_decomp,
             inverse_method=cfg.inverse_method,
             skip_layers=list(cfg.skip_layers) or None,
+            symmetry_aware_comm=cfg.symmetry_aware_comm,
             comm_method=COMM_METHODS[cfg.comm_method.lower()],
             grad_worker_fraction=cfg.grad_worker_fraction)
         kfac_scheduler = KFACParamScheduler(

@@ -82,6 +82,8 @@ def parse_args(argv=None):
     p.add_argument('--comm-method', default='comm-opt',
                    choices=sorted(optimizers.COMM_METHODS))
     p.add_argument('--grad-worker-fraction', type=float, default=0.25)
+    p.add_argument('--symmetry-aware-comm', action='store_true',
+                   help='triu-packed factor allreduce (halved bytes)')
     p.add_argument('--bf16-factors', action='store_true',
                    help='store/communicate factors in bfloat16 '
                         '(decompositions stay fp32)')
@@ -122,6 +124,7 @@ def main(argv=None):
         kl_clip=args.kl_clip, inverse_method=args.inverse_method,
         skip_layers=args.skip_layers, comm_method=args.comm_method,
         grad_worker_fraction=args.grad_worker_fraction,
+        symmetry_aware_comm=args.symmetry_aware_comm,
         damping_alpha=args.damping_alpha,
         damping_schedule=args.damping_decay,
         kfac_update_freq_alpha=args.kfac_update_freq_alpha,

@@ -97,6 +97,8 @@ def parse_args(argv=None):
     p.add_argument('--comm-method', default='comm-opt',
                    choices=sorted(optimizers.COMM_METHODS))
     p.add_argument('--grad-worker-fraction', type=float, default=0.25)
+    p.add_argument('--symmetry-aware-comm', action='store_true',
+                   help='triu-packed factor allreduce (halved bytes)')
     return p.parse_args(argv)
 
 
@@ -141,7 +143,8 @@ def main(argv=None):
         damping=args.damping, factor_decay=args.stat_decay,
         kl_clip=args.kl_clip, inverse_method=args.inverse_method,
         skip_layers=args.skip_layers, comm_method=args.comm_method,
-        grad_worker_fraction=args.grad_worker_fraction)
+        grad_worker_fraction=args.grad_worker_fraction,
+        symmetry_aware_comm=args.symmetry_aware_comm)
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(model, cfg)
     if kfac is None:
         raise SystemExit('use --kfac-update-freq >= 1')

@@ -13,5 +13,5 @@ ZONE=${2:?zone}
 
 gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone "${ZONE}" \
   --worker=all \
-  --command='pkill -9 -f "python.*train_" || true; \
+  --command='pkill -9 -f "[p]ython.*train_" || true; \
              rm -f /tmp/libtpu_lockfile || true'
