@@ -34,6 +34,118 @@ def get_eigendecomp(x: jax.Array, clip: float | None = 0.0
     return q, d
 
 
+def _round_robin_schedule(n: int):
+    """Tournament pairings: (n-1) rounds of n/2 disjoint pairs covering
+    every index pair exactly once (circle method, index 0 fixed)."""
+    import numpy as np
+    assert n % 2 == 0
+    others = list(range(1, n))
+    rounds = []
+    for _ in range(n - 1):
+        arr = [0] + others
+        pairs = [(min(arr[i], arr[n - 1 - i]), max(arr[i], arr[n - 1 - i]))
+                 for i in range(n // 2)]
+        rounds.append(pairs)
+        others = others[1:] + others[:1]
+    return np.asarray(rounds)  # (n-1, n/2, 2)
+
+
+def jacobi_eigh(x: jax.Array, sweeps: int = 12
+                ) -> tuple[jax.Array, jax.Array]:
+    """Symmetric eigendecomposition by vectorized cyclic Jacobi rotations.
+
+    One sweep = n-1 tournament rounds; each round applies n/2 *disjoint*
+    Givens rotations simultaneously (vector ops over the pair index, no
+    per-rotation loop), so the whole solver is ~2(n-1)·sweeps dense-row
+    updates — the classic parallel-Jacobi formulation that maps onto
+    wide vector units, and the basis for a VMEM-resident Pallas variant.
+    Accuracy: off-diagonal mass contracts quadratically once small;
+    ``sweeps=12`` reaches fp32 roundoff for n <= ~512.
+
+    Returns ``(Q, d)`` with eigenvalues ascending (same convention as
+    :func:`get_eigendecomp`). Pure JAX, vmap-friendly.
+    """
+    n = x.shape[-1]
+    x = x.astype(jnp.float32)
+    if n == 1:
+        return jnp.ones((1, 1), jnp.float32), x.reshape(1)
+    n_pad = n + (n % 2)
+    a = x
+    if n_pad != n:
+        # Pad with a decoupled unit eigenvalue; stripped after sorting.
+        a = jnp.pad(x, ((0, 1), (0, 1)))
+        a = a.at[n, n].set(1.0)
+    schedule = jnp.asarray(_round_robin_schedule(n_pad))  # (R, P, 2)
+    v0 = jnp.eye(n_pad, dtype=jnp.float32)
+
+    def rotate_rows(m, p, q, c, s):
+        """rows[p] <- c*rows[p] - s*rows[q]; rows[q] <- s*rows[p] + c*rows[q]."""
+        mp = m[p, :]
+        mq = m[q, :]
+        return m.at[p, :].set(c[:, None] * mp - s[:, None] * mq) \
+                .at[q, :].set(s[:, None] * mp + c[:, None] * mq)
+
+    def round_step(carry, pairs):
+        a, v = carry
+        p, q = pairs[:, 0], pairs[:, 1]
+        app = a[p, p]
+        aqq = a[q, q]
+        apq = a[p, q]
+        # Rotation zeroing A[p,q]: guard tiny pivots (t -> 0, identity).
+        small = jnp.abs(apq) <= 1e-30
+        tau = (aqq - app) / jnp.where(small, 1.0, 2.0 * apq)
+        # sign(0) must be +1 here: tau=0 (equal diagonal) needs the full
+        # 45-degree rotation, not the identity.
+        sgn = jnp.where(tau >= 0, 1.0, -1.0)
+        t = sgn / (jnp.abs(tau) + jnp.sqrt(1.0 + tau * tau))
+        t = jnp.where(small, 0.0, t)
+        c = 1.0 / jnp.sqrt(1.0 + t * t)
+        s = t * c
+        a = rotate_rows(a, p, q, c, s)          # J^T A
+        a = rotate_rows(a.T, p, q, c, s).T      # (J^T A) J
+        v = rotate_rows(v.T, p, q, c, s).T      # accumulate Q = J_1 J_2 ...
+        return (a, v), None
+
+    def sweep(carry, _):
+        carry, _ = jax.lax.scan(round_step, carry, schedule)
+        return carry, None
+
+    (a, v), _ = jax.lax.scan(sweep, (a, v0), None, length=sweeps)
+    d = jnp.diagonal(a)
+    order = jnp.argsort(d)
+    d = d[order]
+    v = v[:, order]
+    if n_pad != n:
+        # Drop the padding eigenpair: its eigenvector is exactly e_n.
+        keep = v[n, :] < 0.5
+        # Static-shape removal: positions of kept columns among first n.
+        v = jnp.take(v[:n, :], jnp.nonzero(keep, size=n)[0], axis=1)
+        d = jnp.take(d, jnp.nonzero(keep, size=n)[0])
+    return v, d
+
+
+def batched_eigh(stack: jax.Array, method: str = 'xla',
+                 clip: float | None = 0.0
+                 ) -> tuple[jax.Array, jax.Array]:
+    """Eigendecompose a (B, n, n) SPD stack: ``(Q, d)`` ascending.
+
+    ``method='xla'`` vmaps the backend eigh; ``'jacobi'`` vmaps
+    :func:`jacobi_eigh` (parallel cyclic Jacobi — an alternative whose
+    inner loop is pure vector ops, the shape a Pallas VMEM-resident
+    kernel wants). Single dispatch point for the bucketed eigen paths in
+    ``preconditioner`` and ``parallel.distributed``.
+    """
+    if method == 'jacobi':
+        qs, ds = jax.vmap(jacobi_eigh)(stack.astype(jnp.float32))
+        if clip is not None:
+            ds = jnp.maximum(ds, clip)
+        return qs, ds
+    if method != 'xla':
+        raise ValueError(f"eigh method must be 'xla' or 'jacobi', "
+                         f'got {method!r}')
+    return jax.vmap(lambda m: get_eigendecomp(m, clip=clip))(stack)
+
+
 def get_inverse(x: jax.Array, damping: float | jax.Array | None = None
                 ) -> jax.Array:
     """Damped SPD inverse via Cholesky: ``(x + damping*I)^-1`` in fp32.

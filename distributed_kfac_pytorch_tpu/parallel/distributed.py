@@ -351,11 +351,12 @@ class DistributedKFAC:
         g_scale = 1.0 / self.data_size ** 2
 
         def factor_pmean(m):
-            """pmean of a symmetric factor; triu-packed when enabled.
+            """pmean of a symmetric factor; triangular-packed if enabled.
 
             Reference symmetry_aware_comm (kfac/layers/base.py:120-125):
-            halves the bytes on the wire at the cost of a pack/unpack
-            gather. Embedding A factors are 1-D (already minimal).
+            halves the bytes on the wire at the cost of the gather-free
+            mask/concat pack+unpack (ops.factors.pack_symmetric).
+            Embedding A factors are 1-D (already minimal).
             """
             if kfac.symmetry_aware_comm and m.ndim == 2:
                 packed = jax.lax.pmean(F.pack_symmetric(m),
@@ -409,8 +410,8 @@ class DistributedKFAC:
                 full, (row * plan.slots_per_row + col * s, 0, 0),
                 (s, dim, dim))
             if kfac.use_eigen_decomp:
-                q, d = jax.vmap(
-                    lambda m: linalg.get_eigendecomp(m, clip=0.0))(local)
+                q, d = linalg.batched_eigh(local, kfac.eigh_method,
+                                           clip=0.0)
                 q = jax.lax.all_gather(
                     q, GRAD_WORKER_AXIS, tiled=True)
                 d = jax.lax.all_gather(

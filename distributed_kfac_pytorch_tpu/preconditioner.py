@@ -92,6 +92,9 @@ class KFAC:
         non-eigen method) or 'newton' (matmul-only Newton–Schulz, Pallas
         VMEM-resident on TPU — see ops.pallas_kernels). Defaults to
         'eigen'/'cholesky' per ``use_eigen_decomp``.
+      eigh_method: backend for the eigen path's decompositions: 'xla'
+        (the backend eigh) or 'jacobi' (vectorized parallel cyclic
+        Jacobi, ops.linalg.jacobi_eigh).
       newton_iters: iteration cap for 'newton' (the loop exits early on
         a 1e-5 residual; ~log2(cond)+6 iterations are used in practice).
       factor_dtype: dtype for factor running averages (default fp32; pass
@@ -101,11 +104,12 @@ class KFAC:
         always *computed* in fp32, reference base.py:432-441).
       skip_layers: module names/classes to skip (case-insensitive, prunes
         subtrees).
-      symmetry_aware_comm: communicate only the upper triangle of the
-        (symmetric) factor matrices — n(n+1)/2 instead of n^2 elements
-        per allreduce (reference kfac/layers/base.py:120-125). Worth it
-        when factor averaging crosses hosts (DCN-bound); on-chip the
-        pack/unpack gather usually costs more than the halved bytes.
+      symmetry_aware_comm: communicate only ~half of each (symmetric)
+        factor matrix — a gather-free rectangular triangular packing
+        (ops.factors.pack_symmetric) before the allreduce (reference
+        kfac/layers/base.py:120-125). Worth it when factor averaging
+        crosses hosts (DCN-bound); on-chip the pack/unpack mask-and-
+        concat work usually costs more than the halved bytes.
       assignment_strategy: 'compute' (n^3 cost) or 'memory' (n^2) for the
         LPT work balancer (reference preconditioner.py:625-628).
       comm_method / grad_worker_fraction: see CommMethod; consumed by the
@@ -121,6 +125,7 @@ class KFAC:
                  lr: float = 0.1,
                  use_eigen_decomp: bool | None = None,
                  inverse_method: str | None = None,
+                 eigh_method: str = 'xla',
                  newton_iters: int = 100,
                  factor_dtype: Any = None,
                  inv_dtype: Any = jnp.float32,
@@ -160,8 +165,12 @@ class KFAC:
             raise ValueError(
                 f'{use_eigen_decomp=} contradicts {inverse_method=}; '
                 'set one or the other')
+        if eigh_method not in ('xla', 'jacobi'):
+            raise ValueError(f"eigh_method must be 'xla' or 'jacobi', "
+                             f'got {eigh_method!r}')
         self.inverse_method = inverse_method
         self.use_eigen_decomp = inverse_method == 'eigen'
+        self.eigh_method = eigh_method
         self.newton_iters = newton_iters
         self.factor_dtype = factor_dtype
         self.inv_dtype = inv_dtype
@@ -318,8 +327,7 @@ class KFAC:
         """
         out: dict[str, tuple[jax.Array, jax.Array]] = {}
         for names, stack in _size_buckets(mats):
-            qs, ds = jax.vmap(
-                lambda m: linalg.get_eigendecomp(m, clip=0.0))(stack)
+            qs, ds = linalg.batched_eigh(stack, self.eigh_method, clip=0.0)
             for i, n in enumerate(names):
                 out[n] = (qs[i], ds[i])
         return out

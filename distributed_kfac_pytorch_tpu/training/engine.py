@@ -191,13 +191,15 @@ def build_sgd_train_step(model, loss_fn, tx, mesh=None, *,
                        donate_argnums=(0, 1, 3) if donate else ())
 
     def step(params, opt_state, kstate, extra_vars, batch, hyper):
+        batch_specs = (jax.tree.map(lambda _: batch_spec, batch)
+                       if isinstance(batch_spec, P) else batch_spec)
         fn = jax.shard_map(
             local_step, mesh=mesh,
             in_specs=(_replicated_specs(params),
                       _replicated_specs(opt_state),
                       _replicated_specs(kstate),
                       _replicated_specs(extra_vars),
-                      jax.tree.map(lambda _: batch_spec, batch),
+                      batch_specs,
                       _replicated_specs(hyper)),
             out_specs=(_replicated_specs(params),
                        _replicated_specs(opt_state),

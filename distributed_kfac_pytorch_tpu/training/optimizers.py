@@ -48,6 +48,7 @@ class OptimConfig:
     kl_clip: float = 0.001
     use_eigen_decomp: bool | None = None  # None: follow inverse_method
     inverse_method: str | None = None     # 'eigen' | 'cholesky' | 'newton'
+    eigh_method: str = 'xla'              # 'xla' | 'jacobi'
     skip_layers: Sequence[str] = ()
     symmetry_aware_comm: bool = False
     comm_method: str = 'comm-opt'
@@ -121,6 +122,7 @@ def get_optimizer(model, cfg: OptimConfig):
             lr=cfg.base_lr,
             use_eigen_decomp=cfg.use_eigen_decomp,
             inverse_method=cfg.inverse_method,
+            eigh_method=cfg.eigh_method,
             skip_layers=list(cfg.skip_layers) or None,
             symmetry_aware_comm=cfg.symmetry_aware_comm,
             comm_method=COMM_METHODS[cfg.comm_method.lower()],

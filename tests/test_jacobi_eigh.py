@@ -1,0 +1,86 @@
+"""Parallel cyclic Jacobi eigensolver vs dense oracles.
+
+The reference never unit-tested its decompositions (SURVEY.md §4); here
+every eigh backend is pinned against the fp64 numpy oracle, and the full
+K-FAC eigen path is checked to be backend-independent.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_kfac_pytorch_tpu import KFAC
+from distributed_kfac_pytorch_tpu.ops import linalg
+
+
+@pytest.mark.parametrize('n', [2, 5, 16, 33, 130])
+def test_jacobi_eigh_matches_numpy(n):
+    a = np.random.RandomState(n).randn(n, n).astype(np.float32)
+    m = a @ a.T / n
+    q, d = linalg.jacobi_eigh(jnp.asarray(m))
+    q, d = np.asarray(q), np.asarray(d)
+    ref = np.linalg.eigvalsh(m.astype(np.float64))
+    scale = max(1.0, np.abs(ref).max())
+    assert np.abs(np.sort(d) - ref).max() / scale < 5e-5
+    assert (d[:-1] <= d[1:] + 1e-6).all()           # ascending
+    assert np.abs(q.T @ q - np.eye(n)).max() < 5e-5  # orthogonal
+    assert np.abs(q @ np.diag(d) @ q.T - m).max() / scale < 5e-5
+
+
+def test_batched_eigh_backends_agree():
+    rng = np.random.RandomState(0)
+    stack = []
+    for _ in range(3):
+        a = rng.randn(12, 12).astype(np.float32)
+        stack.append(a @ a.T / 12)
+    stack = jnp.asarray(np.stack(stack))
+    qx, dx = linalg.batched_eigh(stack, 'xla', clip=0.0)
+    qj, dj = linalg.batched_eigh(stack, 'jacobi', clip=0.0)
+    np.testing.assert_allclose(np.asarray(dj), np.asarray(dx),
+                               rtol=1e-4, atol=1e-5)
+    # Eigenvectors agree up to sign.
+    for b in range(3):
+        dots = np.abs(np.sum(np.asarray(qx[b]) * np.asarray(qj[b]),
+                             axis=0))
+        np.testing.assert_allclose(dots, 1.0, atol=1e-3)
+
+
+def test_kfac_eigen_path_backend_independent():
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(nn.relu(nn.Dense(10)(x)))
+
+    x = jnp.asarray(np.random.RandomState(1).randn(8, 7), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(2).randint(0, 4, 8))
+
+    def run(method):
+        model = MLP()
+        kfac = KFAC(model, factor_update_freq=1, inv_update_freq=1,
+                    damping=0.01, eigh_method=method)
+        variables, state = kfac.init(jax.random.PRNGKey(0), x)
+
+        def loss_fn(out):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                out, y).mean()
+
+        _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+            loss_fn, variables['params'], x)
+        precond, _ = kfac.step(state, grads, captures)
+        return precond
+
+    a = jax.tree.leaves(run('xla'))
+    b = jax.tree.leaves(run('jacobi'))
+    for u, v in zip(a, b):
+        np.testing.assert_allclose(np.asarray(u), np.asarray(v),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_eigh_method_validation():
+    import flax.linen as nn
+    with pytest.raises(ValueError):
+        KFAC(nn.Dense(2), eigh_method='qr')
