@@ -50,7 +50,7 @@ def _round_robin_schedule(n: int):
     return np.asarray(rounds)  # (n-1, n/2, 2)
 
 
-def jacobi_eigh(x: jax.Array, sweeps: int = 12
+def jacobi_eigh(x: jax.Array, sweeps: int | None = None
                 ) -> tuple[jax.Array, jax.Array]:
     """Symmetric eigendecomposition by vectorized cyclic Jacobi rotations.
 
@@ -60,13 +60,16 @@ def jacobi_eigh(x: jax.Array, sweeps: int = 12
     updates — the classic parallel-Jacobi formulation that maps onto
     wide vector units, and the basis for a VMEM-resident Pallas variant.
     Accuracy: off-diagonal mass contracts quadratically once small;
-    ``sweeps=12`` reaches fp32 roundoff for n <= ~512.
+    12 sweeps reach fp32 roundoff for n <= ~512, and the default scales
+    the count up with log2(n) beyond that.
 
     Returns ``(Q, d)`` with eigenvalues ascending (same convention as
     :func:`get_eigendecomp`). Pure JAX, vmap-friendly.
     """
     n = x.shape[-1]
     x = x.astype(jnp.float32)
+    if sweeps is None:
+        sweeps = 12 if n <= 512 else 12 + max(0, (n - 1).bit_length() - 9)
     if n == 1:
         return jnp.ones((1, 1), jnp.float32), x.reshape(1)
     n_pad = n + (n % 2)
@@ -119,13 +122,15 @@ def jacobi_eigh(x: jax.Array, sweeps: int = 12
         # Drop the padding eigenpair: its eigenvector is exactly e_n.
         keep = v[n, :] < 0.5
         # Static-shape removal: positions of kept columns among first n.
-        v = jnp.take(v[:n, :], jnp.nonzero(keep, size=n)[0], axis=1)
-        d = jnp.take(d, jnp.nonzero(keep, size=n)[0])
+        idx = jnp.nonzero(keep, size=n)[0]
+        v = jnp.take(v[:n, :], idx, axis=1)
+        d = jnp.take(d, idx)
     return v, d
 
 
 def batched_eigh(stack: jax.Array, method: str = 'xla',
-                 clip: float | None = 0.0
+                 clip: float | None = 0.0,
+                 sweeps: int | None = None
                  ) -> tuple[jax.Array, jax.Array]:
     """Eigendecompose a (B, n, n) SPD stack: ``(Q, d)`` ascending.
 
@@ -136,7 +141,8 @@ def batched_eigh(stack: jax.Array, method: str = 'xla',
     ``preconditioner`` and ``parallel.distributed``.
     """
     if method == 'jacobi':
-        qs, ds = jax.vmap(jacobi_eigh)(stack.astype(jnp.float32))
+        qs, ds = jax.vmap(
+            lambda m: jacobi_eigh(m, sweeps))(stack.astype(jnp.float32))
         if clip is not None:
             ds = jnp.maximum(ds, clip)
         return qs, ds

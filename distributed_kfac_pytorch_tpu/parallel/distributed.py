@@ -116,6 +116,19 @@ def make_kfac_mesh(devices: Sequence[jax.Device] | None = None, *,
     return Mesh(devices.reshape(dp // gw, gw), KFAC_AXES)
 
 
+def normalize_batch_specs(batch_spec, batch):
+    """Per-leaf PartitionSpec tree for a batch pytree.
+
+    A single ``PartitionSpec`` (or None) is broadcast over every leaf; a
+    pytree of specs matching ``batch`` passes through unchanged. Single
+    point of truth for every train-step builder that accepts
+    ``batch_spec``.
+    """
+    if batch_spec is None or isinstance(batch_spec, P):
+        return jax.tree.map(lambda _: batch_spec, batch)
+    return batch_spec
+
+
 # ---------------------------------------------------------------------------
 # Host-side static work assignment
 # ---------------------------------------------------------------------------
@@ -730,8 +743,7 @@ class DistributedKFAC:
             non-factor-update steps skip the covariance work, like the
             single-pass path's in-cond contraction.
             """
-            specs = (jax.tree.map(lambda _: batch_spec, batch)
-                     if isinstance(batch_spec, P) else batch_spec)
+            specs = normalize_batch_specs(batch_spec, batch)
 
             def split(x, spec):
                 if spec == P():
@@ -824,8 +836,7 @@ class DistributedKFAC:
         def step(params, opt_state, kstate, extra_vars, batch, hyper):
             kspecs = self.state_pspecs(kstate)
             rep = P()
-            batch_specs = (jax.tree.map(lambda _: batch_spec, batch)
-                           if isinstance(batch_spec, P) else batch_spec)
+            batch_specs = normalize_batch_specs(batch_spec, batch)
             in_specs = (
                 jax.tree.map(lambda _: rep, params),
                 jax.tree.map(lambda _: rep, opt_state,

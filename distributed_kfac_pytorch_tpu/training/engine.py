@@ -130,9 +130,10 @@ def build_sgd_train_step(model, loss_fn, tx, mesh=None, *,
                 params, extra_vars, batch)
         else:
             from jax.sharding import PartitionSpec as P
-            specs = (jax.tree.map(lambda _: batch_spec, batch)
-                     if batch_spec is None or isinstance(batch_spec, P)
-                     else batch_spec)
+
+            from distributed_kfac_pytorch_tpu.parallel.distributed import (
+                normalize_batch_specs)
+            specs = normalize_batch_specs(batch_spec, batch)
 
             def split(x, spec):
                 if spec == P():
@@ -191,8 +192,9 @@ def build_sgd_train_step(model, loss_fn, tx, mesh=None, *,
                        donate_argnums=(0, 1, 3) if donate else ())
 
     def step(params, opt_state, kstate, extra_vars, batch, hyper):
-        batch_specs = (jax.tree.map(lambda _: batch_spec, batch)
-                       if isinstance(batch_spec, P) else batch_spec)
+        from distributed_kfac_pytorch_tpu.parallel.distributed import (
+            normalize_batch_specs)
+        batch_specs = normalize_batch_specs(batch_spec, batch)
         fn = jax.shard_map(
             local_step, mesh=mesh,
             in_specs=(_replicated_specs(params),
