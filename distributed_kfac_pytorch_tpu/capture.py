@@ -255,12 +255,19 @@ class KFACCapture:
 
     def loss_and_grads(self, loss_fn: Callable, params, *args,
                        probes=None, extra_vars=None, mutable_cols=(),
-                       has_aux=False, **kwargs):
+                       has_aux=False, loss_scale=None, **kwargs):
         """One backward pass yielding param grads AND per-layer captures.
 
         ``loss_fn`` receives the model output only — close over labels and
         any other data: ``lambda out: cross_entropy(out, labels)``. With
         ``has_aux=True`` it returns ``(loss, aux)``.
+
+        ``loss_scale`` multiplies the loss before differentiation and
+        divides the gradients and output-grad captures after — the fp16
+        loss-scaling hook (the analogue of the reference's GradScaler
+        unscaling at hook time, kfac/layers/base.py:374-375,397-407).
+        Identity in fp32/bf16; on TPU bf16 needs no scaling, so the
+        default is None.
 
         ``extra_vars`` are non-differentiated collections passed to apply
         (e.g. ``{'batch_stats': ...}``); collections listed in
@@ -281,11 +288,18 @@ class KFACCapture:
                 mutable_cols=mutable_cols, **kwargs)
             res = loss_fn(out)
             loss, aux = res if has_aux else (res, None)
+            if loss_scale is not None:
+                loss = loss * loss_scale
             return loss, (aux, acts, updated)
 
         (loss, (aux, acts, updated)), (grads, probe_grads) = (
             jax.value_and_grad(wrapped, argnums=(0, 1), has_aux=True)(
                 params, probes))
+        if loss_scale is not None:
+            inv = 1.0 / loss_scale
+            loss = loss * inv
+            grads = jax.tree.map(lambda g: g * inv, grads)
+            probe_grads = jax.tree.map(lambda g: g * inv, probe_grads)
         captures = self.collect(acts, probe_grads)
         return loss, aux, grads, captures, updated
 

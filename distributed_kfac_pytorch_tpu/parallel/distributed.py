@@ -662,7 +662,8 @@ class DistributedKFAC:
                          mutable_cols: Sequence[str] = (),
                          batch_spec: P | None = None,
                          donate: bool = True,
-                         grad_accum_steps: int = 1):
+                         grad_accum_steps: int = 1,
+                         loss_scale=None):
         """Jitted data-parallel train step with distributed K-FAC.
 
         The functional analogue of the reference training engine step
@@ -702,6 +703,9 @@ class DistributedKFAC:
             numerics match the single-pass step up to fp associativity
             (G contributions carry the exact ``1/accum**2`` loss-scale
             correction).
+          loss_scale: optional fp16 loss-scaling factor, forwarded to
+            ``KFACCapture.loss_and_grads`` (grads and output-grad
+            captures are unscaled before any factor statistics).
 
         Returns a function
         ``step(params, opt_state, kfac_state, extra_vars, batch, hyper)
@@ -730,7 +734,7 @@ class DistributedKFAC:
                 capture.loss_and_grads(
                     wrapped_loss, params, *model_args_fn(batch),
                     extra_vars=extra_vars, mutable_cols=mutable_cols,
-                    has_aux=True, **kwargs))
+                    has_aux=True, loss_scale=loss_scale, **kwargs))
             return loss, extra_metrics, grads, captures, updated
 
         def accum_fwd_bwd(params, extra_vars, batch, do_factors):

@@ -181,6 +181,21 @@ class KFAC:
         self.verbose = verbose
         self._specs: dict[str, Any] | None = None
 
+    def __repr__(self) -> str:
+        """Hyperparameter dump (reference KFAC.__repr__,
+        preconditioner.py:265-292)."""
+        fields = ('damping', 'factor_decay', 'factor_update_freq',
+                  'inv_update_freq', 'kl_clip', 'lr', 'inverse_method',
+                  'eigh_method', 'newton_iters', 'factor_dtype',
+                  'inv_dtype', 'symmetry_aware_comm',
+                  'assignment_strategy', 'comm_method',
+                  'grad_worker_fraction')
+        lines = [f'  {name}: {getattr(self, name)!r}' for name in fields]
+        n_layers = (len(self._specs) if self._specs is not None
+                    else '<uninitialized>')
+        lines.append(f'  registered_layers: {n_layers}')
+        return 'KFAC(\n' + '\n'.join(lines) + '\n)'
+
     # ------------------------------------------------------------------
     # Registration / state init
     # ------------------------------------------------------------------

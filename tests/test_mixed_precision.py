@@ -1,0 +1,89 @@
+"""Mixed-precision policies: bf16 factors, fp32 decompositions, fp16
+loss scaling.
+
+Pins the reference's dtype policy (README.md:150-160, SURVEY.md §2.2):
+factors may be stored in the low-precision compute dtype, inverses are
+always *computed* in fp32, and loss-scaled backward passes unscale the
+captured output-grads before factor statistics (BASELINE config 5 is
+bf16 factors + fp32 eigendecomp).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_kfac_pytorch_tpu import KFAC
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(4)(nn.relu(nn.Dense(12)(x)))
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 8), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 4, 16))
+    return x, y
+
+
+def test_bf16_factor_storage_fp32_decomposition():
+    x, y = _data()
+    model = MLP()
+    kfac = KFAC(model, factor_update_freq=1, inv_update_freq=1,
+                damping=0.01, factor_dtype=jnp.bfloat16)
+    variables, state = kfac.init(jax.random.PRNGKey(0), x)
+    for f in jax.tree.leaves(state['factors']):
+        assert f.dtype == jnp.bfloat16
+
+    def loss_fn(out):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            out, y).mean()
+
+    _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+        loss_fn, variables['params'], x)
+    precond, state = kfac.step(state, grads, captures)
+    for f in jax.tree.leaves(state['factors']):
+        assert f.dtype == jnp.bfloat16          # stored/communicated bf16
+    for f in jax.tree.leaves(state['inverses']):
+        assert f.dtype == jnp.float32           # computed + stored fp32
+    assert all(np.isfinite(np.asarray(g)).all()
+               for g in jax.tree.leaves(precond))
+
+
+def test_loss_scale_is_identity_in_fp32():
+    x, y = _data()
+    model = MLP()
+    kfac = KFAC(model, factor_update_freq=1, inv_update_freq=1,
+                damping=0.01)
+    variables, state = kfac.init(jax.random.PRNGKey(0), x)
+    params = variables['params']
+
+    def loss_fn(out):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            out, y).mean()
+
+    loss_a, _, grads_a, caps_a, _ = kfac.capture.loss_and_grads(
+        loss_fn, params, x)
+    loss_b, _, grads_b, caps_b, _ = kfac.capture.loss_and_grads(
+        loss_fn, params, x, loss_scale=2.0 ** 14)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(grads_a), jax.tree.leaves(grads_b)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+    # Captured output-grads are unscaled too (factor stats unaffected).
+    for name in caps_a:
+        for ga, gb in zip(caps_a[name]['g'], caps_b[name]['g']):
+            np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
+                                       rtol=1e-5, atol=1e-7)
+
+
+def test_repr_lists_hyperparams():
+    kfac = KFAC(MLP(), damping=0.02, inverse_method='newton')
+    text = repr(kfac)
+    assert 'damping: 0.02' in text
+    assert "inverse_method: 'newton'" in text
+    assert 'registered_layers' in text
