@@ -26,4 +26,7 @@ from distributed_kfac_pytorch_tpu.ops.linalg import (
     precondition_inv,
 )
 from distributed_kfac_pytorch_tpu.ops import pallas_kernels
-from distributed_kfac_pytorch_tpu.ops.pallas_kernels import batched_inverse
+from distributed_kfac_pytorch_tpu.ops.pallas_kernels import (
+    batched_inverse,
+    batched_jacobi_eigh,
+)

@@ -34,34 +34,103 @@ def get_eigendecomp(x: jax.Array, clip: float | None = 0.0
     return q, d
 
 
-def _round_robin_schedule(n: int):
-    """Tournament pairings: (n-1) rounds of n/2 disjoint pairs covering
-    every index pair exactly once (circle method, index 0 fixed)."""
-    import numpy as np
-    assert n % 2 == 0
-    others = list(range(1, n))
-    rounds = []
-    for _ in range(n - 1):
-        arr = [0] + others
-        pairs = [(min(arr[i], arr[n - 1 - i]), max(arr[i], arr[n - 1 - i]))
-                 for i in range(n // 2)]
-        rounds.append(pairs)
-        others = others[1:] + others[:1]
-    return np.asarray(rounds)  # (n-1, n/2, 2)
+def default_jacobi_sweeps(n: int) -> int:
+    """Sweep count reaching fp32 roundoff: 12 up to n=512, +log2 beyond."""
+    return 12 if n <= 512 else 12 + max(0, (n - 1).bit_length() - 9)
+
+
+def jacobi_slot_iteration(a: jax.Array, v: jax.Array, sweeps: int
+                          ) -> tuple[jax.Array, jax.Array]:
+    """The Brent–Luk Jacobi inner loop over an even-dim slot-basis pair.
+
+    Runs ``sweeps * (n - 1)`` rounds: rotate the paired half-blocks of
+    ``a`` (rows then columns) and of ``v`` (columns), then move to the
+    next tournament pairing with the systolic slice/concat exchange.
+    Every op is elementwise/slice/concat — usable verbatim inside a
+    Pallas kernel (ops.pallas_kernels) and under vmap.
+
+    Returns (a, v) with ``a`` ~diagonal in the final slot order and
+    ``v``'s columns the matching eigenvector candidates (original row
+    basis). Callers sort by the diagonal afterwards.
+    """
+    n_pad = a.shape[-1]
+    p = n_pad // 2
+    eye_p = jnp.eye(p, dtype=jnp.float32)
+
+    def halves(m, axis):
+        return (jax.lax.slice_in_dim(m, 0, p, axis=axis),
+                jax.lax.slice_in_dim(m, p, n_pad, axis=axis))
+
+    def rotate(m, c, s, axis):
+        """Mix the two halves along ``axis`` with per-pair (c, s)."""
+        lo, hi = halves(m, axis)
+        shape = (-1, 1) if axis == 0 else (1, -1)
+        c = c.reshape(shape)
+        s = s.reshape(shape)
+        return jnp.concatenate([c * lo - s * hi, s * lo + c * hi],
+                               axis=axis)
+
+    def exchange(m, axis):
+        """Brent–Luk systolic move to the next pairing (slice/concat).
+
+        tops' = [t0, b0, t1..t_{p-2}]; bots' = [b1..b_{p-1}, t_{p-1}].
+        """
+        t, b = halves(m, axis)
+        sl = lambda h, lo, hi: jax.lax.slice_in_dim(h, lo, hi, axis=axis)
+        t_new = jnp.concatenate(
+            [sl(t, 0, 1), sl(b, 0, 1), sl(t, 1, p - 1)], axis=axis)
+        b_new = jnp.concatenate(
+            [sl(b, 1, p), sl(t, p - 1, p)], axis=axis)
+        return jnp.concatenate([t_new, b_new], axis=axis)
+
+    def round_step(carry, _):
+        a, v = carry
+        # Pair i = (slot i, slot p+i): diagonals of the three p x p
+        # blocks, extracted by mask-sum (no gathers).
+        tl, tr = halves(halves(a, 0)[0], 1)     # a[:p,:p], a[:p,p:]
+        br = halves(halves(a, 0)[1], 1)[1]      # a[p:,p:]
+        app = jnp.sum(tl * eye_p, axis=1)
+        aqq = jnp.sum(br * eye_p, axis=1)
+        apq = jnp.sum(tr * eye_p, axis=1)
+        small = jnp.abs(apq) <= 1e-30
+        tau = (aqq - app) / jnp.where(small, 1.0, 2.0 * apq)
+        # sign(0) must be +1: tau=0 (equal diagonal) needs the full
+        # 45-degree rotation, not the identity.
+        sgn = jnp.where(tau >= 0, 1.0, -1.0)
+        t = sgn / (jnp.abs(tau) + jnp.sqrt(1.0 + tau * tau))
+        t = jnp.where(small, 0.0, t)
+        c = 1.0 / jnp.sqrt(1.0 + t * t)
+        s = t * c
+        a = rotate(a, c, s, axis=0)             # J^T A
+        a = rotate(a, c, s, axis=1)             # (J^T A) J
+        v = rotate(v, c, s, axis=1)             # accumulate Q = J_1 J_2 ..
+        if p > 1:
+            a = exchange(a, axis=0)
+            a = exchange(a, axis=1)
+            v = exchange(v, axis=1)
+        return (a, v), None
+
+    rounds = sweeps * (n_pad - 1)
+    (a, v), _ = jax.lax.scan(round_step, (a, v), None, length=rounds)
+    return a, v
 
 
 def jacobi_eigh(x: jax.Array, sweeps: int | None = None
                 ) -> tuple[jax.Array, jax.Array]:
-    """Symmetric eigendecomposition by vectorized cyclic Jacobi rotations.
+    """Symmetric eigendecomposition by Brent–Luk parallel Jacobi.
 
-    One sweep = n-1 tournament rounds; each round applies n/2 *disjoint*
-    Givens rotations simultaneously (vector ops over the pair index, no
-    per-rotation loop), so the whole solver is ~2(n-1)·sweeps dense-row
-    updates — the classic parallel-Jacobi formulation that maps onto
-    wide vector units, and the basis for a VMEM-resident Pallas variant.
-    Accuracy: off-diagonal mass contracts quadratically once small;
-    12 sweeps reach fp32 roundoff for n <= ~512, and the default scales
-    the count up with log2(n) beyond that.
+    The matrix lives in a *slot* basis where round ``r`` always pairs
+    slot ``i`` with slot ``p + i`` (``p = n/2``): each round applies all
+    ``p`` disjoint Givens rotations as two half-matrix elementwise
+    combines (rows, then columns), then moves pairs to the next
+    tournament arrangement with the Brent–Luk systolic exchange — a
+    fixed slice/concat shuffle. One sweep = ``n - 1`` rounds covering
+    every index pair once. The entire inner loop is elementwise ops,
+    slices and concats — no gather/scatter — so it vectorizes cleanly on
+    wide vector units and ports directly to a VMEM-resident Pallas
+    kernel. Accuracy: off-diagonal mass contracts quadratically once
+    small; 12 sweeps reach fp32 roundoff for n <= ~512, and the default
+    scales the count with log2(n) beyond that.
 
     Returns ``(Q, d)`` with eigenvalues ascending (same convention as
     :func:`get_eigendecomp`). Pure JAX, vmap-friendly.
@@ -69,7 +138,7 @@ def jacobi_eigh(x: jax.Array, sweeps: int | None = None
     n = x.shape[-1]
     x = x.astype(jnp.float32)
     if sweeps is None:
-        sweeps = 12 if n <= 512 else 12 + max(0, (n - 1).bit_length() - 9)
+        sweeps = default_jacobi_sweeps(n)
     if n == 1:
         return jnp.ones((1, 1), jnp.float32), x.reshape(1)
     n_pad = n + (n % 2)
@@ -78,43 +147,9 @@ def jacobi_eigh(x: jax.Array, sweeps: int | None = None
         # Pad with a decoupled unit eigenvalue; stripped after sorting.
         a = jnp.pad(x, ((0, 1), (0, 1)))
         a = a.at[n, n].set(1.0)
-    schedule = jnp.asarray(_round_robin_schedule(n_pad))  # (R, P, 2)
     v0 = jnp.eye(n_pad, dtype=jnp.float32)
-
-    def rotate_rows(m, p, q, c, s):
-        """rows[p] <- c*rows[p] - s*rows[q]; rows[q] <- s*rows[p] + c*rows[q]."""
-        mp = m[p, :]
-        mq = m[q, :]
-        return m.at[p, :].set(c[:, None] * mp - s[:, None] * mq) \
-                .at[q, :].set(s[:, None] * mp + c[:, None] * mq)
-
-    def round_step(carry, pairs):
-        a, v = carry
-        p, q = pairs[:, 0], pairs[:, 1]
-        app = a[p, p]
-        aqq = a[q, q]
-        apq = a[p, q]
-        # Rotation zeroing A[p,q]: guard tiny pivots (t -> 0, identity).
-        small = jnp.abs(apq) <= 1e-30
-        tau = (aqq - app) / jnp.where(small, 1.0, 2.0 * apq)
-        # sign(0) must be +1 here: tau=0 (equal diagonal) needs the full
-        # 45-degree rotation, not the identity.
-        sgn = jnp.where(tau >= 0, 1.0, -1.0)
-        t = sgn / (jnp.abs(tau) + jnp.sqrt(1.0 + tau * tau))
-        t = jnp.where(small, 0.0, t)
-        c = 1.0 / jnp.sqrt(1.0 + t * t)
-        s = t * c
-        a = rotate_rows(a, p, q, c, s)          # J^T A
-        a = rotate_rows(a.T, p, q, c, s).T      # (J^T A) J
-        v = rotate_rows(v.T, p, q, c, s).T      # accumulate Q = J_1 J_2 ...
-        return (a, v), None
-
-    def sweep(carry, _):
-        carry, _ = jax.lax.scan(round_step, carry, schedule)
-        return carry, None
-
-    (a, v), _ = jax.lax.scan(sweep, (a, v0), None, length=sweeps)
-    d = jnp.diagonal(a)
+    a, v = jacobi_slot_iteration(a, v0, sweeps)
+    d = jnp.sum(a * jnp.eye(n_pad, dtype=jnp.float32), axis=1)
     order = jnp.argsort(d)
     d = d[order]
     v = v[:, order]
@@ -134,15 +169,16 @@ def batched_eigh(stack: jax.Array, method: str = 'xla',
                  ) -> tuple[jax.Array, jax.Array]:
     """Eigendecompose a (B, n, n) SPD stack: ``(Q, d)`` ascending.
 
-    ``method='xla'`` vmaps the backend eigh; ``'jacobi'`` vmaps
-    :func:`jacobi_eigh` (parallel cyclic Jacobi — an alternative whose
-    inner loop is pure vector ops, the shape a Pallas VMEM-resident
-    kernel wants). Single dispatch point for the bucketed eigen paths in
-    ``preconditioner`` and ``parallel.distributed``.
+    ``method='xla'`` vmaps the backend eigh; ``'jacobi'`` dispatches
+    through ``ops.pallas_kernels.batched_jacobi_eigh`` (Brent–Luk
+    parallel Jacobi — vmapped pure JAX by default, with an opt-in
+    VMEM-resident Pallas kernel pending hardware validation). Single
+    dispatch point for the bucketed eigen paths in ``preconditioner``
+    and ``parallel.distributed``.
     """
     if method == 'jacobi':
-        qs, ds = jax.vmap(
-            lambda m: jacobi_eigh(m, sweeps))(stack.astype(jnp.float32))
+        from distributed_kfac_pytorch_tpu.ops import pallas_kernels
+        qs, ds = pallas_kernels.batched_jacobi_eigh(stack, sweeps)
         if clip is not None:
             ds = jnp.maximum(ds, clip)
         return qs, ds
