@@ -83,7 +83,7 @@ def jacobi_slot_iteration(a: jax.Array, v: jax.Array, sweeps: int
             [sl(b, 1, p), sl(t, p - 1, p)], axis=axis)
         return jnp.concatenate([t_new, b_new], axis=axis)
 
-    def round_step(carry, _):
+    def round_step(_, carry):
         a, v = carry
         # Pair i = (slot i, slot p+i): diagonals of the three p x p
         # blocks, extracted by mask-sum (no gathers).
@@ -108,10 +108,13 @@ def jacobi_slot_iteration(a: jax.Array, v: jax.Array, sweeps: int
             a = exchange(a, axis=0)
             a = exchange(a, axis=1)
             v = exchange(v, axis=1)
-        return (a, v), None
+        return a, v
 
+    # fori_loop, not scan: identical semantics with no per-round outputs,
+    # and it is the loop form the Mosaic (Pallas TPU) compiler can lower,
+    # so the same code runs inside the VMEM kernel.
     rounds = sweeps * (n_pad - 1)
-    (a, v), _ = jax.lax.scan(round_step, (a, v), None, length=rounds)
+    a, v = jax.lax.fori_loop(0, rounds, round_step, (a, v))
     return a, v
 
 
@@ -149,7 +152,7 @@ def jacobi_eigh(x: jax.Array, sweeps: int | None = None
         a = a.at[n, n].set(1.0)
     v0 = jnp.eye(n_pad, dtype=jnp.float32)
     a, v = jacobi_slot_iteration(a, v0, sweeps)
-    d = jnp.sum(a * jnp.eye(n_pad, dtype=jnp.float32), axis=1)
+    d = jnp.diagonal(a)
     order = jnp.argsort(d)
     d = d[order]
     v = v[:, order]
@@ -171,10 +174,10 @@ def batched_eigh(stack: jax.Array, method: str = 'xla',
 
     ``method='xla'`` vmaps the backend eigh; ``'jacobi'`` dispatches
     through ``ops.pallas_kernels.batched_jacobi_eigh`` (Brent–Luk
-    parallel Jacobi — vmapped pure JAX by default, with an opt-in
-    VMEM-resident Pallas kernel pending hardware validation). Single
-    dispatch point for the bucketed eigen paths in ``preconditioner``
-    and ``parallel.distributed``.
+    parallel Jacobi — vmapped pure JAX by default; the VMEM Pallas
+    kernel is opt-in, hardware-validated but VMEM-bound at n >= 128 —
+    see its dispatch comment). Single dispatch point for the bucketed
+    eigen paths in ``preconditioner`` and ``parallel.distributed``.
     """
     if method == 'jacobi':
         from distributed_kfac_pytorch_tpu.ops import pallas_kernels
