@@ -15,6 +15,18 @@ The reference repo publishes no wall-clock numbers (BASELINE.md), so
 against a plain-SGD step of the same model on the same chip — the
 reference papers' own headline framing (K-FAC at small overhead over SGD);
 lower is better, 1.0 means free preconditioning.
+
+Measurement methodology (hard-won on the tunneled v5e backend):
+  - the iteration loop runs INSIDE the program (``lax.scan``), so a
+    timing call is one device program — per-step host dispatch through
+    the device tunnel costs ~15-20 ms/step and would swamp the ratio;
+  - the inverse cadence is STATIC program structure (blocks of one
+    inverse-updating step followed by ``inv_freq - 1`` plain steps) —
+    the measured-on-v5e fast path (see KFAC.step on why on-device
+    ``lax.cond`` gating is pathological on TPU);
+  - timed calls CHAIN the carry returned by the previous call, so no two
+    calls see identical inputs (the backend can serve repeated identical
+    executions from a cache, which reads as impossibly-fast iters).
 """
 
 from __future__ import annotations
@@ -35,7 +47,10 @@ def loss_fn(out, labels):
         out, labels).mean()
 
 
-def build_steps(model, x, y, factor_freq, inv_freq):
+def build_runners(model, x, y, factor_freq, inv_freq, n_iters):
+    """(kfac_run, kfac_carry0, sgd_run, sgd_carry0) scanned n-iter programs."""
+    assert factor_freq == 1, 'tracked config 1 updates factors every iter'
+    assert n_iters % inv_freq == 0
     kfac = KFAC(model, factor_update_freq=factor_freq,
                 inv_update_freq=inv_freq, damping=0.003, lr=0.1)
     variables, kstate = kfac.init(jax.random.PRNGKey(0), x)
@@ -44,18 +59,37 @@ def build_steps(model, x, y, factor_freq, inv_freq):
     tx = optax.sgd(0.1, momentum=0.9)
     opt_state = tx.init(params)
 
-    @jax.jit
-    def kfac_step(params, opt_state, kstate, extra, x, y):
-        loss, _, grads, captures, updated = kfac.capture.loss_and_grads(
-            lambda out: loss_fn(out, y), params, x,
-            extra_vars=extra, mutable_cols=('batch_stats',))
-        precond, kstate = kfac.step(kstate, grads, captures)
-        updates, opt_state = tx.update(precond, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, kstate, {**extra, **updated}, loss
+    def make_body(inv_update):
+        def body(carry, _):
+            params, opt_state, kstate, extra = carry
+            loss, _, grads, captures, updated = kfac.capture.loss_and_grads(
+                lambda out: loss_fn(out, y), params, x,
+                extra_vars=extra, mutable_cols=('batch_stats',))
+            precond, kstate = kfac.step(kstate, grads, captures,
+                                        factor_update=True,
+                                        inv_update=inv_update)
+            updates, opt_state = tx.update(precond, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state, kstate, {**extra, **updated}), loss
+        return body
+
+    inv_body, plain_body = make_body(True), make_body(False)
+
+    def block(carry, _):
+        carry, loss0 = inv_body(carry, None)
+        carry, losses = jax.lax.scan(plain_body, carry, None,
+                                     length=inv_freq - 1)
+        return carry, (losses[-1] if inv_freq > 1 else loss0)
 
     @jax.jit
-    def sgd_step(params, opt_state, extra, x, y):
+    def kfac_run(carry):
+        carry, losses = jax.lax.scan(block, carry, None,
+                                     length=n_iters // inv_freq)
+        return carry, losses[-1]
+
+    def sgd_body(carry, _):
+        params, opt_state, extra = carry
+
         def wrapped(params):
             out, updated = model.apply(
                 {'params': params, **extra}, x,
@@ -65,18 +99,26 @@ def build_steps(model, x, y, factor_freq, inv_freq):
             wrapped, has_aux=True)(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, opt_state, {**extra, **updated}, loss
+        return (params, opt_state, {**extra, **updated}), loss
 
-    return kfac_step, sgd_step, params, opt_state, kstate, extra
+    @jax.jit
+    def sgd_run(carry):
+        carry, losses = jax.lax.scan(sgd_body, carry, None, length=n_iters)
+        return carry, losses[-1]
+
+    return (kfac_run, (params, opt_state, kstate, extra),
+            sgd_run, (params, opt_state, extra))
 
 
-def time_loop(fn, n_iters):
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(n_iters):
-        out = fn()
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / n_iters * 1000.0
+def time_chained(run, carry, n_iters, repeats=3):
+    """Best-of-``repeats`` per-iter time; each call chains the last carry."""
+    carry, loss = jax.block_until_ready(run(carry))  # compile + warm
+    best = float('inf')
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        carry, loss = jax.block_until_ready(run(carry))
+        best = min(best, time.perf_counter() - t0)
+    return best / n_iters * 1000.0
 
 
 def main():
@@ -99,31 +141,11 @@ def main():
         metric = 'resnet20_cifar_kfac_step_cpu'
         n_iters, factor_freq, inv_freq = 10, 1, 10
 
-    kfac_step, sgd_step, params, opt_state, kstate, extra = build_steps(
-        model, x, y, factor_freq, inv_freq)
+    kfac_run, kfac_carry, sgd_run, sgd_carry = build_runners(
+        model, x, y, factor_freq, inv_freq, n_iters)
 
-    # Warmup: compile both programs and run one full inverse update.
-    state = [params, opt_state, kstate, extra]
-
-    def run_kfac():
-        state[0], state[1], state[2], state[3], loss = kfac_step(
-            state[0], state[1], state[2], state[3], x, y)
-        return loss
-
-    sgd_state = [params, opt_state, extra]
-
-    def run_sgd():
-        sgd_state[0], sgd_state[1], sgd_state[2], loss = sgd_step(
-            sgd_state[0], sgd_state[1], sgd_state[2], x, y)
-        return loss
-
-    jax.block_until_ready(run_kfac())
-    jax.block_until_ready(run_sgd())
-    run_kfac()  # one more warm iter each
-    run_sgd()
-
-    kfac_ms = time_loop(run_kfac, n_iters)
-    sgd_ms = time_loop(run_sgd, n_iters)
+    kfac_ms = time_chained(kfac_run, kfac_carry, n_iters)
+    sgd_ms = time_chained(sgd_run, sgd_carry, n_iters)
 
     print(json.dumps({
         'metric': metric,

@@ -32,20 +32,26 @@ from distributed_kfac_pytorch_tpu.ops import factors as F
 KNOWN_KINDS = (LINEAR, CONV2D, EMBEDDING)
 
 
-def compute_a_factor(spec: LayerSpec,
-                     a_calls: Sequence[jax.Array]) -> jax.Array:
-    """Input-covariance factor A from per-call activations."""
+def compute_a_factor(spec: LayerSpec, a_calls: Sequence[jax.Array],
+                     compute_dtype=None) -> jax.Array:
+    """Input-covariance factor A from per-call activations.
+
+    ``compute_dtype`` selects the covariance matmul input dtype (fp32
+    accumulation always) — see ops.factors.get_cov.
+    """
     if spec.kind == LINEAR:
         out = None
         for a in a_calls:
-            cur = F.linear_a_factor(a, spec.has_bias)
+            cur = F.linear_a_factor(a, spec.has_bias,
+                                    compute_dtype=compute_dtype)
             out = cur if out is None else out + cur
         return out
     if spec.kind == CONV2D:
         out = None
         for a in a_calls:
             cur = F.conv2d_a_factor(a, spec.kernel_size, spec.strides,
-                                    spec.padding, spec.has_bias)
+                                    spec.padding, spec.has_bias,
+                                    compute_dtype=compute_dtype)
             out = cur if out is None else out + cur
         return out
     if spec.kind == EMBEDDING:
@@ -57,19 +63,19 @@ def compute_a_factor(spec: LayerSpec,
     raise ValueError(f'unknown layer kind {spec.kind!r}')
 
 
-def compute_g_factor(spec: LayerSpec,
-                     g_calls: Sequence[jax.Array]) -> jax.Array:
+def compute_g_factor(spec: LayerSpec, g_calls: Sequence[jax.Array],
+                     compute_dtype=None) -> jax.Array:
     """Output-gradient covariance factor G from per-call probe grads."""
     if spec.kind in (LINEAR, EMBEDDING):
         out = None
         for g in g_calls:
-            cur = F.linear_g_factor(g)
+            cur = F.linear_g_factor(g, compute_dtype=compute_dtype)
             out = cur if out is None else out + cur
         return out
     if spec.kind == CONV2D:
         out = None
         for g in g_calls:
-            cur = F.conv2d_g_factor(g)
+            cur = F.conv2d_g_factor(g, compute_dtype=compute_dtype)
             out = cur if out is None else out + cur
         return out
     raise ValueError(f'unknown layer kind {spec.kind!r}')

@@ -24,11 +24,17 @@ def append_bias_ones(x: jax.Array) -> jax.Array:
 
 
 def get_cov(a: jax.Array, b: jax.Array | None = None,
-            scale: float | None = None) -> jax.Array:
+            scale: float | None = None,
+            compute_dtype=None) -> jax.Array:
     """Empirical second moment ``a^T @ b / scale`` of 2-D tensors.
 
     When ``b`` is None the result is explicitly symmetrized,
     ``(C + C^T) / 2``, to suppress float round-off asymmetry.
+
+    ``compute_dtype`` casts the matmul *inputs* (e.g. to bfloat16 for the
+    MXU fast path) while always accumulating in float32 — the TPU
+    analogue of the reference's keep-autocast-dtype factor policy
+    (README.md:150-160); the returned covariance is float32.
 
     Reference parity: kfac/layers/utils.py:13-43.
     """
@@ -38,10 +44,18 @@ def get_cov(a: jax.Array, b: jax.Array | None = None,
         raise ValueError(f'shape mismatch: {a.shape} vs {b.shape}')
     if scale is None:
         scale = a.shape[0]
+    if compute_dtype is not None:
+        a = a.astype(compute_dtype)
+        b = b if b is None else b.astype(compute_dtype)
+    # Scale the (small) covariance output, not the (batch-sized) input:
+    # an elementwise divide of the input materializes a full copy of a
+    # tensor that is ~300 MB per conv layer at production batch sizes —
+    # profiled on v5e, those copies dominated the whole K-FAC step.
     if b is None:
-        cov = a.T @ (a / scale)
-        return (cov + cov.T) / 2.0
-    return a.T @ (b / scale)
+        cov = jnp.matmul(a.T, a, preferred_element_type=jnp.float32)
+        return (cov + cov.T) * (0.5 / scale)
+    return jnp.matmul(a.T, b,
+                      preferred_element_type=jnp.float32) * (1.0 / scale)
 
 
 def update_running_avg(new: jax.Array, current: jax.Array,
@@ -68,24 +82,59 @@ def collapse_batch_dims(x: jax.Array) -> jax.Array:
 # Per-layer-kind factor statistics
 # ---------------------------------------------------------------------------
 
-def linear_a_factor(a: jax.Array, has_bias: bool) -> jax.Array:
+def _column_mean(x: jax.Array) -> jax.Array:
+    """Column mean of a 2-D tensor as a ones-row matmul (fp32 accumulate).
+
+    Expressed as a matmul rather than ``jnp.sum(x, axis=0)``: the batched
+    column reduction rides the MXU on TPU, and the reduction form
+    segfaults XLA:CPU inside large shard_map programs (bisected on the
+    distributed embedding-parity test; same fragility class as the
+    gather note in :func:`pack_symmetric`).
+    """
+    ones = jnp.ones((1, x.shape[0]), jnp.float32)
+    return (ones @ x.astype(jnp.float32))[0] / x.shape[0]
+
+
+def _assemble_bias_factor(cov: jax.Array, bias_col: jax.Array,
+                          corner) -> jax.Array:
+    """[[cov, b], [b^T, corner]] — the covariance of rows with an appended
+    ones column, built without ever materializing the (batch, dim + 1)
+    concatenation (a full copy of the activation/patch tensor).
+
+    Assembled as pad + two rank-1 outer products rather than block
+    concatenation (keeps every op elementwise/pad — the most portable
+    fusion-friendly form on both TPU and XLA:CPU).
+    """
+    d = cov.shape[0]
+    padded = jnp.pad(cov, ((0, 1), (0, 1)))
+    onehot = (jnp.arange(d + 1) == d).astype(cov.dtype)
+    b_ext = jnp.pad(bias_col, (0, 1)) + (corner / 2.0) * onehot
+    return padded + jnp.outer(onehot, b_ext) + jnp.outer(b_ext, onehot)
+
+
+def linear_a_factor(a: jax.Array, has_bias: bool,
+                    compute_dtype=None) -> jax.Array:
     """A = cov(inputs (+ ones column)) for a dense layer.
 
     ``a`` may have arbitrary leading dims (batch, time, ...); they are
-    collapsed. Reference parity: kfac/layers/linear.py:12-18.
+    collapsed. Reference parity: kfac/layers/linear.py:12-18; the bias
+    row/column ``[sum(a)/n, 1]`` is assembled analytically instead of
+    concatenating a ones column onto the batch tensor.
     """
     a = collapse_batch_dims(a)
-    if has_bias:
-        a = append_bias_ones(a)
-    return get_cov(a)
+    cov = get_cov(a, compute_dtype=compute_dtype)
+    if not has_bias:
+        return cov
+    bias_col = _column_mean(a).astype(cov.dtype)
+    return _assemble_bias_factor(cov, bias_col, 1.0)
 
 
-def linear_g_factor(g: jax.Array) -> jax.Array:
+def linear_g_factor(g: jax.Array, compute_dtype=None) -> jax.Array:
     """G = cov(grad wrt layer outputs) for a dense layer.
 
     Reference parity: kfac/layers/linear.py:20-24.
     """
-    return get_cov(collapse_batch_dims(g))
+    return get_cov(collapse_batch_dims(g), compute_dtype=compute_dtype)
 
 
 def extract_conv2d_patches(x: jax.Array,
@@ -119,31 +168,56 @@ def extract_conv2d_patches(x: jax.Array,
 
 
 def conv2d_a_factor(a: jax.Array, kernel_size, strides, padding,
-                    has_bias: bool) -> jax.Array:
+                    has_bias: bool, compute_dtype=None) -> jax.Array:
     """A factor for conv2d from NHWC inputs via im2col patches.
 
-    Patch rows (and the appended ones column) are divided by the spatial
-    size before the covariance, exactly like the reference
-    (kfac/layers/conv.py:24-34: ``a / spatial_size`` after
-    ``append_bias_ones``, then cov over all B*OH*OW rows).
+    Same value as the reference formula (kfac/layers/conv.py:24-34:
+    ``a / spatial_size`` after ``append_bias_ones``, then cov over all
+    B*OH*OW rows), restructured so nothing batch-sized is ever copied:
+
+      - patches stay in ``conv_general_dilated_patches``'s native
+        (c, kh, kw) feature order; the basis permutation to (kh, kw, c)
+        is applied to the *small* (D, D) covariance instead of
+        transposing the ~300 MB patch tensor (profiled on v5e: those
+        relayout copies, the ones-column concat, and the spatial-size
+        divide were ~95% of the whole K-FAC step time);
+      - the 1/spatial scaling folds into the covariance output scale;
+      - the bias row/column is assembled analytically.
     """
-    patches = extract_conv2d_patches(a, kernel_size, strides, padding)
-    spatial_size = patches.shape[1] * patches.shape[2]
-    p = patches.reshape(-1, patches.shape[-1])
-    if has_bias:
-        p = append_bias_ones(p)
-    return get_cov(p / spatial_size)
+    kh, kw = kernel_size
+    c = a.shape[-1]
+    patches = jax.lax.conv_general_dilated_patches(
+        a, filter_shape=(kh, kw), window_strides=tuple(strides),
+        padding=padding, dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+    b, oh, ow, d = patches.shape
+    spatial = oh * ow
+    rows = b * spatial
+    p2 = patches.reshape(rows, d)
+    cov = get_cov(p2, scale=rows * spatial * spatial,
+                  compute_dtype=compute_dtype)
+    # Native feature order is (c, kh*kw) with c slowest; the factor basis
+    # is (kh, kw, c) to match the flattened flax kernel. Permuting the
+    # (D, D) covariance is ~1 MB of gather vs two relayouts of patches.
+    perm = jnp.arange(d).reshape(c, kh * kw).T.reshape(-1)
+    cov = cov[perm][:, perm]
+    if not has_bias:
+        return cov
+    bias_col = (_column_mean(p2) / (spatial * spatial)
+                ).astype(cov.dtype)[perm]
+    return _assemble_bias_factor(cov, bias_col, 1.0 / (spatial * spatial))
 
 
-def conv2d_g_factor(g: jax.Array) -> jax.Array:
+def conv2d_g_factor(g: jax.Array, compute_dtype=None) -> jax.Array:
     """G factor for conv2d from NHWC output grads.
 
-    Reference parity: kfac/layers/conv.py:36-48 (there NCHW is transposed to
-    channels-last first; NHWC already is).
+    Reference parity: kfac/layers/conv.py:36-48 (there NCHW is transposed
+    to channels-last first; NHWC already is). The 1/spatial scaling folds
+    into the covariance output scale (no batch-sized elementwise copy).
     """
     spatial_size = g.shape[1] * g.shape[2]
     g2 = g.reshape(-1, g.shape[-1])
-    return get_cov(g2 / spatial_size)
+    return get_cov(g2, scale=g2.shape[0] * spatial_size * spatial_size,
+                   compute_dtype=compute_dtype)
 
 
 def embedding_a_factor(ids: jax.Array, vocab_size: int) -> jax.Array:

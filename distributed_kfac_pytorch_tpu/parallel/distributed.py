@@ -58,7 +58,11 @@ from distributed_kfac_pytorch_tpu.ops import linalg
 from distributed_kfac_pytorch_tpu.ops import pallas_kernels
 from distributed_kfac_pytorch_tpu.parallel.placement import load_balance
 from distributed_kfac_pytorch_tpu.parallel.sequence import SEQ_AXIS
-from distributed_kfac_pytorch_tpu.preconditioner import KFAC, CommMethod
+from distributed_kfac_pytorch_tpu.preconditioner import (
+    KFAC,
+    CommMethod,
+    cadence_gate,
+)
 
 # Mesh axis names. Batch/data parallelism shards over both axes jointly;
 # an optional third SEQ_AXIS ('kfac_sp') shards the sequence dimension for
@@ -339,8 +343,11 @@ class DistributedKFAC:
         accumulation can average contributions over micro-batches before
         the mesh ``pmean``.
         """
-        return {name: {'A': L.compute_a_factor(spec, captures[name]['a']),
-                       'G': L.compute_g_factor(spec, captures[name]['g'])}
+        cdt = self.kfac.factor_compute_dtype
+        return {name: {'A': L.compute_a_factor(spec, captures[name]['a'],
+                                               compute_dtype=cdt),
+                       'G': L.compute_g_factor(spec, captures[name]['g'],
+                                               compute_dtype=cdt)}
                 for name, spec in self.kfac.specs.items()}
 
     def _spmd_update_factors(self, state, contribs, factor_decay):
@@ -542,8 +549,9 @@ class DistributedKFAC:
     def spmd_step(self, state: dict, grads: dict, captures: dict = None, *,
                   contribs: dict = None,
                   damping=None, lr=None, factor_decay=None,
-                  factor_update_freq=None, inv_update_freq=None
-                  ) -> tuple[dict, dict]:
+                  factor_update_freq=None, inv_update_freq=None,
+                  factor_update: bool | None = None,
+                  inv_update: bool | None = None) -> tuple[dict, dict]:
         """One distributed K-FAC update; call inside ``shard_map``.
 
         Same contract and cadence semantics as :meth:`KFAC.step`
@@ -558,6 +566,12 @@ class DistributedKFAC:
         e.g. averaged over gradient-accumulation micro-batches (the
         analogue of the reference's ``accumulate_data`` path,
         kfac/layers/base.py:364-379).
+
+        ``factor_update`` / ``inv_update``: static cadence gating — see
+        :meth:`KFAC.step`. ``None`` keeps the dynamic ``lax.cond`` form;
+        Python bools bake the schedule into the trace (the fast path on
+        TPU — a cond whose branch holds the decompositions costs 10-18x
+        in XLA layout/copy pathologies around it, measured on v5e).
         """
         kfac = self.kfac
         damping = kfac.damping if damping is None else damping
@@ -570,19 +584,19 @@ class DistributedKFAC:
         if contribs is None and captures is None:
             raise ValueError('pass captures or contribs')
 
-        factors = jax.lax.cond(
-            step % f_freq == 0,
-            # Contraction stays inside the branch: covariance work only
-            # runs (not just gates) on factor-update steps.
-            lambda: self._spmd_update_factors(
+        def do_factors():
+            # Contraction stays inside the gated path: covariance work
+            # only runs on factor-update steps.
+            return self._spmd_update_factors(
                 state,
                 (contribs if contribs is not None
                  else self.local_factor_contribs(captures)),
-                factor_decay),
-            lambda: state['factors'])
+                factor_decay)
 
-        inv_stacks, diag_inv = jax.lax.cond(
-            step % i_freq == 0,
+        factors = cadence_gate(factor_update, step, f_freq, do_factors,
+                               lambda: state['factors'])
+        inv_stacks, diag_inv = cadence_gate(
+            inv_update, step, i_freq,
             lambda: self._spmd_update_inverses(factors, damping),
             lambda: (state['inv_stacks'], state['diag_inv']))
 
@@ -779,10 +793,16 @@ class DistributedKFAC:
                 extra_c, sums = carry
                 loss, extra_metrics, grads, captures, updated = fwd_bwd(
                     params, extra_c, mb)
-                contribs = jax.lax.cond(
-                    do_factors,
-                    lambda: self.local_factor_contribs(captures),
-                    lambda: zeros(contribs_sh))
+                if isinstance(do_factors, bool):
+                    # Static cadence: the contraction is simply present or
+                    # absent from this program variant.
+                    contribs = (self.local_factor_contribs(captures)
+                                if do_factors else zeros(contribs_sh))
+                else:
+                    contribs = jax.lax.cond(
+                        do_factors,
+                        lambda: self.local_factor_contribs(captures),
+                        lambda: zeros(contribs_sh))
                 new_sums = jax.tree.map(
                     jnp.add, sums, (loss, extra_metrics, grads, contribs))
                 new_extra = ({**extra_c, **updated} if updated
@@ -806,64 +826,100 @@ class DistributedKFAC:
             return (mean(loss_sum), mean(extras_sum), mean(grads_sum),
                     contribs, updated)
 
-        def local_step(params, opt_state, kstate, extra_vars, batch, hyper):
-            if grad_accum_steps == 1:
-                loss, extra_metrics, grads, captures, updated = fwd_bwd(
-                    params, extra_vars, batch)
-                contribs = None
-            else:
-                f_freq = hyper.get('factor_update_freq')
-                if f_freq is None:
-                    f_freq = self.kfac.factor_update_freq
-                do_factors = kstate['step'] % f_freq == 0
-                loss, extra_metrics, grads, contribs, updated = (
-                    accum_fwd_bwd(params, extra_vars, batch, do_factors))
-                captures = None
-            grads = jax.lax.pmean(grads, self.data_axes)
-            loss = jax.lax.pmean(loss, self.data_axes)
-            metrics = {'loss': loss,
-                       **jax.lax.pmean(extra_metrics, self.data_axes)}
-            precond, kstate = self.spmd_step(
-                kstate, grads, captures, contribs=contribs,
-                damping=hyper['damping'], lr=hyper['lr'],
-                factor_decay=hyper.get('factor_decay'),
-                factor_update_freq=hyper.get('factor_update_freq'),
-                inv_update_freq=hyper.get('inv_update_freq'))
-            updates, opt_state = tx.update(precond, opt_state, params)
-            params = jax.tree.map(lambda p, u: (p + u).astype(p.dtype),
-                                  params, updates)
-            if updated:
-                extra_vars = {**extra_vars,
-                              **jax.lax.pmean(updated, self.data_axes)}
-            return params, opt_state, kstate, extra_vars, metrics
+        def make_local_step(factor_update, inv_update):
+            def local_step(params, opt_state, kstate, extra_vars, batch,
+                           hyper):
+                if grad_accum_steps == 1:
+                    loss, extra_metrics, grads, captures, updated = fwd_bwd(
+                        params, extra_vars, batch)
+                    contribs = None
+                else:
+                    if factor_update is not None:
+                        do_factors = factor_update
+                    else:
+                        f_freq = hyper.get('factor_update_freq')
+                        if f_freq is None:
+                            f_freq = self.kfac.factor_update_freq
+                        do_factors = kstate['step'] % f_freq == 0
+                    loss, extra_metrics, grads, contribs, updated = (
+                        accum_fwd_bwd(params, extra_vars, batch, do_factors))
+                    captures = None
+                grads = jax.lax.pmean(grads, self.data_axes)
+                loss = jax.lax.pmean(loss, self.data_axes)
+                metrics = {'loss': loss,
+                           **jax.lax.pmean(extra_metrics, self.data_axes)}
+                precond, kstate = self.spmd_step(
+                    kstate, grads, captures, contribs=contribs,
+                    damping=hyper['damping'], lr=hyper['lr'],
+                    factor_decay=hyper.get('factor_decay'),
+                    factor_update_freq=hyper.get('factor_update_freq'),
+                    inv_update_freq=hyper.get('inv_update_freq'),
+                    factor_update=factor_update, inv_update=inv_update)
+                updates, opt_state = tx.update(precond, opt_state, params)
+                params = jax.tree.map(lambda p, u: (p + u).astype(p.dtype),
+                                      params, updates)
+                if updated:
+                    extra_vars = {**extra_vars,
+                                  **jax.lax.pmean(updated, self.data_axes)}
+                return params, opt_state, kstate, extra_vars, metrics
+            return local_step
 
-        def step(params, opt_state, kstate, extra_vars, batch, hyper):
-            kspecs = self.state_pspecs(kstate)
-            rep = P()
-            batch_specs = normalize_batch_specs(batch_spec, batch)
-            in_specs = (
-                jax.tree.map(lambda _: rep, params),
-                jax.tree.map(lambda _: rep, opt_state,
-                             is_leaf=lambda x: x is None),
-                kspecs,
-                jax.tree.map(lambda _: rep, extra_vars),
-                batch_specs,
-                jax.tree.map(lambda _: rep, hyper),
-            )
-            out_specs = (
-                jax.tree.map(lambda _: rep, params),
-                jax.tree.map(lambda _: rep, opt_state,
-                             is_leaf=lambda x: x is None),
-                kspecs,
-                jax.tree.map(lambda _: rep, extra_vars),
-                rep,  # metrics dict: P() prefix covers any keys
-            )
-            fn = jax.shard_map(local_step, mesh=self.mesh, in_specs=in_specs,
-                           out_specs=out_specs, check_vma=False)
-            return fn(params, opt_state, kstate, extra_vars, batch, hyper)
+        def make_step_impl(factor_update, inv_update):
+            def step_impl(params, opt_state, kstate, extra_vars, batch,
+                          hyper):
+                kspecs = self.state_pspecs(kstate)
+                rep = P()
+                batch_specs = normalize_batch_specs(batch_spec, batch)
+                in_specs = (
+                    jax.tree.map(lambda _: rep, params),
+                    jax.tree.map(lambda _: rep, opt_state,
+                                 is_leaf=lambda x: x is None),
+                    kspecs,
+                    jax.tree.map(lambda _: rep, extra_vars),
+                    batch_specs,
+                    jax.tree.map(lambda _: rep, hyper),
+                )
+                out_specs = (
+                    jax.tree.map(lambda _: rep, params),
+                    jax.tree.map(lambda _: rep, opt_state,
+                                 is_leaf=lambda x: x is None),
+                    kspecs,
+                    jax.tree.map(lambda _: rep, extra_vars),
+                    rep,  # metrics dict: P() prefix covers any keys
+                )
+                fn = jax.shard_map(
+                    make_local_step(factor_update, inv_update),
+                    mesh=self.mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_vma=False)
+                return fn(params, opt_state, kstate, extra_vars, batch,
+                          hyper)
+            return step_impl
 
+        # One separately-jitted callable per cadence-flag combination,
+        # built lazily and kept for the builder's lifetime. Passing the
+        # flags through one jit via static_argnums retraced + recompiled
+        # on EVERY flag flip (observed on jax 0.8: the tracing cache kept
+        # only the most recent static-arg variant — ~15-45 s per flip on
+        # TPU); distinct jit callables have independent caches, so each
+        # variant compiles exactly once.
         donate_argnums = (0, 1, 2, 3) if donate else ()
-        return jax.jit(step, donate_argnums=donate_argnums)
+        variants: dict[tuple, Any] = {}
+
+        def step(params, opt_state, kstate, extra_vars, batch, hyper,
+                 factor_update: bool | None = None,
+                 inv_update: bool | None = None):
+            """``factor_update`` / ``inv_update``: static cadence flags
+            (see :meth:`KFAC.step`). ``None`` = dynamic on-device conds;
+            host-driven bools select one of the statically-compiled
+            program variants (the TPU fast path)."""
+            key = (factor_update, inv_update)
+            if key not in variants:
+                variants[key] = jax.jit(make_step_impl(*key),
+                                        donate_argnums=donate_argnums)
+            return variants[key](params, opt_state, kstate, extra_vars,
+                                 batch, hyper)
+
+        return step
 
 
 def _get(tree, path):

@@ -11,6 +11,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Sequence
 
+import jax.numpy as jnp
 import optax
 
 from distributed_kfac_pytorch_tpu.preconditioner import CommMethod, KFAC
@@ -90,7 +91,17 @@ def set_lr(opt_state, lr):
         opt_state if isinstance(opt_state, tuple) else ())
     for s in states:
         if hasattr(s, 'hyperparams'):
-            s.hyperparams['learning_rate'] = lr
+            # Preserve the leaf's exact aval (array-ness, dtype AND
+            # weak_type): writing a Python float where an array leaf
+            # lived — or a strong-typed array where a weak one lived —
+            # changes the jit argument signature and silently recompiles
+            # the train step every epoch (~15-45 s per variant on TPU).
+            prev = jnp.asarray(s.hyperparams['learning_rate'])
+            if prev.weak_type:
+                new = jnp.asarray(float(lr))
+            else:
+                new = jnp.asarray(lr, dtype=prev.dtype)
+            s.hyperparams['learning_rate'] = new
             return opt_state
     raise ValueError('no injected learning_rate in optimizer state')
 

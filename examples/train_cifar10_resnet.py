@@ -150,10 +150,15 @@ def main(argv=None):
     mgr = ckpt_lib.CheckpointManager(args.checkpoint_dir)
     start_epoch = 0
     if not args.no_resume and mgr.latest_epoch() is not None:
+        # The like tree must match the SAVED structure exactly (orbax
+        # StandardRestore is strict), so include the scheduler states
+        # and the step scalar that mgr.save writes.
         like = ckpt_lib.bundle_state(
             state.params, state.opt_state,
             dkfac.state_dict(kstate) if dkfac else {},
-            state.extra_vars)
+            state.extra_vars,
+            schedulers={'kfac': kfac_sched} if kfac_sched else None,
+            step=0)
         try:
             restored = mgr.restore(like=like)
         except Exception as e:

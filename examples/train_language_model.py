@@ -207,14 +207,20 @@ def main(argv=None):
     mgr = ckpt_lib.CheckpointManager(args.checkpoint_dir)
     start_epoch = 0
     if not args.no_resume and mgr.latest_epoch() is not None:
+        # Must match the SAVED structure exactly (orbax StandardRestore
+        # is strict): include scheduler states and the step scalar.
         like = ckpt_lib.bundle_state(
-            state.params, state.opt_state, dkfac.state_dict(kstate), {})
+            state.params, state.opt_state, dkfac.state_dict(kstate), {},
+            schedulers={'kfac': kfac_sched}, step=0)
         restored = mgr.restore(like=like)
         state.params = restored['params']
         state.opt_state = restored['opt_state']
         state.kfac_state = dkfac.load_state_dict(restored['kfac'], params)
         start_epoch = mgr.latest_epoch() + 1
         state.epoch = start_epoch
+        # Restore the host step counter: the engine's static cadence is
+        # driven by it, so it must stay in phase with kstate['step'].
+        state.step = int(restored['scalars'].get('step', 0))
         kfac_sched.step(start_epoch)
         print(f'resumed from epoch {mgr.latest_epoch()}')
 
@@ -247,7 +253,7 @@ def main(argv=None):
             mgr.save(epoch, ckpt_lib.bundle_state(
                 state.params, state.opt_state,
                 dkfac.state_dict(state.kfac_state), {},
-                schedulers={'kfac': kfac_sched}))
+                schedulers={'kfac': kfac_sched}, step=state.step))
     writer.flush()
     print(f'total: {time.perf_counter() - t_start:.1f}s')
 

@@ -62,7 +62,8 @@ class TestLinearFactors:
         a = rand(4, 5, 6)  # (batch, time, dim)
         got = factors.linear_a_factor(a, has_bias=False)
         flat = np.asarray(a).reshape(20, 6)
-        np.testing.assert_allclose(got, flat.T @ flat / 20, rtol=1e-5)
+        np.testing.assert_allclose(got, flat.T @ flat / 20, rtol=1e-5,
+                                   atol=1e-6)
 
     def test_g(self):
         g = rand(10, 3)
