@@ -72,6 +72,16 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
                               hyper['inv_update_freq'])
         else:
             static_cadence = None
+            if accepts:
+                import warnings
+                warnings.warn(
+                    'train_epoch: step_fn accepts static cadence flags '
+                    "but hyper lacks 'factor_update_freq'/"
+                    "'inv_update_freq' — falling back to on-device "
+                    'cadence conds, which are 10-18x slower on TPU '
+                    '(PERF.md). Add the freqs to hyper (e.g. via '
+                    'KFACParamScheduler.params()) to enable the static '
+                    'fast path.')
     meters: dict[str, Metric] = {}
     t0 = time.perf_counter()
     n_batches = 0

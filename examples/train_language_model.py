@@ -212,7 +212,14 @@ def main(argv=None):
         like = ckpt_lib.bundle_state(
             state.params, state.opt_state, dkfac.state_dict(kstate), {},
             schedulers={'kfac': kfac_sched}, step=0)
-        restored = mgr.restore(like=like)
+        try:
+            restored = mgr.restore(like=like)
+        except Exception as e:
+            raise SystemExit(
+                f'cannot resume from {args.checkpoint_dir}: {e}\n'
+                'The checkpoint was likely written with a different '
+                'model/K-FAC configuration — pass --no-resume or a '
+                'fresh --checkpoint-dir.')
         state.params = restored['params']
         state.opt_state = restored['opt_state']
         state.kfac_state = dkfac.load_state_dict(restored['kfac'], params)
