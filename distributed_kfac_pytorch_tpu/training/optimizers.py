@@ -50,6 +50,9 @@ class OptimConfig:
     use_eigen_decomp: bool | None = None  # None: follow inverse_method
     inverse_method: str | None = None     # 'eigen' | 'cholesky' | 'newton'
     eigh_method: str = 'xla'              # 'xla' | 'jacobi'
+    # bf16 factor storage/comm AND bf16 covariance-matmul inputs (fp32
+    # accumulation) — the reference's --fp16 factor mode, done safely.
+    bf16_factors: bool = False
     skip_layers: Sequence[str] = ()
     symmetry_aware_comm: bool = False
     comm_method: str = 'comm-opt'
@@ -134,6 +137,9 @@ def get_optimizer(model, cfg: OptimConfig):
             use_eigen_decomp=cfg.use_eigen_decomp,
             inverse_method=cfg.inverse_method,
             eigh_method=cfg.eigh_method,
+            factor_dtype=jnp.bfloat16 if cfg.bf16_factors else None,
+            factor_compute_dtype=(jnp.bfloat16 if cfg.bf16_factors
+                                  else None),
             skip_layers=list(cfg.skip_layers) or None,
             symmetry_aware_comm=cfg.symmetry_aware_comm,
             comm_method=COMM_METHODS[cfg.comm_method.lower()],

@@ -78,6 +78,9 @@ def parse_args(argv=None):
     p.add_argument('--grad-worker-fraction', type=float, default=0.25)
     p.add_argument('--symmetry-aware-comm', action='store_true',
                    help='triu-packed factor allreduce (halved bytes)')
+    p.add_argument('--bf16-factors', action='store_true',
+                   help='bf16 factor storage + bf16 covariance matmuls '
+                        '(fp32 accumulation); the reference fp16 mode')
     return p.parse_args(argv)
 
 
@@ -103,7 +106,8 @@ def main(argv=None):
         damping_alpha=args.damping_alpha,
         damping_schedule=args.damping_decay,
         kfac_update_freq_alpha=args.kfac_update_freq_alpha,
-        kfac_update_freq_schedule=args.kfac_update_freq_decay)
+        kfac_update_freq_schedule=args.kfac_update_freq_decay,
+        bf16_factors=args.bf16_factors)
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(model, cfg)
 
     x0 = jnp.zeros((2, 32, 32, 3), jnp.float32)
@@ -162,6 +166,8 @@ def main(argv=None):
         try:
             restored = mgr.restore(like=like)
         except Exception as e:
+            import traceback
+            traceback.print_exc()  # keep the real cause diagnosable
             raise SystemExit(
                 f'cannot resume from {args.checkpoint_dir}: {e}\n'
                 'The checkpoint was likely written with a different '

@@ -85,8 +85,8 @@ def parse_args(argv=None):
     p.add_argument('--symmetry-aware-comm', action='store_true',
                    help='triu-packed factor allreduce (halved bytes)')
     p.add_argument('--bf16-factors', action='store_true',
-                   help='store/communicate factors in bfloat16 '
-                        '(decompositions stay fp32)')
+                   help='bf16 factor storage + bf16 covariance matmuls '
+                        '(fp32 accumulation); the reference fp16 mode')
     return p.parse_args(argv)
 
 
@@ -128,10 +128,9 @@ def main(argv=None):
         damping_alpha=args.damping_alpha,
         damping_schedule=args.damping_decay,
         kfac_update_freq_alpha=args.kfac_update_freq_alpha,
-        kfac_update_freq_schedule=args.kfac_update_freq_decay)
+        kfac_update_freq_schedule=args.kfac_update_freq_decay,
+        bf16_factors=args.bf16_factors)
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(model, cfg)
-    if kfac is not None and args.bf16_factors:
-        kfac.factor_dtype = jnp.bfloat16
 
     x0 = jnp.zeros((2, args.image_size, args.image_size, 3), jnp.float32)
     if kfac is not None:
@@ -190,6 +189,8 @@ def main(argv=None):
         try:
             restored = mgr.restore(like=like)
         except Exception as e:
+            import traceback
+            traceback.print_exc()  # keep the real cause diagnosable
             raise SystemExit(
                 f'cannot resume from {args.checkpoint_dir}: {e}\n'
                 'The checkpoint was likely written with a different '

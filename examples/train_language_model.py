@@ -99,6 +99,9 @@ def parse_args(argv=None):
     p.add_argument('--grad-worker-fraction', type=float, default=0.25)
     p.add_argument('--symmetry-aware-comm', action='store_true',
                    help='triu-packed factor allreduce (halved bytes)')
+    p.add_argument('--bf16-factors', action='store_true',
+                   help='bf16 factor storage + bf16 covariance matmuls '
+                        '(fp32 accumulation); the reference fp16 mode')
     return p.parse_args(argv)
 
 
@@ -144,7 +147,8 @@ def main(argv=None):
         kl_clip=args.kl_clip, inverse_method=args.inverse_method,
         skip_layers=args.skip_layers, comm_method=args.comm_method,
         grad_worker_fraction=args.grad_worker_fraction,
-        symmetry_aware_comm=args.symmetry_aware_comm)
+        symmetry_aware_comm=args.symmetry_aware_comm,
+        bf16_factors=args.bf16_factors)
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(model, cfg)
     if kfac is None:
         raise SystemExit('use --kfac-update-freq >= 1')
@@ -215,6 +219,8 @@ def main(argv=None):
         try:
             restored = mgr.restore(like=like)
         except Exception as e:
+            import traceback
+            traceback.print_exc()  # keep the real cause diagnosable
             raise SystemExit(
                 f'cannot resume from {args.checkpoint_dir}: {e}\n'
                 'The checkpoint was likely written with a different '

@@ -87,3 +87,31 @@ def test_repr_lists_hyperparams():
     assert 'damping: 0.02' in text
     assert "inverse_method: 'newton'" in text
     assert 'registered_layers' in text
+
+
+def test_bf16_factor_compute_close_to_fp32():
+    """bf16 covariance-matmul inputs (fp32 accumulation) track the fp32
+    factor statistics to bf16 input precision — the MXU fast path behind
+    OptimConfig.bf16_factors (see PERF.md)."""
+    x, y = _data()
+    model = MLP()
+
+    def factors_for(compute_dtype):
+        kfac = KFAC(model, factor_update_freq=1, inv_update_freq=1,
+                    damping=0.01, factor_compute_dtype=compute_dtype)
+        variables, state = kfac.init(jax.random.PRNGKey(0), x)
+        _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+            lambda out: optax.softmax_cross_entropy_with_integer_labels(
+                out, y).mean(), variables['params'], x)
+        _, new_state = kfac.step(state, grads, captures)
+        return new_state['factors']
+
+    f32 = factors_for(None)
+    bf16 = factors_for(jnp.bfloat16)
+    for a, b in zip(jax.tree.leaves(f32), jax.tree.leaves(bf16)):
+        assert b.dtype == jnp.float32  # accumulation/storage stay fp32
+        np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)
+    # And bf16 inputs genuinely change the bits (the cast really ran).
+    assert any(not np.allclose(a, b, rtol=1e-6, atol=1e-7)
+               for a, b in zip(jax.tree.leaves(f32),
+                               jax.tree.leaves(bf16)))
