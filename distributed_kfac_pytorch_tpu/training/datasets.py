@@ -100,7 +100,10 @@ def get_imagenet(data_dir: str | None = None, image_size: int = 224,
         return imagenet_tfdata(data_dir, image_size)
     train = _synthetic_images(synthetic_size, image_size, num_classes,
                               seed=0)
-    val = _synthetic_images(synthetic_size // 4, image_size, num_classes,
+    # Val split matches the train size: a fraction of it (the old
+    # synthetic_size // 4) was smaller than the default --val-batch-size,
+    # which yields ZERO full batches and silently empty val metrics.
+    val = _synthetic_images(synthetic_size, image_size, num_classes,
                             seed=1)
     norm = lambda x: (x - IMAGENET_MEAN) / IMAGENET_STD
     return (norm(train[0]), train[1]), (norm(val[0]), val[1])

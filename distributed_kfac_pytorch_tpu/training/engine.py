@@ -100,6 +100,12 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
         for k, v in metrics.items():
             meters.setdefault(k, Metric(k)).update(v)
     elapsed = time.perf_counter() - t0
+    if n_batches == 0:
+        raise ValueError(
+            'train_epoch: the batch iterator yielded ZERO batches — '
+            'usually batch_size larger than the dataset (full batches '
+            'are required for static shapes). Lower the batch size or '
+            'enlarge the dataset.')
     out = {k: m.avg for k, m in meters.items()}
     out['time_s'] = elapsed
     out['ms_per_iter'] = elapsed / max(n_batches, 1) * 1000.0
@@ -300,10 +306,18 @@ def evaluate(eval_step, state: TrainState, batches: Iterable, *,
              log_writer=None, verbose: bool = False) -> dict[str, float]:
     """Run the eval loop; returns averaged metrics."""
     meters: dict[str, Metric] = {}
+    n_batches = 0
     for batch in batches:
         metrics = eval_step(state.params, state.extra_vars, batch)
+        n_batches += 1
         for k, v in metrics.items():
             meters.setdefault(k, Metric(k)).update(v)
+    if n_batches == 0:
+        raise ValueError(
+            'evaluate: the batch iterator yielded ZERO batches — '
+            'usually val_batch_size larger than the val set (full '
+            'batches are required for static shapes). Lower the batch '
+            'size or enlarge the dataset.')
     out = {k: m.avg for k, m in meters.items()}
     if log_writer is not None:
         for k, v in out.items():

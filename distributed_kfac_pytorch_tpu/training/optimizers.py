@@ -50,8 +50,10 @@ class OptimConfig:
     use_eigen_decomp: bool | None = None  # None: follow inverse_method
     inverse_method: str | None = None     # 'eigen' | 'cholesky' | 'newton'
     eigh_method: str = 'xla'              # 'xla' | 'jacobi'
-    # bf16 factor storage/comm AND bf16 covariance-matmul inputs (fp32
-    # accumulation) — the reference's --fp16 factor mode, done safely.
+    # bf16 factor storage/averaging AND bf16 covariance-matmul inputs
+    # (the matmuls accumulate fp32; the EWMA running averages are kept in
+    # bf16) — the reference's --fp16 factor mode. For bf16 matmuls with
+    # fp32 running averages, pass factor_compute_dtype to KFAC directly.
     bf16_factors: bool = False
     skip_layers: Sequence[str] = ()
     symmetry_aware_comm: bool = False

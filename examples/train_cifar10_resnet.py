@@ -79,8 +79,9 @@ def parse_args(argv=None):
     p.add_argument('--symmetry-aware-comm', action='store_true',
                    help='triu-packed factor allreduce (halved bytes)')
     p.add_argument('--bf16-factors', action='store_true',
-                   help='bf16 factor storage + bf16 covariance matmuls '
-                        '(fp32 accumulation); the reference fp16 mode')
+                   help='bf16 factor storage/averaging + bf16 covariance '
+                        'matmul inputs (matmuls accumulate fp32); the '
+                        'reference fp16 factor mode')
     return p.parse_args(argv)
 
 

@@ -28,3 +28,11 @@ assert jax.default_backend() == 'cpu', (
     'tests must run on the virtual CPU mesh, got ' + jax.default_backend())
 assert jax.device_count() == 8, (
     f'expected 8 virtual CPU devices, got {jax.device_count()}')
+
+
+def pytest_configure(config):
+    # Compile-heavy tests (the flagship ResNet-50 distributed step, ~9
+    # min on CPU) carry @pytest.mark.slow. They RUN by default so the
+    # plain `pytest tests/` invocation covers the flagship path; skip
+    # them with `-m 'not slow'` or KFAC_SKIP_SLOW=1 for quick loops.
+    config.addinivalue_line('markers', 'slow: compile-heavy (~minutes)')
