@@ -72,7 +72,8 @@ def test_single_device_static_matches_dynamic():
     assert int(s_dyn['step']) == int(s_sta['step'])
 
 
-def _run_distributed(static_cadence, n_steps: int = 5):
+def _run_distributed(static_cadence, n_steps: int = 5,
+                     grad_accum_steps: int = 1):
     model = cifar_resnet.get_model('resnet20')
     kfac = KFAC(model, factor_update_freq=F_FREQ, inv_update_freq=I_FREQ,
                 damping=0.01, lr=0.05)
@@ -94,7 +95,8 @@ def _run_distributed(static_cadence, n_steps: int = 5):
             out, batch[1]).mean()
 
     step = dkfac.build_train_step(loss, tx, mutable_cols=('batch_stats',),
-                                  donate=False)
+                                  donate=False,
+                                  grad_accum_steps=grad_accum_steps)
     state = engine.TrainState(params, opt_state, dstate, extra)
     hyper = {'lr': 0.05, 'damping': 0.01,
              'factor_update_freq': F_FREQ, 'inv_update_freq': I_FREQ}
@@ -113,6 +115,16 @@ def test_distributed_static_matches_dynamic_via_train_epoch():
     # Params prove the whole pipeline (they flow through the inverse
     # stacks); the stacks themselves are skipped — eigenvector sign/
     # rotation is program-dependent (see the single-device test).
+    _assert_close(st_dyn.params, st_sta.params)
+    _assert_close(st_dyn.kfac_state['factors'],
+                  st_sta.kfac_state['factors'])
+
+
+def test_grad_accum_static_matches_dynamic():
+    """The micro-batch scan's statically-gated factor contraction (the
+    isinstance(do_factors, bool) branch) matches the traced-cond form."""
+    st_sta = _run_distributed('auto', n_steps=4, grad_accum_steps=2)
+    st_dyn = _run_distributed(None, n_steps=4, grad_accum_steps=2)
     _assert_close(st_dyn.params, st_sta.params)
     _assert_close(st_dyn.kfac_state['factors'],
                   st_sta.kfac_state['factors'])
