@@ -49,6 +49,9 @@ def parse_args(argv=None):
     p.add_argument('--batch-size', type=int, default=128,
                    help='global batch size (reference: per-GPU 128)')
     p.add_argument('--val-batch-size', type=int, default=128)
+    p.add_argument('--grad-accum', type=int, default=1,
+                   help='micro-batches per step (the reference '
+                        '--batches-per-allreduce)')
     p.add_argument('--epochs', type=int, default=100)
     p.add_argument('--base-lr', type=float, default=0.1)
     p.add_argument('--lr-decay', type=int, nargs='+', default=[35, 75, 90])
@@ -76,6 +79,9 @@ def parse_args(argv=None):
     p.add_argument('--comm-method', default='comm-opt',
                    choices=sorted(optimizers.COMM_METHODS))
     p.add_argument('--grad-worker-fraction', type=float, default=0.25)
+    p.add_argument('--coallocate-layer-factors', action='store_true',
+                   help='place A and G of a layer on the same worker '
+                        '(reference --coallocate-layer-factors)')
     p.add_argument('--symmetry-aware-comm', action='store_true',
                    help='triu-packed factor allreduce (halved bytes)')
     p.add_argument('--bf16-factors', action='store_true',
@@ -132,16 +138,21 @@ def main(argv=None):
         return {'acc': utils.accuracy(out, batch[1])}
 
     if kfac is not None:
-        dkfac = D.DistributedKFAC(kfac, mesh, params)
+        dkfac = D.DistributedKFAC(
+            kfac, mesh, params,
+            distribute_layer_factors=(
+                False if args.coallocate_layer_factors else None))
         kstate = dkfac.init_state(params)
         step_fn = dkfac.build_train_step(
             loss_fn, tx, metrics_fn=metrics_fn,
-            mutable_cols=('batch_stats',))
+            mutable_cols=('batch_stats',),
+            grad_accum_steps=args.grad_accum)
     else:  # --kfac-update-freq 0: plain SGD (reference optimizers.py:28)
         dkfac, kstate = None, None
         step_fn = engine.build_sgd_train_step(
             model, loss_fn, tx, mesh, metrics_fn=metrics_fn,
-            mutable_cols=('batch_stats',))
+            mutable_cols=('batch_stats',),
+            grad_accum_steps=args.grad_accum)
     eval_step = engine.make_eval_step(
         model, lambda out, b: utils.label_smooth_loss(out, b[1], 0.0),
         mesh, model_args_fn=lambda b: (b[0], False))

@@ -82,6 +82,9 @@ def parse_args(argv=None):
     p.add_argument('--comm-method', default='comm-opt',
                    choices=sorted(optimizers.COMM_METHODS))
     p.add_argument('--grad-worker-fraction', type=float, default=0.25)
+    p.add_argument('--coallocate-layer-factors', action='store_true',
+                   help='place A and G of a layer on the same worker '
+                        '(reference --coallocate-layer-factors)')
     p.add_argument('--symmetry-aware-comm', action='store_true',
                    help='triu-packed factor allreduce (halved bytes)')
     p.add_argument('--bf16-factors', action='store_true',
@@ -154,7 +157,10 @@ def main(argv=None):
         return {'acc': utils.accuracy(out, batch[1])}
 
     if kfac is not None:
-        dkfac = D.DistributedKFAC(kfac, mesh, params)
+        dkfac = D.DistributedKFAC(
+            kfac, mesh, params,
+            distribute_layer_factors=(
+                False if args.coallocate_layer_factors else None))
         kstate = dkfac.init_state(params)
         step_fn = dkfac.build_train_step(
             loss_fn, tx, metrics_fn=metrics_fn,
