@@ -4,9 +4,13 @@ Times the full jitted K-FAC + SGD training step on ResNet-32/CIFAR-10 at
 the reference CIFAR cadence (factors every iter, inverses every 10 —
 reference torch_cifar10_resnet.py:68-71) for each ``inverse_method``:
 
-  - eigen:    bucketed vmapped fp32 eigh (the reference's default path)
-  - cholesky: damped Cholesky inverse (reference --use-inv-kfac)
-  - newton:   matmul-only Newton-Schulz (Pallas VMEM-resident on TPU)
+  - eigen:      the default eigen path (eigh_method='auto': warm-start
+                matmul-only basis polish, ops.linalg.eigh_polish)
+  - eigen-xla:  bucketed vmapped backend eigh every firing (the
+                reference-style cold decomposition; data-dependent
+                runtime on TPU, PERF.md §6)
+  - cholesky:   damped Cholesky inverse (reference --use-inv-kfac)
+  - newton:     matmul-only Newton-Schulz (Pallas VMEM-resident on TPU)
 
 (For the plain-SGD floor / overhead ratio, see bench.py.) Run on the
 target chip:
@@ -32,8 +36,10 @@ from distributed_kfac_pytorch_tpu.models import cifar_resnet
 
 
 def build_kfac_step(model, x, y, method):
+    inverse_method, _, eigh = method.partition('-')
     kfac = KFAC(model, factor_update_freq=1, inv_update_freq=10,
-                damping=0.003, lr=0.1, inverse_method=method)
+                damping=0.003, lr=0.1, inverse_method=inverse_method,
+                eigh_method=eigh or 'auto')
     variables, kstate = kfac.init(jax.random.PRNGKey(0), x)
     params = variables['params']
     extra = {k: v for k, v in variables.items() if k != 'params'}
@@ -82,7 +88,7 @@ def main(argv=None):
                            0, 10)
 
     results = {}
-    for method in ('eigen', 'cholesky', 'newton'):
+    for method in ('eigen', 'eigen-xla', 'cholesky', 'newton'):
         step, state = build_kfac_step(model, x, y, method)
         results[method] = round(time_step(step, state, args.iters), 3)
     print(json.dumps({'model': args.model, 'batch': args.batch_size,

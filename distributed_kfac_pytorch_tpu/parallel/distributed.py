@@ -306,9 +306,14 @@ class DistributedKFAC:
         for dim, plan in self.assignment.buckets.items():
             n_slots = self.n_rows * plan.slots_per_row
             if self.kfac.use_eigen_decomp:
+                # Identity bases / unit eigenvalues: the exact
+                # eigendecomposition of the identity-seeded factors, and
+                # a valid warm start for the eigh_method='auto' polish
+                # from step 0 (see KFAC.init_state).
                 stacks[str(dim)] = {
-                    'Q': jnp.zeros((n_slots, dim, dim), idt),
-                    'd': jnp.zeros((n_slots, dim), idt)}
+                    'Q': jnp.broadcast_to(jnp.eye(dim, dtype=idt),
+                                          (n_slots, dim, dim)),
+                    'd': jnp.ones((n_slots, dim), idt)}
             else:
                 stacks[str(dim)] = {
                     'inv': jnp.zeros((n_slots, dim, dim), idt)}
@@ -410,7 +415,7 @@ class DistributedKFAC:
         eye = jnp.eye(plan.dim, dtype=jnp.float32)
         return jnp.stack([eye if m is None else m for m in mats])
 
-    def _spmd_update_inverses(self, factors, damping):
+    def _spmd_update_inverses(self, factors, damping, prev_stacks=None):
         """Sharded batched inverse computation + in-group all_gather.
 
         Each device decomposes its ``slots_per_col`` slice of its row's
@@ -418,10 +423,19 @@ class DistributedKFAC:
         SPMD form of "only the assigned rank computes",
         reference kfac/layers/base.py:249,294), then an ``all_gather``
         over ``kfac_gw`` reassembles the row's full inverse stack.
+
+        ``prev_stacks``: the state's previous inverse stacks. On the
+        eigen path they hold each slot's previous eigenbasis — this
+        device slices *its own slots'* bases (the stacks are
+        ``kfac_ig``-sharded and slot layout is static, so the slice
+        aligns with the factors being decomposed) and runs the
+        warm-start polish instead of a cold eigh (eigh_method 'auto').
         """
         kfac = self.kfac
         row = jax.lax.axis_index(INV_GROUP_AXIS)
         col = jax.lax.axis_index(GRAD_WORKER_AXIS)
+        eigh_method = ('auto' if kfac.eigh_method in ('auto', 'warm')
+                       else kfac.eigh_method)
         stacks = {}
         for dim, plan in self.assignment.buckets.items():
             full = self._build_bucket_stack(factors, plan)
@@ -430,8 +444,17 @@ class DistributedKFAC:
                 full, (row * plan.slots_per_row + col * s, 0, 0),
                 (s, dim, dim))
             if kfac.use_eigen_decomp:
-                q, d = linalg.batched_eigh(local, kfac.eigh_method,
-                                           clip=0.0)
+                q_prev = None
+                if prev_stacks is not None and eigh_method == 'auto':
+                    # Inside shard_map the stored stack is the *local*
+                    # row shard (slots_per_row, dim, dim): index by the
+                    # in-row column offset only.
+                    q_prev = jax.lax.dynamic_slice(
+                        prev_stacks[str(dim)]['Q'].astype(jnp.float32),
+                        (col * s, 0, 0), (s, dim, dim))
+                q, d = linalg.batched_eigh(
+                    local, eigh_method, clip=0.0, q_prev=q_prev,
+                    polish_iters=kfac.eigh_polish_iters)
                 q = jax.lax.all_gather(
                     q, GRAD_WORKER_AXIS, tiled=True)
                 d = jax.lax.all_gather(
@@ -597,7 +620,8 @@ class DistributedKFAC:
                                lambda: state['factors'])
         inv_stacks, diag_inv = cadence_gate(
             inv_update, step, i_freq,
-            lambda: self._spmd_update_inverses(factors, damping),
+            lambda: self._spmd_update_inverses(
+                factors, damping, prev_stacks=state['inv_stacks']),
             lambda: (state['inv_stacks'], state['diag_inv']))
 
         precond = self._spmd_precondition(inv_stacks, diag_inv, grads,
@@ -640,12 +664,31 @@ class DistributedKFAC:
                 f'{sorted(sd["factors"])} vs {sorted(state["factors"])}')
         state = {**state, 'step': jnp.asarray(sd['step'], jnp.int32),
                  'factors': sd['factors']}
-        if 'inv_stacks' in sd:
+        if 'inv_stacks' in sd and not self._degenerate_stacks(
+                sd['inv_stacks']):
             state = {**state, 'inv_stacks': sd['inv_stacks'],
                      'diag_inv': sd['diag_inv']}
         else:
             state = self.recompute_inverses(state, damping=damping)
         return state
+
+    def _degenerate_stacks(self, inv_stacks: dict) -> bool:
+        """True if any stored eigenbasis stack is unusable (all-zero).
+
+        Pre-warm-eigh checkpoints stored zero-initialized Q stacks;
+        Q=0 is a fixed point of the warm polish (see
+        preconditioner._degenerate_bases), so such checkpoints must be
+        rebuilt from factors instead of warm-started.
+        """
+        if not self.kfac.use_eigen_decomp:
+            return False
+        for entry in inv_stacks.values():
+            if 'Q' in entry:
+                q = np.asarray(entry['Q'])
+                if float(np.linalg.norm(q)) < 0.5 * np.sqrt(
+                        q.shape[0] * q.shape[-1]):
+                    return True
+        return False
 
     def recompute_inverses(self, state: dict,
                            damping: float | None = None) -> dict:

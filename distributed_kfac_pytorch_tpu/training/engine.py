@@ -82,6 +82,22 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
                     '(PERF.md). Add the freqs to hyper (e.g. via '
                     'KFACParamScheduler.params()) to enable the static '
                     'fast path.')
+    if (static_cadence is not None and isinstance(state.kfac_state, dict)
+            and 'step' in state.kfac_state):
+        # Static cadence is only correct while the host counter driving
+        # the factor/inverse flags stays in phase with the on-device
+        # K-FAC counter (a caller that rebuilds TrainState without
+        # restoring ``step`` would silently shift the schedule). Checked
+        # BEFORE the epoch so a desynced state cannot train a whole
+        # epoch on the wrong schedule; one device sync per epoch.
+        kstep = int(jax.device_get(state.kfac_state['step']))
+        if kstep != state.step:
+            raise RuntimeError(
+                f'static-cadence phase error: host step counter '
+                f'{state.step} != on-device K-FAC step {kstep}. '
+                'TrainState.step must be restored alongside kfac_state '
+                '(checkpoint resume restores both; see '
+                "MIGRATION.md 'Checkpoint format').")
     meters: dict[str, Metric] = {}
     t0 = time.perf_counter()
     n_batches = 0

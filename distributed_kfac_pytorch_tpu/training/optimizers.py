@@ -49,7 +49,10 @@ class OptimConfig:
     kl_clip: float = 0.001
     use_eigen_decomp: bool | None = None  # None: follow inverse_method
     inverse_method: str | None = None     # 'eigen' | 'cholesky' | 'newton'
-    eigh_method: str = 'xla'              # 'xla' | 'jacobi'
+    # 'auto' (default): warm-start basis polish seeded from the state's
+    # previous eigenbasis (the TPU fast path — see ops.linalg.eigh_polish);
+    # 'xla' | 'jacobi' | 'warm' as in KFAC.
+    eigh_method: str = 'auto'
     # bf16 factor storage/averaging AND bf16 covariance-matmul inputs
     # (the matmuls accumulate fp32; the EWMA running averages are kept in
     # bf16) — the reference's --fp16 factor mode. For bf16 matmuls with

@@ -70,6 +70,11 @@ def parse_args(argv=None):
                    default=[])
     p.add_argument('--use-inv-kfac', action='store_true',
                    help='Cholesky inverse method instead of eigen')
+    p.add_argument('--eigh-method', default='auto',
+                   choices=['auto', 'xla', 'jacobi', 'warm'],
+                   help='eigen-path decomposition backend; auto = '
+                        'warm-start matmul-only basis polish (TPU '
+                        'fast path)')
     p.add_argument('--stat-decay', type=float, default=0.95)
     p.add_argument('--damping', type=float, default=0.003)
     p.add_argument('--damping-alpha', type=float, default=0.5)
@@ -107,6 +112,7 @@ def main(argv=None):
         kfac_cov_update_freq=args.kfac_cov_update_freq,
         damping=args.damping, factor_decay=args.stat_decay,
         kl_clip=args.kl_clip, use_eigen_decomp=not args.use_inv_kfac,
+        eigh_method=args.eigh_method,
         skip_layers=args.skip_layers, comm_method=args.comm_method,
         grad_worker_fraction=args.grad_worker_fraction,
         symmetry_aware_comm=args.symmetry_aware_comm,
@@ -183,8 +189,10 @@ def main(argv=None):
             raise SystemExit(
                 f'cannot resume from {args.checkpoint_dir}: {e}\n'
                 'The checkpoint was likely written with a different '
-                'K-FAC configuration — pass --no-resume or a fresh '
-                '--checkpoint-dir.')
+                'K-FAC configuration, or by a version predating the '
+                'scalars/scheduler checkpoint-format extension (see '
+                'MIGRATION.md "Checkpoint format") — pass --no-resume '
+                'or a fresh --checkpoint-dir.')
         state.params = restored['params']
         state.opt_state = restored['opt_state']
         if dkfac:

@@ -87,6 +87,11 @@ def parse_args(argv=None):
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
     p.add_argument('--inverse-method', default='eigen',
                    choices=['eigen', 'cholesky', 'newton'])
+    p.add_argument('--eigh-method', default='auto',
+                   choices=['auto', 'xla', 'jacobi', 'warm'],
+                   help='eigen-path decomposition backend; auto = '
+                        'warm-start matmul-only basis polish (TPU '
+                        'fast path)')
     p.add_argument('--stat-decay', type=float, default=0.95)
     p.add_argument('--damping', type=float, default=0.003)
     p.add_argument('--kl-clip', type=float, default=0.001)
@@ -146,6 +151,7 @@ def main(argv=None):
         kfac_cov_update_freq=args.kfac_cov_update_freq,
         damping=args.damping, factor_decay=args.stat_decay,
         kl_clip=args.kl_clip, inverse_method=args.inverse_method,
+        eigh_method=args.eigh_method,
         skip_layers=args.skip_layers, comm_method=args.comm_method,
         grad_worker_fraction=args.grad_worker_fraction,
         symmetry_aware_comm=args.symmetry_aware_comm,
@@ -225,8 +231,10 @@ def main(argv=None):
             raise SystemExit(
                 f'cannot resume from {args.checkpoint_dir}: {e}\n'
                 'The checkpoint was likely written with a different '
-                'model/K-FAC configuration — pass --no-resume or a '
-                'fresh --checkpoint-dir.')
+                'model/K-FAC configuration, or by a version predating '
+                'the scalars/scheduler checkpoint-format extension (see '
+                'MIGRATION.md "Checkpoint format") — pass --no-resume '
+                'or a fresh --checkpoint-dir.')
         state.params = restored['params']
         state.opt_state = restored['opt_state']
         state.kfac_state = dkfac.load_state_dict(restored['kfac'], params)

@@ -253,8 +253,16 @@ def test_grad_accumulation_matches_single_pass():
     single-pass step to fp tolerance.
     """
     model = SmallCNN()
+    # eigh_method='xla': this test's subject is the accumulation
+    # arithmetic. Early-training factors are near-identity (clustered
+    # eigenvalues), where the warm polish's basis choice is chaotic in
+    # fp-associativity-level input differences between the accum and
+    # single-pass paths — the preconditioned output difference stays at
+    # the harmless cluster-spread level, but it breaks elementwise
+    # comparison at these tolerances (see tests/test_warm_eigh.py for
+    # the warm path's own accuracy coverage).
     kfac = KFAC(model, factor_update_freq=1, inv_update_freq=2,
-                damping=0.003, lr=0.1)
+                damping=0.003, lr=0.1, eigh_method='xla')
     x = jax.random.normal(jax.random.PRNGKey(1), (32, 8, 8, 3))
     y = jax.random.randint(jax.random.PRNGKey(2), (32,), 0, 10)
     variables, _ = kfac.init(jax.random.PRNGKey(0), x)

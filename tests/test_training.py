@@ -180,7 +180,8 @@ class TestEngine:
     def test_train_epoch_and_eval(self):
         (model, dkfac, tx, step_fn, state, data, mesh,
          loss_fn) = _small_setup()
-        hyper = {'lr': 0.05, 'damping': 0.003}
+        hyper = {'lr': 0.05, 'damping': 0.003,
+                 'factor_update_freq': 1, 'inv_update_freq': 2}
         m = engine.train_epoch(step_fn, state, data, hyper)
         assert set(m) >= {'loss', 'acc', 'time_s', 'ms_per_iter'}
         assert np.isfinite(m['loss'])
@@ -191,6 +192,18 @@ class TestEngine:
             model, loss_fn, mesh, model_args_fn=lambda b: (b[0], False))
         em = engine.evaluate(eval_step, state, data)
         assert np.isfinite(em['loss']) and 0.0 <= em['acc'] <= 1.0
+
+    def test_static_cadence_phase_mismatch_raises(self):
+        """A host step counter out of phase with the on-device K-FAC
+        counter silently shifts the factor/inverse schedule — the epoch
+        loop asserts the invariant at epoch boundaries (ADVICE r1)."""
+        (model, dkfac, tx, step_fn, state, data, mesh,
+         loss_fn) = _small_setup()
+        hyper = {'lr': 0.05, 'damping': 0.003,
+                 'factor_update_freq': 1, 'inv_update_freq': 2}
+        state.step = 7  # e.g. TrainState rebuilt without restoring step
+        with pytest.raises(RuntimeError, match='phase error'):
+            engine.train_epoch(step_fn, state, data, hyper)
 
     def test_eval_step_single_device(self):
         model = cifar_resnet.get_model('resnet20')
@@ -210,7 +223,8 @@ class TestCheckpoint:
     def test_roundtrip_and_auto_resume(self, tmp_path):
         (model, dkfac, tx, step_fn, state, data, mesh,
          loss_fn) = _small_setup()
-        hyper = {'lr': 0.05, 'damping': 0.003}
+        hyper = {'lr': 0.05, 'damping': 0.003,
+                 'factor_update_freq': 1, 'inv_update_freq': 2}
         engine.train_epoch(step_fn, state, data, hyper)
 
         mgr = ckpt_lib.CheckpointManager(str(tmp_path / 'ckpt'))
@@ -236,7 +250,8 @@ class TestCheckpoint:
     def test_factor_only_checkpoint_recomputes_inverses(self, tmp_path):
         (model, dkfac, tx, step_fn, state, data, mesh,
          loss_fn) = _small_setup()
-        hyper = {'lr': 0.05, 'damping': 0.003}
+        hyper = {'lr': 0.05, 'damping': 0.003,
+                 'factor_update_freq': 1, 'inv_update_freq': 2}
         engine.train_epoch(step_fn, state, data, hyper)
         sd = dkfac.state_dict(state.kfac_state, include_inverses=False)
         assert 'inv_stacks' not in sd
