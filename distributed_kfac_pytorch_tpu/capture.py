@@ -76,27 +76,46 @@ def _canonical_padding(padding, n_spatial: int):
     return out
 
 
+def _conv_decline_reason(mod: nn.Conv) -> str | None:
+    """Why a conv-family module cannot be K-FAC-preconditioned, or None.
+
+    These are the configurations the factor math does not model (the
+    reference's registry simply has no layer class for them either,
+    kfac/layers/__init__.py:13-36 — but it *errors* on the module kinds
+    it refuses, :31-33, where silence here would hide a partially
+    preconditioned model).
+    """
+    if type(mod) is not nn.Conv:
+        return (f'nn.Conv subclass {type(mod).__name__} (capture only '
+                'matches exact nn.Conv; its call/patch semantics may '
+                'differ)')
+    if mod.feature_group_count != 1:
+        return (f'grouped/depthwise conv (feature_group_count='
+                f'{mod.feature_group_count})')
+    dilation = mod.kernel_dilation
+    if dilation is not None and any(
+            d != 1 for d in (dilation if isinstance(dilation, Sequence)
+                             else (dilation,))):
+        return f'dilated conv (kernel_dilation={dilation})'
+    if len(tuple(mod.kernel_size)) != 2:
+        return f'non-2D conv (kernel_size={tuple(mod.kernel_size)})'
+    return None
+
+
 def _spec_for_module(mod: nn.Module, path: tuple[str, ...],
                      num_calls: int) -> LayerSpec | None:
     """Build a LayerSpec for a supported flax module, else None.
 
     Mirrors the registry dispatch in reference kfac/layers/__init__.py:13-36
     (module type -> KFACLayer class), with unsupported configurations
-    (grouped/dilated convs) skipped rather than mis-modelled.
+    (grouped/dilated convs) skipped rather than mis-modelled (declines are
+    recorded and reported — see KFACCapture.skipped_modules).
     """
     if isinstance(mod, nn.Dense):
         return LayerSpec(path=path, kind=LINEAR, has_bias=mod.use_bias,
                          num_calls=num_calls)
-    if type(mod) is nn.Conv:
-        if mod.feature_group_count != 1:
-            return None
-        dilation = mod.kernel_dilation
-        if dilation is not None and any(
-                d != 1 for d in (dilation if isinstance(dilation, Sequence)
-                                 else (dilation,))):
-            return None
-        kernel_size = tuple(mod.kernel_size)
-        if len(kernel_size) != 2:
+    if isinstance(mod, nn.Conv):
+        if _conv_decline_reason(mod) is not None:
             return None
         strides = mod.strides
         if strides is None:
@@ -106,7 +125,8 @@ def _spec_for_module(mod: nn.Module, path: tuple[str, ...],
         else:
             strides = tuple(strides)
         return LayerSpec(path=path, kind=CONV2D, has_bias=mod.use_bias,
-                         num_calls=num_calls, kernel_size=kernel_size,
+                         num_calls=num_calls,
+                         kernel_size=tuple(mod.kernel_size),
                          strides=strides,
                          padding=_canonical_padding(mod.padding, 2))
     if isinstance(mod, nn.Embed):
@@ -140,13 +160,14 @@ class KFACCapture:
             skip_layers = [skip_layers]
         self.skip_layers = frozenset(s.lower() for s in skip_layers)
         self._specs: dict[str, LayerSpec] | None = None
+        self._skipped: dict[str, str] = {}
 
     # -- registration ------------------------------------------------------
 
     def _module_path(self, mod: nn.Module) -> tuple[str, ...]:
         return tuple(mod.path)
 
-    def _skipped(self, mod: nn.Module, path: tuple[str, ...]) -> bool:
+    def _is_skipped(self, mod: nn.Module, path: tuple[str, ...]) -> bool:
         if type(mod).__name__.lower() in self.skip_layers:
             return True
         return any(part.lower() in self.skip_layers for part in path)
@@ -159,9 +180,15 @@ class KFACCapture:
             if context.method_name != '__call__' or mod is None:
                 return next_fun(*args, **kwargs)
             path = self._module_path(mod)
-            if self._skipped(mod, path):
+            if self._is_skipped(mod, path):
+                if record_specs and path:
+                    self._skipped['/'.join(path)] = 'skip_layers match'
                 return next_fun(*args, **kwargs)
             if _spec_for_module(mod, path, 1) is None:
+                if record_specs and isinstance(mod, nn.Conv):
+                    reason = _conv_decline_reason(mod)
+                    if reason:
+                        self._skipped['/'.join(path)] = reason
                 return next_fun(*args, **kwargs)
             # Dense/Conv/Embed all name their input 'inputs'; support both
             # positional and keyword call styles.
@@ -199,12 +226,63 @@ class KFACCapture:
         depend only on structure, so the twin's registration is exact.
         """
         self._specs = {}
+        self._skipped = {}
         model = self.model if init_model is None else init_model
         with nn.intercept_methods(self._make_interceptor(record_specs=True)):
             variables = model.init(rng, *args, **kwargs)
         variables = dict(variables)
         variables.pop(CAPTURE_COL, None)
+        self._record_unregistered_params(variables.get('params', {}))
+        declined = {n: r for n, r in self._skipped.items()
+                    if 'conv' in r.lower()}
+        if declined:
+            # The reference hard-errors on module kinds it refuses
+            # (kfac/layers/__init__.py:31-33); silence here would hide a
+            # partially preconditioned model, so be loud about the convs
+            # K-FAC *should* cover but cannot.
+            import warnings
+            lines = ', '.join(f'{n} ({r})' for n, r in declined.items())
+            warnings.warn(
+                f'K-FAC cannot precondition {len(declined)} conv '
+                f'module(s); their params get plain gradients: {lines}. '
+                'See KFACCapture.skipped_modules for the full report.')
         return variables, dict(self._specs)
+
+    def _record_unregistered_params(self, params) -> None:
+        """Record parameterized modules that registration never covered.
+
+        Walks the params tree for leaf-parent paths (modules holding
+        arrays directly). Anything not a registered layer and not already
+        recorded gets a generic 'unsupported module type' entry — e.g.
+        BatchNorm scale/bias (benign: the reference never preconditions
+        normalization layers either) or custom modules with params.
+        """
+        def walk(node, path):
+            if not isinstance(node, dict):
+                return
+            if any(not isinstance(v, dict) for v in node.values()):
+                # Direct array leaves: this path is a parameterized
+                # module. Do NOT return — a module may hold its own
+                # params AND nested parameterized submodules.
+                name = '/'.join(path)
+                if name not in self._specs and name not in self._skipped:
+                    self._skipped[name] = (
+                        'unsupported module type (params receive plain '
+                        'gradients)')
+            for key, child in node.items():
+                walk(child, path + (key,))
+
+        walk(params, ())
+
+    @property
+    def skipped_modules(self) -> dict[str, str]:
+        """{module path: reason} for every parameterized module K-FAC does
+        not precondition — skip_layers matches, declined conv configs
+        (grouped/dilated/non-2D/subclass), and unsupported kinds. The
+        loud-report answer to the reference's silent partial coverage
+        (it errors only on RNNCellBase, kfac/layers/__init__.py:31-33).
+        """
+        return dict(self._skipped)
 
     @property
     def specs(self) -> dict[str, LayerSpec]:

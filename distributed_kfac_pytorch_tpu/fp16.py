@@ -1,0 +1,112 @@
+"""fp16 robustness: non-finite capture filtering + dynamic loss scaling.
+
+Reference parity for the GradScaler integration
+(kfac/layers/base.py:374-407 and kfac/preconditioner.py:12-16): the
+reference unscales grad-output captures by the live GradScaler scale and
+*drops* inf/NaN tensors at hook time with a warning; its training loop
+rides ``torch.cuda.amp.GradScaler``'s dynamic scale. TPU bf16 needs none
+of this (no loss scaling required — the default path), so everything
+here is opt-in for true-fp16 runs.
+
+jit-friendly redesign of both pieces:
+
+  - dropping a tensor is a dynamic shape — the SPMD equivalent is
+    *zeroing* it (:func:`sanitize_captures`): a zeroed call contributes
+    nothing to the factor covariance sum, which is exactly what the
+    reference's drop does to the accumulated average (the next EWMA
+    update then averages over slightly fewer effective samples). The
+    number of zeroed tensors is returned as an on-device count for the
+    caller's metrics (a Python-side warning inside jit is impossible;
+    the count is the observable).
+  - GradScaler's schedule becomes a pure state transition
+    (:func:`init_loss_scale` / :func:`update_loss_scale`): halve on any
+    non-finite gradient and skip the step, double after
+    ``growth_interval`` consecutive finite steps — the standard AMP
+    policy, as a pytree usable inside one jitted train step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _tensor_finite(x) -> jax.Array:
+    return jnp.isfinite(x.astype(jnp.float32)).all()
+
+
+def tree_all_finite(tree) -> jax.Array:
+    """Scalar bool: every element of every leaf is finite."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack([_tensor_finite(x) for x in leaves]).all()
+
+
+def sanitize_captures(captures: dict) -> tuple[dict, jax.Array]:
+    """Zero out non-finite per-call capture tensors; count them.
+
+    The jit-friendly analogue of the reference's hook-time drop of
+    inf/NaN grad-output batches (kfac/layers/base.py:397-407): a tensor
+    with *any* non-finite element is replaced by zeros (whole-tensor,
+    like the reference's whole-batch drop — partial masking would bias
+    the covariance). Returns ``(clean_captures, n_zeroed)`` with
+    ``n_zeroed`` an on-device int32 count suitable for metrics.
+    """
+    count = jnp.zeros((), jnp.int32)
+    out = {}
+    for name, entry in captures.items():
+        clean = {}
+        for key in ('a', 'g'):
+            calls = []
+            for x in entry[key]:
+                ok = _tensor_finite(x)
+                count = count + jnp.where(ok, 0, 1).astype(jnp.int32)
+                calls.append(jnp.where(ok, x, jnp.zeros_like(x)))
+            clean[key] = tuple(calls)
+        out[name] = clean
+    return out, count
+
+
+def init_loss_scale(initial: float = 2.0 ** 15) -> dict:
+    """Fresh dynamic-loss-scale state (AMP GradScaler defaults)."""
+    return {'scale': jnp.asarray(initial, jnp.float32),
+            'growth_count': jnp.zeros((), jnp.int32)}
+
+
+def update_loss_scale(state: dict, grads_finite,
+                      growth_interval: int = 2000,
+                      growth_factor: float = 2.0,
+                      backoff_factor: float = 0.5,
+                      min_scale: float = 1.0,
+                      max_scale: float = 2.0 ** 24) -> dict:
+    """One GradScaler schedule step (pure).
+
+    ``grads_finite``: scalar bool (e.g. ``tree_all_finite(grads)``).
+    On overflow the scale backs off and the growth counter resets; after
+    ``growth_interval`` consecutive finite steps the scale doubles.
+    The *caller* skips the parameter update on overflow (see
+    :func:`apply_if_finite`).
+    """
+    grads_finite = jnp.asarray(grads_finite)
+    grew = state['growth_count'] + 1
+    do_grow = grads_finite & (grew >= growth_interval)
+    new_scale = jnp.where(
+        grads_finite,
+        jnp.where(do_grow, state['scale'] * growth_factor,
+                  state['scale']),
+        state['scale'] * backoff_factor)
+    new_scale = jnp.clip(new_scale, min_scale, max_scale)
+    new_count = jnp.where(grads_finite & ~do_grow, grew, 0)
+    return {'scale': new_scale, 'growth_count': new_count}
+
+
+def apply_if_finite(grads_finite, new_tree, old_tree):
+    """Select ``new_tree`` when grads were finite, else keep ``old_tree``.
+
+    The jit form of GradScaler's skipped ``optimizer.step()`` on
+    overflow: apply to (params, opt_state, kfac_state, ...) pairs.
+    """
+    grads_finite = jnp.asarray(grads_finite)
+    return jax.tree.map(
+        lambda n, o: jnp.where(grads_finite, n, o), new_tree, old_tree)

@@ -26,11 +26,34 @@ def initialize_multihost(coordinator_address: str | None = None,
     ``jax.distributed.initialize`` does; explicit arguments override.
     Returns a summary dict (process_index, process_count, device counts).
     """
-    explicit = coordinator_address or num_processes or process_id
+    # Manual launch support: JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES
+    # / JAX_PROCESS_ID env vars (jax.distributed.initialize itself only
+    # auto-detects SLURM / Open MPI / TPU-pod environments) — the
+    # generic analogue of the reference's MASTER_ADDR / RANK env chain
+    # (launch_node_torch_imagenet.sh:45-68).
+    if coordinator_address is None:
+        coordinator_address = os.environ.get('JAX_COORDINATOR_ADDRESS')
+    if num_processes is None and \
+            os.environ.get('JAX_NUM_PROCESSES', '').isdigit():
+        num_processes = int(os.environ['JAX_NUM_PROCESSES'])
+    if process_id is None and \
+            os.environ.get('JAX_PROCESS_ID', '').isdigit():
+        process_id = int(os.environ['JAX_PROCESS_ID'])
+    explicit = (coordinator_address or num_processes
+                or process_id is not None)
     multi_env = any(v in os.environ for v in (
-        'SLURM_JOB_ID', 'OMPI_COMM_WORLD_SIZE', 'TPU_WORKER_HOSTNAMES',
-        'JAX_COORDINATOR_ADDRESS'))
+        'SLURM_JOB_ID', 'OMPI_COMM_WORLD_SIZE', 'TPU_WORKER_HOSTNAMES'))
     if explicit or multi_env:
+        try:
+            # Cross-process collectives on the CPU backend need an
+            # implementation selected before the backend initializes;
+            # harmless on TPU (ICI/DCN collectives are native). This is
+            # what lets the multi-host path run on plain hosts (and the
+            # 2-process integration test, tests/test_multihost.py).
+            jax.config.update('jax_cpu_collectives_implementation',
+                              'gloo')
+        except Exception:  # config knob absent/renamed: non-fatal
+            pass
         try:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
@@ -90,3 +113,99 @@ def process_local_slice(n_global: int) -> slice:
     per = n_global // jax.process_count()
     start = jax.process_index() * per
     return slice(start, start + per)
+
+
+def global_batches(mesh, batches, batch_spec=None, *,
+                   already_sharded: bool = False):
+    """Adapt an iterator of host-identical global batches for multi-host.
+
+    The multi-host feeding glue between a dataset iterator and a jitted
+    ``shard_map`` train step — the analogue of the reference's
+    ``DistributedSampler`` + per-rank loader chain
+    (examples/cnn_utils/datasets.py:53-68, launch chain
+    launch_node_torch_imagenet.sh:45-68 -> torch_imagenet_resnet.py:113):
+
+      - single-process: yields batches unchanged (jit shards them onto
+        the local mesh per its in_specs — no wrapping needed);
+      - multi-process: every host generates the *same* global batch
+        (same seed/epoch => same permutation, like DistributedSampler's
+        shared-seed shuffle); each host keeps only its
+        :func:`process_local_slice` of every batch-sharded leaf and
+        assembles one global ``jax.Array`` per leaf spec, so the jitted
+        step sees a fully-addressable global batch.
+
+    ``batch_spec``: a single PartitionSpec (broadcast over leaves) or a
+    pytree of specs matching the batch — same convention as
+    ``DistributedKFAC.build_train_step``. ``None`` defaults to sharding
+    the leading dim over the K-FAC mesh axes. Leaves with a
+    fully-replicated spec (``P()``) are passed whole from every host.
+    Supported specs shard the *leading* dim across processes; later
+    spec dims may only map to mesh axes contained within one process
+    (e.g. single-host sequence parallelism) — anything else raises.
+
+    ``already_sharded=True``: the iterator yields *per-process local*
+    batches (e.g. a tf.data pipeline sharded with
+    ``ds.shard(process_count, process_index)``) — no slicing, each
+    host's data is used as its local shard directly. Prefer this at
+    scale: the default shared-global-batch mode costs every host the
+    full global input pipeline (simple and exact for in-memory
+    datasets, wasteful for a 32-host ImageNet job).
+    """
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    if jax.process_count() == 1:
+        yield from batches
+        return
+    from distributed_kfac_pytorch_tpu.parallel.distributed import (
+        KFAC_AXES,
+        normalize_batch_specs,
+    )
+    if batch_spec is None:
+        batch_spec = P(KFAC_AXES)
+    nproc = jax.process_count()
+
+    def axis_spans_processes(name) -> bool:
+        """Does moving along mesh axis ``name`` cross a process?"""
+        idx = mesh.axis_names.index(name)
+        rows = np.moveaxis(mesh.devices, idx, -1)
+        rows = rows.reshape(-1, rows.shape[-1])
+        return any(len({d.process_index for d in row}) > 1
+                   for row in rows)
+
+    def _axes(entry):
+        if entry is None:
+            return ()
+        return entry if isinstance(entry, tuple) else (entry,)
+
+    def check_spec(spec):
+        for entry in tuple(spec)[1:]:
+            for ax in _axes(entry):
+                if axis_spans_processes(ax):
+                    raise NotImplementedError(
+                        f'global_batches only shards the leading batch '
+                        f'dim across processes; spec {spec} shards a '
+                        f'later dim over mesh axis {ax!r} which spans '
+                        'multiple processes — assemble such leaves '
+                        'yourself with host_local_batch_to_global')
+
+    def assemble(x, spec):
+        sharding = NamedSharding(mesh, spec)
+        if spec == P():
+            return jax.make_array_from_process_local_data(
+                sharding, np.asarray(x))
+        check_spec(spec)
+        if already_sharded:
+            return jax.make_array_from_process_local_data(
+                sharding, np.asarray(x))
+        n = x.shape[0]
+        if n % nproc:
+            raise ValueError(
+                f'global batch of {n} does not divide evenly over '
+                f'{nproc} processes')
+        local = np.asarray(x)[process_local_slice(n)]
+        return jax.make_array_from_process_local_data(sharding, local)
+
+    for batch in batches:
+        specs = normalize_batch_specs(batch_spec, batch)
+        yield jax.tree.map(assemble, batch, specs)

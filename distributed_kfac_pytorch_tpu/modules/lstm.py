@@ -86,7 +86,37 @@ class LSTMLayer(nn.Module):
     reverse: bool = False
 
     @nn.compact
-    def __call__(self, xs, state=None):
+    def __call__(self, xs, state=None, lengths=None):
+        """``lengths``: optional (batch,) int array of valid sequence
+        lengths — the jit-friendly ``PackedSequence`` analogue (reference
+        kfac/modules/lstm.py:120-225). Every timestep still executes
+        (static shapes), but for rows past their length:
+
+          - the cell *inputs* (x_t and the recurrent h) are zeroed, so
+            the K-FAC ``a`` captures of those rows are zero and
+            contribute nothing to the factor covariance;
+          - the state is carried through unchanged (forward: the final
+            state is the state at the last valid step; reverse: the
+            run effectively starts at each row's last valid token);
+          - outputs at padded positions are zero (packed-unpack
+            convention), so a loss that masks padded targets sends zero
+            gradient into those cell calls — the ``g`` captures of
+            padded rows are zero too.
+
+        Note on factor normalization: covariance averages divide by the
+        full padded ``batch * time`` row count (a static shape), not the
+        valid-token count. Relative to a truly packed implementation
+        (the reference divides by the shrinking packed batch) this
+        scales the weight blocks of A and G by ``valid / (B * T)``; for
+        *biased* layers the homogeneous bias coordinate of A is NOT
+        scaled (every row's implicit 1 still counts — a zeroed row
+        contributes ``e_bias e_bias^T``), so the bias coordinate's
+        relative curvature is overestimated by up to ``B*T/valid`` and
+        its preconditioned update correspondingly damped. Exact packed
+        statistics would need the capture pipeline to carry per-row
+        masks into the factor math; with typical padding fractions the
+        distortion is modest and affects bias updates only.
+        """
         cell_cls = LSTMCellKFAC if self.kfac_cell else LSTMCell
         cell = cell_cls(self.hidden_size, name='cell')
         batch = xs.shape[0]
@@ -98,7 +128,16 @@ class LSTMLayer(nn.Module):
             steps = reversed(list(steps))
         outs = []
         for t in steps:
-            y, state = cell(xs[:, t], state)
+            if lengths is None:
+                y, state = cell(xs[:, t], state)
+            else:
+                mask = (t < lengths).astype(xs.dtype)[:, None]
+                h_old, c_old = state
+                y_new, (h_new, c_new) = cell(
+                    xs[:, t] * mask, (h_old * mask, c_old * mask))
+                state = (jnp.where(mask > 0, h_new, h_old),
+                         jnp.where(mask > 0, c_new, c_old))
+                y = y_new * mask
             outs.append(y)
         if self.reverse:
             outs = outs[::-1]
@@ -119,7 +158,8 @@ class LSTM(nn.Module):
     kfac_cell: bool = True
 
     @nn.compact
-    def __call__(self, xs, states=None, *, train: bool = True):
+    def __call__(self, xs, states=None, *, lengths=None,
+                 train: bool = True):
         n_dirs = 2 if self.bidirectional else 1
         if states is None:
             states = [None] * (self.num_layers * n_dirs)
@@ -132,7 +172,7 @@ class LSTM(nn.Module):
                 seq, st = LSTMLayer(
                     self.hidden_size, kfac_cell=self.kfac_cell,
                     reverse=(d == 1), name=f'layer{layer}_d{d}')(
-                        out, states[idx])
+                        out, states[idx], lengths=lengths)
                 dirs.append(seq)
                 new_states.append(st)
             out = dirs[0] if n_dirs == 1 else jnp.concatenate(dirs, -1)

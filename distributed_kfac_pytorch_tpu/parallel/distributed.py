@@ -62,6 +62,8 @@ from distributed_kfac_pytorch_tpu.preconditioner import (
     KFAC,
     CommMethod,
     cadence_gate,
+    q_stack_degenerate,
+    resolve_eigh_method,
 )
 
 # Mesh axis names. Batch/data parallelism shards over both axes jointly;
@@ -434,8 +436,7 @@ class DistributedKFAC:
         kfac = self.kfac
         row = jax.lax.axis_index(INV_GROUP_AXIS)
         col = jax.lax.axis_index(GRAD_WORKER_AXIS)
-        eigh_method = ('auto' if kfac.eigh_method in ('auto', 'warm')
-                       else kfac.eigh_method)
+        eigh_method = resolve_eigh_method(kfac.eigh_method)
         stacks = {}
         for dim, plan in self.assignment.buckets.items():
             full = self._build_bucket_stack(factors, plan)
@@ -676,19 +677,15 @@ class DistributedKFAC:
         """True if any stored eigenbasis stack is unusable (all-zero).
 
         Pre-warm-eigh checkpoints stored zero-initialized Q stacks;
-        Q=0 is a fixed point of the warm polish (see
-        preconditioner._degenerate_bases), so such checkpoints must be
-        rebuilt from factors instead of warm-started.
+        Q=0 is a fixed point of the warm polish, so such checkpoints
+        must be rebuilt from factors instead of warm-started. Shares
+        :func:`preconditioner.q_stack_degenerate` (multi-host safe:
+        inspects addressable shards only).
         """
         if not self.kfac.use_eigen_decomp:
             return False
-        for entry in inv_stacks.values():
-            if 'Q' in entry:
-                q = np.asarray(entry['Q'])
-                if float(np.linalg.norm(q)) < 0.5 * np.sqrt(
-                        q.shape[0] * q.shape[-1]):
-                    return True
-        return False
+        return any(q_stack_degenerate(entry['Q'])
+                   for entry in inv_stacks.values() if 'Q' in entry)
 
     def recompute_inverses(self, state: dict,
                            damping: float | None = None) -> dict:

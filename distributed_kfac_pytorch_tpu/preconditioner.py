@@ -262,6 +262,8 @@ class KFAC:
             for name, spec in specs.items():
                 print(f'Registered {name}: {spec.kind} '
                       f'(bias={spec.has_bias}, calls={spec.num_calls})')
+            for name, reason in self.capture.skipped_modules.items():
+                print(f'Skipped {name}: {reason}')
         state = self.init_state(variables['params'])
         return variables, state
 
@@ -394,11 +396,7 @@ class KFAC:
         warm-start matmul-only polish instead of a cold eigh.
         """
         out: dict[str, tuple[jax.Array, jax.Array]] = {}
-        # 'warm' is an explicit alias of 'auto': both polish when a
-        # previous basis exists and fall back to the exact eigh when not
-        # (one-time host-side rebuilds like load_state_dict).
-        method = ('auto' if self.eigh_method in ('auto', 'warm')
-                  else self.eigh_method)
+        method = resolve_eigh_method(self.eigh_method)
         for names, stack in _size_buckets(mats):
             q_prev = None
             if prev is not None and method == 'auto':
@@ -643,28 +641,54 @@ class KFAC:
         return state
 
 
-def _degenerate_bases(inverses: dict, use_eigen: bool) -> bool:
-    """True if any stored eigenbasis is unusable (e.g. all-zero).
+def resolve_eigh_method(method: str) -> str:
+    """Normalize the eigh-method alias: 'warm' behaves as 'auto'.
+
+    Both polish when a previous basis exists and fall back to the exact
+    eigh when not (one-time host-side rebuilds like load_state_dict).
+    Single point of truth for the single-chip and SPMD dispatchers.
+    """
+    return 'auto' if method in ('auto', 'warm') else method
+
+
+def q_stack_degenerate(q) -> bool:
+    """True if a stored eigenbasis (or stack of bases) is unusable.
 
     Checkpoints written by pre-warm-eigh versions initialized inverse
     slots to zeros; Q=0 is a *fixed point* of the warm polish (every
     update is right-multiplication by Q), which would silently zero the
-    preconditioned gradients forever. An orthonormal basis has
-    ``|Q|_F = sqrt(n)``, so a tiny Frobenius norm is an unambiguous
-    degeneracy signal; the caller falls back to recomputing inverses
-    from factors (the reference's behavior, preconditioner.py:347-353).
-    Host-side, eager, one scalar read per layer.
+    preconditioned gradients forever. An orthonormal (n, n) basis has
+    ``|Q|_F = sqrt(n)`` (a (B, n, n) stack: ``sqrt(B * n)``), so a tiny
+    Frobenius norm is an unambiguous degeneracy signal.
+
+    Multi-host safe: on a sharded ``jax.Array`` only the *addressable*
+    shards are inspected (fetching the global value of an array spanning
+    other hosts' devices is impossible); an all-zero stack is all-zero
+    in every shard. Host-side, eager — used only on checkpoint restore.
     """
+    import numpy as np
+
+    def shard_bad(arr) -> bool:
+        a = np.asarray(arr)
+        expect = np.sqrt(float(np.prod(a.shape[:-1])))
+        return float(np.linalg.norm(a)) < 0.5 * expect
+
+    shards = getattr(q, 'addressable_shards', None)
+    if shards is not None:
+        return any(shard_bad(s.data) for s in shards)
+    return shard_bad(q)
+
+
+def _degenerate_bases(inverses: dict, use_eigen: bool) -> bool:
+    """True if any stored eigenbasis in a per-layer inverse dict is
+    unusable (see :func:`q_stack_degenerate`); the caller falls back to
+    recomputing inverses from factors (the reference's behavior,
+    preconditioner.py:347-353)."""
     if not use_eigen:
         return False
-    import numpy as np
-    for entry in inverses.values():
-        for key in ('QA', 'QG'):
-            if key in entry:
-                q = np.asarray(entry[key])
-                if float(np.linalg.norm(q)) < 0.5 * np.sqrt(q.shape[-1]):
-                    return True
-    return False
+    return any(q_stack_degenerate(entry[key])
+               for entry in inverses.values()
+               for key in ('QA', 'QG') if key in entry)
 
 
 def _size_buckets(mats: dict[str, jax.Array]):

@@ -25,6 +25,7 @@ import optax
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
+from distributed_kfac_pytorch_tpu import launch
 from distributed_kfac_pytorch_tpu.models import cifar_resnet
 from distributed_kfac_pytorch_tpu.parallel import distributed as D
 from distributed_kfac_pytorch_tpu.training import (
@@ -98,8 +99,17 @@ def parse_args(argv=None):
 
 def main(argv=None):
     args = parse_args(argv)
+    # Multi-host init BEFORE any backend use: on a pod each worker joins
+    # the global runtime here (the analogue of the reference's
+    # init_process_group at torch_imagenet_resnet.py:113, driven by the
+    # launch-script env — scripts/launch_tpu_pod.sh; single-host no-op).
+    info = launch.initialize_multihost()
+    is_main = info['process_index'] == 0
     n_dev = jax.device_count()
-    print(f'devices: {n_dev} ({jax.default_backend()})')
+    if is_main:
+        print(f'devices: {n_dev} global / {info["local_devices"]} local '
+              f'x {info["process_count"]} processes '
+              f'({jax.default_backend()})')
 
     (train_x, train_y), (test_x, test_y) = datasets.get_cifar(args.data_dir)
     model = cifar_resnet.get_model(args.model)
@@ -204,25 +214,28 @@ def main(argv=None):
         state.step = int(restored['scalars'].get('step', 0))
         if kfac_sched:
             kfac_sched.step(start_epoch)
-        print(f'resumed from epoch {mgr.latest_epoch()}')
+        if is_main:
+            print(f'resumed from epoch {mgr.latest_epoch()}')
 
-    writer = engine.TensorBoardWriter(args.log_dir)
+    # rank-0 writer (reference engine.py:89-93); checkpoint saves stay
+    # collective (orbax coordinates all hosts' shard writes).
+    writer = engine.TensorBoardWriter(args.log_dir) if is_main else None
     t_start = time.perf_counter()
     for epoch in range(start_epoch, args.epochs):
         lr = lr_schedule(epoch)
         state.opt_state = optimizers.set_lr(state.opt_state, lr)
         hyper = {'lr': lr,
                  **(kfac_sched.params() if kfac_sched else {})}
-        batches = datasets.epoch_batches(
+        batches = launch.global_batches(mesh, datasets.epoch_batches(
             train_x, train_y, args.batch_size, seed=args.seed,
-            epoch=epoch, augment=True)
+            epoch=epoch, augment=True))
         train_m = engine.train_epoch(step_fn, state, batches, hyper,
-                                     log_writer=writer, verbose=True)
-        val_batches = datasets.epoch_batches(
+                                     log_writer=writer, verbose=is_main)
+        val_batches = launch.global_batches(mesh, datasets.epoch_batches(
             test_x, test_y, args.val_batch_size, shuffle=False,
-            augment=False)
+            augment=False))
         engine.evaluate(eval_step, state, val_batches,
-                        log_writer=writer, verbose=True)
+                        log_writer=writer, verbose=is_main)
         if kfac_sched:
             kfac_sched.step(epoch + 1)
         if (epoch + 1) % args.checkpoint_freq == 0 or \
@@ -233,8 +246,10 @@ def main(argv=None):
                 state.extra_vars,
                 schedulers={'kfac': kfac_sched} if kfac_sched else None,
                 step=state.step))
-    writer.flush()
-    print(f'total: {time.perf_counter() - t_start:.1f}s')
+    if writer is not None:
+        writer.flush()
+    if is_main:
+        print(f'total: {time.perf_counter() - t_start:.1f}s')
 
 
 if __name__ == '__main__':

@@ -26,6 +26,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
+from distributed_kfac_pytorch_tpu import launch
 from distributed_kfac_pytorch_tpu.models import imagenet_resnet
 from distributed_kfac_pytorch_tpu.parallel import distributed as D
 from distributed_kfac_pytorch_tpu.training import (
@@ -101,11 +102,21 @@ def parse_args(argv=None):
 
 def main(argv=None):
     args = parse_args(argv)
+    # Multi-host init BEFORE any backend use (reference analogue:
+    # init_process_group at torch_imagenet_resnet.py:113, driven by
+    # scripts/launch_tpu_pod.sh; single-host no-op).
+    info = launch.initialize_multihost()
+    is_main = info['process_index'] == 0
     n_dev = jax.device_count()
-    print(f'devices: {n_dev} ({jax.default_backend()})')
+    if is_main:
+        print(f'devices: {n_dev} global / {info["local_devices"]} local '
+              f'x {info["process_count"]} processes '
+              f'({jax.default_backend()})')
 
     data = datasets.get_imagenet(args.data_dir,
                                  image_size=args.image_size)
+    nproc = info['process_count']
+    batches_local = False  # True: iterators yield per-process shards
     if isinstance(data[0], tuple):
         (train_x, train_y), (val_x, val_y) = data
         train_iter_fn = lambda epoch: datasets.epoch_batches(
@@ -115,12 +126,26 @@ def main(argv=None):
             val_x, val_y, args.val_batch_size, shuffle=False)
     else:
         train_ds, val_ds = data
+        tb, vb = args.batch_size, args.val_batch_size
+        if nproc > 1:
+            # Shard the input pipeline per process (the reference's
+            # DistributedSampler analogue, datasets.py:57-63) so no host
+            # pays the full global decode cost; global_batches then
+            # assembles the local shards without re-slicing.
+            if tb % nproc or vb % nproc:
+                raise SystemExit(
+                    f'batch sizes ({tb}, {vb}) must divide evenly over '
+                    f'{nproc} processes')
+            train_ds = train_ds.shard(nproc, info['process_index'])
+            val_ds = val_ds.shard(nproc, info['process_index'])
+            tb, vb = tb // nproc, vb // nproc
+            batches_local = True
         train_iter_fn = lambda epoch: (
             (x.numpy(), y.numpy()) for x, y in
-            train_ds.batch(args.batch_size, drop_remainder=True))
+            train_ds.batch(tb, drop_remainder=True))
         val_iter_fn = lambda: (
             (x.numpy(), y.numpy()) for x, y in
-            val_ds.batch(args.val_batch_size, drop_remainder=True))
+            val_ds.batch(vb, drop_remainder=True))
 
     model = imagenet_resnet.get_model(args.model)
     cfg = optimizers.OptimConfig(
@@ -222,20 +247,26 @@ def main(argv=None):
         state.step = int(restored['scalars'].get('step', 0))
         if kfac_sched:
             kfac_sched.step(start_epoch)
-        print(f'resumed from epoch {mgr.latest_epoch()}')
+        if is_main:
+            print(f'resumed from epoch {mgr.latest_epoch()}')
 
-    writer = engine.TensorBoardWriter(args.log_dir)
+    writer = engine.TensorBoardWriter(args.log_dir) if is_main else None
     t_start = time.perf_counter()
     for epoch in range(start_epoch, args.epochs):
         lr = lr_schedule(epoch)
         state.opt_state = optimizers.set_lr(state.opt_state, lr)
         hyper = {'lr': lr,
                  **(kfac_sched.params() if kfac_sched else {})}
-        train_m = engine.train_epoch(step_fn, state, train_iter_fn(epoch),
-                                     hyper, log_writer=writer,
-                                     verbose=True)
-        engine.evaluate(eval_step, state, val_iter_fn(),
-                        log_writer=writer, verbose=True)
+        train_m = engine.train_epoch(
+            step_fn, state,
+            launch.global_batches(mesh, train_iter_fn(epoch),
+                                  already_sharded=batches_local),
+            hyper, log_writer=writer, verbose=is_main)
+        engine.evaluate(
+            eval_step, state,
+            launch.global_batches(mesh, val_iter_fn(),
+                                  already_sharded=batches_local),
+            log_writer=writer, verbose=is_main)
         if kfac_sched:
             kfac_sched.step(epoch + 1)
         if (epoch + 1) % args.checkpoint_freq == 0 or \
@@ -246,8 +277,10 @@ def main(argv=None):
                 state.extra_vars,
                 schedulers={'kfac': kfac_sched} if kfac_sched else None,
                 step=state.step))
-    writer.flush()
-    print(f'total: {time.perf_counter() - t_start:.1f}s')
+    if writer is not None:
+        writer.flush()
+    if is_main:
+        print(f'total: {time.perf_counter() - t_start:.1f}s')
 
 
 if __name__ == '__main__':

@@ -36,6 +36,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
 from jax.sharding import PartitionSpec as P
 
+from distributed_kfac_pytorch_tpu import launch
 from distributed_kfac_pytorch_tpu.models import lstm_lm, transformer_lm
 from distributed_kfac_pytorch_tpu.parallel import distributed as D
 from distributed_kfac_pytorch_tpu.parallel import sequence as seq
@@ -126,15 +127,23 @@ def build_model(args, vocab_size, seq_axis=None):
 
 def main(argv=None):
     args = parse_args(argv)
+    # Multi-host init BEFORE any backend use (single-host no-op; see
+    # launch.initialize_multihost / scripts/launch_tpu_pod.sh).
+    info = launch.initialize_multihost()
+    is_main = info['process_index'] == 0
     n_dev = jax.device_count()
     sp = args.seq_parallel
     if sp > 1 and args.arch != 'transformer':
         raise SystemExit('--seq-parallel requires --arch transformer')
-    print(f'devices: {n_dev} ({jax.default_backend()}), seq_parallel={sp}')
+    if is_main:
+        print(f'devices: {n_dev} global / {info["local_devices"]} local '
+              f'x {info["process_count"]} processes '
+              f'({jax.default_backend()}), seq_parallel={sp}')
 
     train_ids, val_ids, vocab_size = datasets.get_lm_corpus(args.data_dir)
-    print(f'corpus: {len(train_ids)} train / {len(val_ids)} val tokens, '
-          f'vocab {vocab_size}')
+    if is_main:
+        print(f'corpus: {len(train_ids)} train / {len(val_ids)} val '
+              f'tokens, vocab {vocab_size}')
 
     if args.skip_layers is None:
         args.skip_layers = (['embed', 'decoder'] if args.arch == 'lstm'
@@ -244,7 +253,8 @@ def main(argv=None):
         # driven by it, so it must stay in phase with kstate['step'].
         state.step = int(restored['scalars'].get('step', 0))
         kfac_sched.step(start_epoch)
-        print(f'resumed from epoch {mgr.latest_epoch()}')
+        if is_main:
+            print(f'resumed from epoch {mgr.latest_epoch()}')
 
     def batches(epoch):
         root = jax.random.PRNGKey(args.seed * 1000 + epoch)
@@ -253,22 +263,28 @@ def main(argv=None):
                 shuffle_offset=True, seed=args.seed, epoch=epoch)):
             yield x, y, jax.random.fold_in(root, i)
 
-    writer = engine.TensorBoardWriter(args.log_dir)
+    writer = engine.TensorBoardWriter(args.log_dir) if is_main else None
     t_start = time.perf_counter()
     for epoch in range(start_epoch, args.epochs):
         lr = lr_schedule(epoch)
         state.opt_state = optimizers.set_lr(state.opt_state, lr)
         hyper = {'lr': lr, **kfac_sched.params()}
-        train_m = engine.train_epoch(step_fn, state, batches(epoch),
-                                     hyper, log_writer=writer,
-                                     verbose=True)
+        train_m = engine.train_epoch(
+            step_fn, state,
+            launch.global_batches(mesh, batches(epoch),
+                                  batch_spec=(data_spec, data_spec, P())),
+            hyper, log_writer=writer, verbose=is_main)
         val_m = engine.evaluate(
             eval_step, state,
-            datasets.bptt_batches(val_ids, args.batch_size, args.bptt),
-            log_writer=writer, verbose=True)
-        print(f'epoch {epoch}: train ppl '
-              f'{math.exp(min(train_m["loss"], 20)):.2f}, val ppl '
-              f'{math.exp(min(val_m["loss"], 20)):.2f}')
+            launch.global_batches(
+                mesh,
+                datasets.bptt_batches(val_ids, args.batch_size, args.bptt),
+                batch_spec=(data_spec, data_spec)),
+            log_writer=writer, verbose=is_main)
+        if is_main:
+            print(f'epoch {epoch}: train ppl '
+                  f'{math.exp(min(train_m["loss"], 20)):.2f}, val ppl '
+                  f'{math.exp(min(val_m["loss"], 20)):.2f}')
         kfac_sched.step(epoch + 1)
         if (epoch + 1) % args.checkpoint_freq == 0 or \
                 epoch == args.epochs - 1:
@@ -276,8 +292,10 @@ def main(argv=None):
                 state.params, state.opt_state,
                 dkfac.state_dict(state.kfac_state), {},
                 schedulers={'kfac': kfac_sched}, step=state.step))
-    writer.flush()
-    print(f'total: {time.perf_counter() - t_start:.1f}s')
+    if writer is not None:
+        writer.flush()
+    if is_main:
+        print(f'total: {time.perf_counter() - t_start:.1f}s')
 
 
 if __name__ == '__main__':

@@ -1,0 +1,124 @@
+"""Worker/shared harness for the 2-process multi-host integration test.
+
+Run as a subprocess (one per simulated host) by tests/test_multihost.py:
+
+    python tests/multihost_worker.py PORT PROCESS_ID NUM_PROCESSES OUT.npz
+
+Each process gets 4 virtual CPU devices; ``launch.initialize_multihost``
+joins them into one 8-device global runtime (gloo cross-process
+collectives), exactly the path a TPU pod worker takes through the
+example CLIs (the analogue of the reference's
+``init_process_group`` + env-var launch chain,
+launch_node_torch_imagenet.sh:45-68 -> torch_imagenet_resnet.py:113).
+
+``run_training`` is also imported by the test and executed in-process on
+the single-process 8-device mesh: identical math, so the multi-process
+result must match it (same seeds => same data; factor pmeans/grad psums
+span the same 8 devices either way).
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def _configure(n_local_devices=4):
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    jax.config.update('jax_num_cpu_devices', n_local_devices)
+    return jax
+
+
+def run_training(n_steps=3):
+    """Build a small conv net + DistributedKFAC on the global mesh and
+    train ``n_steps`` deterministic steps through ``global_batches``.
+
+    Returns (params, metrics_history) — identical across processes
+    (all outputs are replicated) and across 1-vs-2-process runs.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax import linen as nn
+
+    from distributed_kfac_pytorch_tpu import launch
+    from distributed_kfac_pytorch_tpu.parallel import distributed as D
+    from distributed_kfac_pytorch_tpu.preconditioner import (
+        CommMethod,
+        KFAC,
+    )
+
+    class SmallCNN(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Conv(8, (3, 3))(x)
+            x = nn.relu(x)
+            x = x.reshape(x.shape[0], -1)
+            x = nn.Dense(16)(x)
+            x = nn.relu(x)
+            return nn.Dense(10)(x)
+
+    model = SmallCNN()
+    kfac = KFAC(model, factor_update_freq=1, inv_update_freq=2,
+                damping=0.003, lr=0.1,
+                comm_method=CommMethod.HYBRID_OPT,
+                grad_worker_fraction=0.5)
+    x0 = jnp.zeros((2, 8, 8, 3))
+    variables, _ = kfac.init(jax.random.PRNGKey(0), x0)
+    params = variables['params']
+    mesh = D.make_kfac_mesh(comm_method=CommMethod.HYBRID_OPT,
+                            grad_worker_fraction=0.5)
+    dkfac = D.DistributedKFAC(kfac, mesh, params)
+    kstate = dkfac.init_state(params)
+    tx = optax.sgd(0.05, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def loss_fn(out, batch):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            out, batch[1]).mean()
+
+    step = dkfac.build_train_step(loss_fn, tx, donate=False)
+    hyper = {'lr': 0.05, 'damping': 0.003}
+
+    rng = np.random.default_rng(0)
+    raw = [(rng.normal(size=(32, 8, 8, 3)).astype(np.float32),
+            rng.integers(0, 10, 32).astype(np.int32))
+           for _ in range(n_steps)]
+
+    losses = []
+    extra = {}
+    for i, batch in enumerate(launch.global_batches(mesh, iter(raw))):
+        params, opt_state, kstate, extra, metrics = step(
+            params, opt_state, kstate, extra, batch, hyper,
+            factor_update=True, inv_update=(i % 2 == 0))
+        losses.append(float(jax.device_get(metrics['loss'])))
+    params_host = jax.tree.map(
+        lambda a: np.asarray(jax.device_get(a)), params)
+    return params_host, losses
+
+
+def main():
+    port, pid, nproc, out_path = sys.argv[1:5]
+    _configure()
+    from distributed_kfac_pytorch_tpu import launch
+    info = launch.initialize_multihost(
+        coordinator_address=f'localhost:{port}',
+        num_processes=int(nproc), process_id=int(pid))
+    assert info['process_count'] == int(nproc), info
+    assert info['global_devices'] == 4 * int(nproc), info
+    params, losses = run_training()
+    if info['process_index'] == 0:
+        import numpy as np
+
+        import jax
+        flat = {'/'.join(map(str, path)): leaf
+                for path, leaf in
+                jax.tree_util.tree_flatten_with_path(params)[0]}
+        np.savez(out_path, losses=np.asarray(losses),
+                 **{k: v for k, v in flat.items()})
+    print(f'worker {pid} done', flush=True)
+
+
+if __name__ == '__main__':
+    main()

@@ -250,3 +250,56 @@ def test_conv_factor_consistency_with_param_grad():
     want = np.einsum('bijf,bijo->of', np.asarray(patches), np.asarray(g))
     got = layers.grads_to_matrix(spec, grads['c1'])[:, :-1]  # drop bias col
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class _DepthwiseNet(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(8, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.Conv(8, (3, 3), feature_group_count=8)(x)  # depthwise
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(4)(x)
+
+
+class TestSkippedReporting:
+    """Loud capture-skip reporting (round-2 VERDICT #6): the reference
+    hard-errors on module kinds it refuses (kfac/layers/__init__.py:31-33);
+    here declined convs warn and everything unpreconditioned is listed."""
+
+    def test_depthwise_conv_warns_and_reported(self):
+        cap = KFACCapture(_DepthwiseNet())
+        with pytest.warns(UserWarning, match='cannot precondition'):
+            variables, specs = cap.init(jax.random.PRNGKey(0),
+                                        jnp.zeros((2, 8, 8, 3)))
+        assert 'Conv_0' in specs and 'Dense_0' in specs
+        assert 'Conv_1' not in specs
+        skipped = cap.skipped_modules
+        assert 'Conv_1' in skipped
+        assert 'feature_group_count' in skipped['Conv_1']
+        # The declined conv still trains (plain grads) — its params exist.
+        assert 'Conv_1' in variables['params']
+
+    def test_batchnorm_reported_without_warning(self):
+        class BNNet(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.Dense(8)(x)
+                x = nn.BatchNorm(use_running_average=False)(x)
+                return nn.Dense(4)(x)
+
+        cap = KFACCapture(BNNet())
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter('error')  # any warning -> failure
+            _, specs = cap.init(jax.random.PRNGKey(0), jnp.zeros((2, 6)))
+        skipped = cap.skipped_modules
+        assert any('BatchNorm' in k for k in skipped), skipped
+        assert all('unsupported module type' in v
+                   for k, v in skipped.items() if 'BatchNorm' in k)
+
+    def test_skip_layers_recorded(self):
+        cap = KFACCapture(_DepthwiseNet(), skip_layers=['dense'])
+        with pytest.warns(UserWarning):
+            cap.init(jax.random.PRNGKey(0), jnp.zeros((2, 8, 8, 3)))
+        assert cap.skipped_modules.get('Dense_0') == 'skip_layers match'

@@ -89,8 +89,16 @@ def test_mesh_factorization(comm_method, frac, shape):
 def test_spmd_parity_cnn(comm_method, frac, shape):
     """Distributed train step == single-device step, all strategies."""
     model = SmallCNN()
+    # eigh_method='xla': this test's subject is the distribution logic.
+    # Early-training factors are near-identity (clustered spectra) where
+    # the warm polish's in-cluster basis choice is chaotic in the fp
+    # rounding differences between the SPMD and single-device paths;
+    # the preconditioned output difference stays at the harmless
+    # cluster-spread level but breaks elementwise parity comparison
+    # (tests/test_warm_eigh.py covers the warm path against a dense
+    # oracle instead).
     kfac = KFAC(model, factor_update_freq=1, inv_update_freq=2,
-                damping=0.003, lr=0.1)
+                damping=0.003, lr=0.1, eigh_method='xla')
     rng = jax.random.PRNGKey(0)
     x = jax.random.normal(jax.random.PRNGKey(1), (16, 8, 8, 3))
     y = jax.random.randint(jax.random.PRNGKey(2), (16,), 0, 10)

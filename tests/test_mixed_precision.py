@@ -115,3 +115,72 @@ def test_bf16_factor_compute_close_to_fp32():
     assert any(not np.allclose(a, b, rtol=1e-6, atol=1e-7)
                for a, b in zip(jax.tree.leaves(f32),
                                jax.tree.leaves(bf16)))
+
+
+class TestFp16Robustness:
+    """fp16 parity hardening (round-2 VERDICT #7): the jit-friendly
+    analogues of the reference's hook-time inf/NaN capture drop
+    (kfac/layers/base.py:397-407) and GradScaler dynamic scaling."""
+
+    def test_sanitize_captures_zeroes_and_counts(self):
+        from distributed_kfac_pytorch_tpu import fp16
+        captures = {
+            'L1': {'a': (jnp.ones((4, 3)),),
+                   'g': (jnp.array([[1.0, jnp.inf], [0.0, 1.0]]),)},
+            'L2': {'a': (jnp.full((2, 2), jnp.nan),),
+                   'g': (jnp.ones((2, 2)),)},
+        }
+        clean, count = jax.jit(fp16.sanitize_captures)(captures)
+        assert int(count) == 2
+        np.testing.assert_array_equal(clean['L1']['g'][0],
+                                      np.zeros((2, 2)))
+        np.testing.assert_array_equal(clean['L2']['a'][0],
+                                      np.zeros((2, 2)))
+        # Finite tensors pass through untouched.
+        np.testing.assert_array_equal(clean['L1']['a'][0], np.ones((4, 3)))
+        np.testing.assert_array_equal(clean['L2']['g'][0], np.ones((2, 2)))
+
+    def test_dynamic_loss_scale_schedule(self):
+        from distributed_kfac_pytorch_tpu import fp16
+        state = fp16.init_loss_scale(initial=2.0 ** 10)
+        # Overflow halves and resets growth.
+        state = fp16.update_loss_scale(state, False)
+        assert float(state['scale']) == 2.0 ** 9
+        assert int(state['growth_count']) == 0
+        # growth_interval consecutive finite steps double the scale.
+        for _ in range(3):
+            state = fp16.update_loss_scale(state, True,
+                                           growth_interval=3)
+        assert float(state['scale']) == 2.0 ** 10
+        assert int(state['growth_count']) == 0
+
+    def test_apply_if_finite_skips_update(self):
+        from distributed_kfac_pytorch_tpu import fp16
+        old = {'w': jnp.zeros(3)}
+        new = {'w': jnp.ones(3)}
+        kept = fp16.apply_if_finite(False, new, old)
+        np.testing.assert_array_equal(kept['w'], np.zeros(3))
+        applied = fp16.apply_if_finite(True, new, old)
+        np.testing.assert_array_equal(applied['w'], np.ones(3))
+
+    def test_factor_update_unpoisoned_by_injected_inf(self):
+        """End-to-end: an inf in one layer's output-grad capture leaves
+        that factor at its EWMA-of-zero-contribution value instead of
+        poisoning the whole state with NaNs."""
+        from distributed_kfac_pytorch_tpu import fp16
+        model = MLP()
+        kfac = KFAC(model, factor_update_freq=1, inv_update_freq=1,
+                    damping=0.01)
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 6))
+        variables, state = kfac.init(jax.random.PRNGKey(1), x)
+        _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+            lambda out: jnp.mean(out ** 2), variables['params'], x)
+        # Poison one capture as an overflowed fp16 backward would.
+        name = sorted(captures)[0]
+        g0 = captures[name]['g'][0]
+        captures[name]['g'] = (g0.at[0, 0].set(jnp.inf),)
+        clean, count = fp16.sanitize_captures(captures)
+        assert int(count) == 1
+        _, new_state = kfac.step(state, grads, clean)
+        for leaf in jax.tree.leaves(new_state['factors']):
+            assert np.isfinite(np.asarray(leaf)).all()

@@ -224,10 +224,13 @@ def test_state_dict_roundtrip_recomputes_inverses():
         jax.tree.map(np.asarray, sd), params, compute_inverses=True)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5),
                  restored['factors'], state['factors'])
-    # recomputed inverses match (same damping)
+    # The recomputed inverses use the exact eigh (sorted basis) while
+    # the originals came from the warm polish (tracked basis order), so
+    # compare at the operator level: both must precondition identically.
+    p1 = kfac.precondition(state, grads, kfac.damping, 0.1)
+    p2 = kfac.precondition(restored, grads, kfac.damping, 0.1)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
-        np.abs(a), np.abs(b), rtol=1e-3, atol=1e-4),
-        restored['inverses'], state['inverses'])
+        a, b, rtol=1e-3, atol=1e-5), p1, p2)
 
 
 def test_load_state_dict_layer_mismatch_raises():
