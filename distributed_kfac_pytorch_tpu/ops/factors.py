@@ -212,15 +212,39 @@ def conv2d_a_factor(a: jax.Array, kernel_size, strides, padding,
     """
     kh, kw = kernel_size
     c = a.shape[-1]
+    d = kh * kw * c
+    if jax.default_backend() == 'tpu' and d <= 640:
+        # Fused VMEM patch-covariance Pallas kernel: never materializes
+        # the KH*KW x im2col blowup in HBM (measured ~14 ms/iter of
+        # patch-tensor write+read on the tracked CIFAR config — the
+        # single largest K-FAC cost after round 1). Guarded to factor
+        # dims whose (D, D) accumulator + patch block fit VMEM
+        # comfortably (d<=640 covers every CIFAR ResNet conv and the
+        # ImageNet conv1/stage-1 convs); bigger convs take the
+        # bf16-patch XLA path below. The
+        # one-time fused_patch_cov_supported probe compiles AND runs a
+        # tiny instance first — Mosaic failures are not catchable at
+        # this dispatch site — and KFAC_DISABLE_FUSED_PATCH_COV=1
+        # force-disables.
+        from distributed_kfac_pytorch_tpu.ops import pallas_kernels
+        try:
+            if not pallas_kernels.fused_patch_cov_supported():
+                raise ValueError('fused kernel unsupported here')
+            mult_bf16 = (compute_dtype is None
+                         or jnp.dtype(compute_dtype) == jnp.bfloat16)
+            return pallas_kernels.conv_a_factor_fused(
+                a, kernel_size, strides, padding, has_bias,
+                mult_bf16=mult_bf16)
+        except ValueError:
+            pass  # unsupported padding config: XLA path
     if (compute_dtype is None and a.dtype == jnp.float32
             and jax.default_backend() == 'tpu'):
         # Under the default precision contract the covariance matmul
         # rounds fp32 inputs to bf16 on the MXU anyway (see get_cov);
         # casting BEFORE the im2col materialization makes the ~KH*KW x
         # blown-up patch tensor bf16, halving the HBM write+read that
-        # dominates conv factor updates (measured ~14 ms/iter on the
-        # tracked CIFAR config, the single largest K-FAC cost). Strict
-        # fp32 (compute_dtype=float32) keeps fp32 patches.
+        # dominates conv factor updates. Strict fp32
+        # (compute_dtype=float32) keeps fp32 patches.
         a = a.astype(jnp.bfloat16)
     patches = jax.lax.conv_general_dilated_patches(
         a, filter_shape=(kh, kw), window_strides=tuple(strides),
@@ -321,8 +345,12 @@ def unpack_symmetric(packed: jax.Array, n: int) -> jax.Array:
 def get_triu(x: jax.Array) -> jax.Array:
     """Flatten the upper triangle of a symmetric 2-D tensor.
 
-    Used for symmetry-aware communication: allreduce n(n+1)/2 elements
-    instead of n^2. Reference parity: kfac/layers/utils.py:126-136.
+    Reference-parity utility only (kfac/layers/utils.py:126-136): the
+    production ``symmetry_aware_comm`` path uses the gather-free
+    :func:`pack_symmetric` instead (gathers are slow on TPU and
+    miscompile on XLA:CPU inside large shard_map programs). Kept because
+    it is the reference's exact wire format (n(n+1)/2 flat elements),
+    useful for interop/conversion.
     """
     if x.ndim != 2:
         raise ValueError('get_triu expects a 2-D tensor')

@@ -252,3 +252,241 @@ def damped_inverse_stack(stack: jax.Array, damping, method: str,
         return batched_inverse(stack, damping, iters=iters)
     from distributed_kfac_pytorch_tpu.ops import linalg
     return jax.vmap(lambda m: linalg.get_inverse(m, damping=damping))(stack)
+
+
+# ---------------------------------------------------------------------------
+# Fused im2col + covariance kernel for conv A factors
+# ---------------------------------------------------------------------------
+#
+# The conv A factor is cov(patches) where patches is the im2col expansion
+# of the layer input — a KH*KW x blowup that the stock XLA lowering
+# *materializes in HBM* (write + read of a ~300 MB tensor per stage-1
+# CIFAR conv at batch 512). Measured on v5e, that traffic made the factor
+# EWMA ~14 ms/iter of the tracked CIFAR config — the single largest
+# K-FAC cost after round 1 eliminated the decompositions. This kernel
+# fuses patch extraction into the covariance contraction: per grid step
+# it loads a block of images into VMEM once, forms the patch block with
+# static (strided) slices + one lane concat, and accumulates
+#   A += P^T P      (MXU, fp32 accumulation)
+#   s += ones @ P   (bias column sums, same pass)
+# so HBM traffic is one read of x plus one (D, D) output — no patch
+# tensor ever exists outside VMEM.
+
+def _patch_cov_kernel(x_ref, a_ref, s_ref, *, kh, kw, sh, sw,
+                      pads, oh, ow, mult_dtype):
+    """One image block per grid step; accumulates into the same output.
+
+    ``x_ref``: (bb, H, W, C) input block. ``a_ref``: (D, D) fp32
+    accumulator, D = kh*kw*C in (ki, kj, c) feature order (matching the
+    flattened flax kernel — the basis ops.factors.conv2d_a_factor
+    permutes *to*; here it is constructed directly). ``s_ref``: (8, D)
+    fp32 column-sum accumulator (row 0 meaningful; 8 rows for sublane
+    tiling).
+    """
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        a_ref[...] = jnp.zeros_like(a_ref)
+        s_ref[...] = jnp.zeros_like(s_ref)
+
+    # Cast BEFORE assembly: the per-shift slices and the concatenated
+    # patch block are the large VMEM temporaries — in bf16 they are
+    # half-size, which is what lets deep-stage blocks (e.g. 56x56x64,
+    # D=576: ~3.6 MB patch block) fit alongside the (D, D) accumulator.
+    x = x_ref[...].astype(mult_dtype)
+    bb, h, w, c = x.shape
+    (ph_lo, ph_hi), (pw_lo, pw_hi) = pads
+    if ph_lo or ph_hi or pw_lo or pw_hi:
+        x = jnp.pad(x, ((0, 0), (ph_lo, ph_hi), (pw_lo, pw_hi), (0, 0)))
+    pieces = []
+    for ki in range(kh):
+        for kj in range(kw):
+            sl = jax.lax.slice(
+                x, (0, ki, kj, 0),
+                (bb, ki + sh * (oh - 1) + 1, kj + sw * (ow - 1) + 1, c),
+                (1, sh, sw, 1))
+            pieces.append(sl.reshape(bb * oh * ow, c))
+    p = jnp.concatenate(pieces, axis=1)
+    # bf16 multiplicands ride the MXU fast path (the default covariance
+    # precision contract); fp32 multiplicands request HIGHEST for the
+    # strict-fp32 contract (ops.factors.get_cov).
+    prec = (None if mult_dtype == jnp.bfloat16
+            else jax.lax.Precision.HIGHEST)
+    a_ref[...] += jnp.dot(p.T, p, preferred_element_type=jnp.float32,
+                          precision=prec)
+    ones = jnp.ones((8, p.shape[0]), mult_dtype)
+    s_ref[...] += jnp.dot(ones, p, preferred_element_type=jnp.float32,
+                          precision=prec)
+
+
+@functools.partial(
+    jax.jit, static_argnames=('kernel_size', 'strides', 'pads',
+                              'block_batch', 'mult_bf16', 'interpret'))
+def _pallas_patch_cov(x: jax.Array, *, kernel_size, strides, pads,
+                      block_batch: int, mult_bf16: bool,
+                      interpret: bool = False):
+    """(B, H, W, C) NHWC -> (cov (D, D) fp32, colsum (D,) fp32).
+
+    ``cov`` is the *sum* over all B*OH*OW patch rows of p p^T (caller
+    applies the 1/scale); ``colsum`` the per-feature row sum.
+    """
+    from jax.experimental import pallas as pl  # noqa: F811 (module use)
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, w, c = x.shape
+    kh, kw = kernel_size
+    sh, sw = strides
+    (ph_lo, ph_hi), (pw_lo, pw_hi) = pads
+    oh = (h + ph_lo + ph_hi - kh) // sh + 1
+    ow = (w + pw_lo + pw_hi - kw) // sw + 1
+    d = kh * kw * c
+    if b % block_batch:
+        raise ValueError(f'batch {b} not divisible by {block_batch=}')
+    mult_dtype = jnp.bfloat16 if mult_bf16 else jnp.float32
+
+    kernel = functools.partial(
+        _patch_cov_kernel, kh=kh, kw=kw, sh=sh, sw=sw, pads=pads,
+        oh=oh, ow=ow, mult_dtype=mult_dtype)
+    cov, s = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((d, d), jnp.float32),
+                   jax.ShapeDtypeStruct((8, d), jnp.float32)),
+        grid=(b // block_batch,),
+        in_specs=[pl.BlockSpec((block_batch, h, w, c),
+                               lambda i: (i, 0, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec((d, d), lambda i: (0, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((8, d), lambda i: (0, 0),
+                                memory_space=pltpu.VMEM)),
+        interpret=interpret,
+    )(x)
+    return cov, s[0]
+
+
+@functools.lru_cache(maxsize=1)
+def fused_patch_cov_supported() -> bool:
+    """One-time probe: can the fused kernel compile AND run here?
+
+    Mosaic failures (VMEM overflow, unsupported lowering) surface at
+    jit-compile or run time — not as catchable trace-time errors at the
+    dispatch site — so the dispatcher calls this once per process and
+    falls back to the XLA path for good if the probe fails. Operators
+    can also force the fallback with KFAC_DISABLE_FUSED_PATCH_COV=1.
+    """
+    import os
+
+    if os.environ.get('KFAC_DISABLE_FUSED_PATCH_COV', '') == '1':
+        return False
+    if jax.default_backend() != 'tpu':
+        return False
+    try:
+        import numpy as np
+
+        from distributed_kfac_pytorch_tpu.ops import factors as F
+        x = jnp.asarray(np.linspace(0, 1, 4 * 8 * 8 * 3, dtype='float32')
+                        .reshape(4, 8, 8, 3))
+        # Reference computed INLINE (not via conv2d_a_factor, whose TPU
+        # dispatch would re-enter this probe): same formula/scale/bias
+        # assembly as conv_a_factor_fused.
+        p2 = np.asarray(F.extract_conv2d_patches(
+            x, (3, 3), (1, 1), 'SAME')).reshape(-1, 27).astype(np.float64)
+        spatial = 64
+        rows = p2.shape[0]
+        cov = (p2.T @ p2) / (rows * spatial * spatial)
+        bias_col = p2.mean(0) / (spatial * spatial)
+        ref = np.asarray(F._assemble_bias_factor(
+            jnp.asarray(cov, jnp.float32), jnp.asarray(bias_col,
+                                                       jnp.float32),
+            1.0 / (spatial * spatial)))
+        got = np.asarray(conv_a_factor_fused(
+            x, (3, 3), (1, 1), 'SAME', True, mult_bf16=True))
+        rel = (np.abs(got - ref).max()
+               / max(float(np.abs(ref).max()), 1e-30))
+        return bool(np.isfinite(got).all()) and rel < 5e-2
+    except Exception:
+        return False
+
+
+def conv_a_factor_fused(a: jax.Array, kernel_size, strides, padding,
+                        has_bias: bool, *, mult_bf16: bool = True,
+                        block_batch: int | None = None,
+                        interpret: bool = False) -> jax.Array:
+    """Conv A factor via the fused VMEM patch-covariance kernel.
+
+    Drop-in equal to ``ops.factors.conv2d_a_factor`` (same value up to
+    matmul rounding; same (kh, kw, c) feature basis and bias assembly)
+    for symmetric spatial padding. ``mult_bf16`` matches the default
+    covariance precision contract (bf16 multiplicands, fp32
+    accumulation — see ops.factors.get_cov); pass False for strict-fp32
+    multiplicands.
+    """
+    from distributed_kfac_pytorch_tpu.ops import factors as F
+
+    b, h, w, c = a.shape
+    kh, kw = kernel_size
+    sh, sw = strides
+    pads = _canonical_pad(padding, (kh, kw), (h, w), (sh, sw))
+    (ph_lo, ph_hi), (pw_lo, pw_hi) = pads
+    oh = (h + ph_lo + ph_hi - kh) // sh + 1
+    ow = (w + pw_lo + pw_hi - kw) // sw + 1
+    if block_batch is None:
+        # VMEM budget: the patch block materializes ~twice (per-shift
+        # pieces + their concat), plus the padded x copy (mult dtype),
+        # plus the fp32 input block (x2 for Mosaic double-buffering);
+        # the fp32 (D, D) + (8, D) accumulators are resident throughout
+        # (x1.5 headroom). Target <= ~10 MB of the ~16 MB/core.
+        mult_bytes = 2 if mult_bf16 else 4
+        d_full = kh * kw * c
+        fixed = int(1.5 * (d_full * d_full + 8 * d_full) * 4)
+        bytes_per_img = (2 * oh * ow * d_full * mult_bytes
+                         + (h + ph_lo + ph_hi) * (w + pw_lo + pw_hi)
+                         * c * mult_bytes
+                         + 2 * h * w * c * 4)
+        budget = int(10e6) - fixed
+        block_batch = max(1, budget // max(1, bytes_per_img))
+        while b % block_batch:
+            block_batch -= 1
+    spatial = oh * ow
+    rows = b * spatial
+    cov, colsum = _pallas_patch_cov(
+        a, kernel_size=(kh, kw), strides=(sh, sw), pads=pads,
+        block_batch=block_batch, mult_bf16=mult_bf16,
+        interpret=interpret)
+    cov = cov * (1.0 / (rows * spatial * spatial))
+    if not has_bias:
+        return cov
+    bias_col = colsum * (1.0 / (rows * spatial * spatial))
+    return F._assemble_bias_factor(cov, bias_col, 1.0 / (spatial * spatial))
+
+
+def _canonical_pad(padding, kernel_size, spatial, strides):
+    """Per-axis (lo, hi) pad amounts matching XLA conventions.
+
+    'SAME' follows the XLA/TF formula — total = max((ceil(dim/s)-1)*s
+    + k - dim, 0), lo = total // 2, hi = total - lo (extra on the high
+    side; asymmetric for strided convs) — so the kernel reproduces
+    conv_general_dilated_patches exactly. Also accepts 'VALID', int,
+    and explicit ((lo, hi), (lo, hi)) pairs.
+    """
+    kh, kw = kernel_size
+    h, w = spatial
+    sh, sw = strides
+    if isinstance(padding, str):
+        if padding.upper() == 'VALID':
+            return ((0, 0), (0, 0))
+        if padding.upper() == 'SAME':
+            out = []
+            for dim, k, s in ((h, kh, sh), (w, kw, sw)):
+                o = -(-dim // s)
+                total = max((o - 1) * s + k - dim, 0)
+                out.append((total // 2, total - total // 2))
+            return tuple(out)
+        raise ValueError(f'unsupported padding {padding!r}')
+    if isinstance(padding, int):
+        return ((padding, padding), (padding, padding))
+    (a, b), (c, d) = padding
+    return ((a, b), (c, d))

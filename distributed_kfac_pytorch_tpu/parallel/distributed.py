@@ -107,7 +107,17 @@ def make_kfac_mesh(devices: Sequence[jax.Device] | None = None, *,
     ``seq_parallel > 1`` appends a third ``SEQ_AXIS`` of that size as the
     *innermost* (fastest-varying) axis, so the ring-attention ppermute
     hops between physically adjacent chips.
+
+    The device grid is *derived from* the golden KAISA topology spec
+    (``placement.WorkerAllocator``, reference kfac/utils.py:59-159,
+    pinned by tests/test_placement.py): mesh rows are the allocator's
+    contiguous inverse-broadcast groups, and the columns across rows are
+    exactly its strided gradient-broadcast groups — one source of truth
+    for the topology, consumed here rather than re-derived by reshape.
     """
+    from distributed_kfac_pytorch_tpu.parallel.placement import (
+        WorkerAllocator,
+    )
     if devices is None:
         devices = jax.devices()
     devices = np.asarray(devices)
@@ -116,10 +126,15 @@ def make_kfac_mesh(devices: Sequence[jax.Device] | None = None, *,
                          f'{devices.size} devices')
     dp = devices.size // seq_parallel
     gw = resolve_grad_workers(dp, comm_method, grad_worker_fraction)
+    alloc = WorkerAllocator(dp, gw / dp)
+    assert alloc.grad_workers == gw
+    # (n_inv_groups, grad_workers) grid of K-FAC ranks per the spec.
+    grid = np.asarray(alloc.bcast_inv_ranks)
     if seq_parallel > 1:
-        return Mesh(devices.reshape(dp // gw, gw, seq_parallel),
-                    KFAC_AXES + (SEQ_AXIS,))
-    return Mesh(devices.reshape(dp // gw, gw), KFAC_AXES)
+        # Rank r owns the contiguous run of seq_parallel devices.
+        devs = devices.reshape(dp, seq_parallel)[grid]
+        return Mesh(devs, KFAC_AXES + (SEQ_AXIS,))
+    return Mesh(devices[grid], KFAC_AXES)
 
 
 def normalize_batch_specs(batch_spec, batch):

@@ -37,13 +37,31 @@ class CheckpointManager:
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True))
 
-    def save(self, epoch: int, tree: dict, *, force: bool = False) -> None:
-        """Save a checkpoint tree (blocking)."""
+    def save(self, epoch: int, tree: dict, *, force: bool = False,
+             blocking: bool = False) -> None:
+        """Save a checkpoint tree.
+
+        Async by default: orbax snapshots the (device) arrays and writes
+        in a background thread, so a multi-GB ImageNet-scale save does
+        not stall the training loop (the step right after a save
+        proceeds while bytes hit disk). Pending writes are joined by the
+        next ``save``/``restore``/``latest_epoch``/``close`` call —
+        orbax serializes them internally — or explicitly via
+        :meth:`wait_until_finished`. Pass ``blocking=True`` (or call
+        ``wait_until_finished``) where durability must be certain before
+        proceeding, e.g. right before process exit.
+        """
         self._mgr.save(epoch, args=ocp.args.StandardSave(tree),
                        force=force)
+        if blocking:
+            self._mgr.wait_until_finished()
+
+    def wait_until_finished(self) -> None:
+        """Block until all pending async saves are durable on disk."""
         self._mgr.wait_until_finished()
 
     def latest_epoch(self) -> int | None:
+        self._mgr.wait_until_finished()  # join any pending async save
         return self._mgr.latest_step()
 
     def restore(self, epoch: int | None = None,
@@ -53,6 +71,7 @@ class CheckpointManager:
         ``like`` provides the target pytree structure/shardings; restored
         arrays adopt its placements (replicated vs row-sharded state).
         """
+        self._mgr.wait_until_finished()  # join any pending async save
         if epoch is None:
             epoch = self.latest_epoch()
         if epoch is None:
@@ -64,6 +83,7 @@ class CheckpointManager:
         return self._mgr.restore(epoch)
 
     def close(self):
+        self._mgr.wait_until_finished()
         self._mgr.close()
 
 

@@ -277,6 +277,7 @@ def main(argv=None):
                 state.extra_vars,
                 schedulers={'kfac': kfac_sched} if kfac_sched else None,
                 step=state.step))
+    mgr.wait_until_finished()  # async saves: durable before exit
     if writer is not None:
         writer.flush()
     if is_main:

@@ -292,6 +292,7 @@ def main(argv=None):
                 state.params, state.opt_state,
                 dkfac.state_dict(state.kfac_state), {},
                 schedulers={'kfac': kfac_sched}, step=state.step))
+    mgr.wait_until_finished()  # async saves: durable before exit
     if writer is not None:
         writer.flush()
     if is_main:

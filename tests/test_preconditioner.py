@@ -241,18 +241,26 @@ def test_load_state_dict_layer_mismatch_raises():
         kfac.load_state_dict(sd, params)
 
 
-def test_assign_workers_balances():
+def test_assign_work_balances():
+    """The single placement path (parallel.distributed.assign_work,
+    round-2: the parallel unused KFAC.assign_workers was removed) spreads
+    factor work across rows/columns and respects
+    distribute_layer_factors (reference preconditioner.py:616-659)."""
+    from distributed_kfac_pytorch_tpu.parallel.distributed import (
+        assign_work,
+    )
     kfac, params, state, x = setup_mlp()
-    assign = kfac.assign_workers(params, n_workers=4)
-    workers = set()
-    for a_w, g_w in assign.values():
-        workers.add(a_w)
-        workers.add(g_w)
-    assert workers <= set(range(4))
-    assert len(workers) > 1  # spread across workers
-    joint = kfac.assign_workers(params, n_workers=4,
-                                distribute_layer_factors=False)
-    assert all(a == g for a, g in joint.values())
+    asg = assign_work(kfac, params, n_rows=2, n_cols=2)
+    assert set(asg.layer_row.values()) == {0, 1}  # both rows used
+    # With distribute_layer_factors=False, a layer's A and G land in the
+    # same column slot group (the reference's coallocate mode).
+    joint = assign_work(kfac, params, n_rows=1, n_cols=2,
+                        distribute_layer_factors=False)
+    col_of = {}
+    for dim, plan in joint.buckets.items():
+        for (name, which), slot in plan.slot.items():
+            col_of.setdefault(name, set()).add(slot // plan.slots_per_col)
+    assert all(len(cols) == 1 for cols in col_of.values())
 
 
 def test_memory_usage_reports():

@@ -97,6 +97,43 @@ class TestDatasets:
         assert out.shape == x.shape
         assert np.isfinite(out).all()
 
+    def test_imagenet_tfdata_real_tree(self, tmp_path):
+        """Exercise the real-data ImageFolder pipeline (round-2 VERDICT
+        #10) against a tiny generated JPEG tree, so its first execution
+        is not on a pod: class-table order, decode, augmentation shapes,
+        normalization, and eval determinism."""
+        tf = pytest.importorskip('tensorflow')
+        rng = np.random.default_rng(0)
+        # Deliberately create class_b FIRST with MORE images: if the
+        # class table ever follows creation order instead of sorted
+        # order, the per-label counts below flip and the test fails.
+        for split, counts in (('train', {'class_b': 4, 'class_a': 2}),
+                              ('val', {'class_b': 2, 'class_a': 2})):
+            for cls, n_per in counts.items():
+                d = tmp_path / split / cls
+                d.mkdir(parents=True)
+                for i in range(n_per):
+                    img = rng.integers(0, 255, (40, 52, 3),
+                                       dtype=np.uint8)
+                    enc = tf.io.encode_jpeg(tf.constant(img))
+                    (d / f'{i}.jpg').write_bytes(enc.numpy())
+
+        train_ds, val_ds = datasets.imagenet_tfdata(str(tmp_path),
+                                                    image_size=32)
+        xs, ys = next(iter(train_ds.batch(6)))
+        assert xs.shape == (6, 32, 32, 3)
+        assert xs.dtype == tf.float32
+        # Sorted class order: class_a (2 images) -> 0, class_b (4) -> 1.
+        labels = ys.numpy().tolist()
+        assert labels.count(0) == 2 and labels.count(1) == 4, labels
+        # Normalized values are centered-ish, not raw [0, 255].
+        assert float(tf.reduce_max(tf.abs(xs))) < 10.0
+
+        v1 = next(iter(val_ds.batch(4)))[0].numpy()
+        v2 = next(iter(val_ds.batch(4)))[0].numpy()
+        np.testing.assert_array_equal(v1, v2)  # eval path deterministic
+        assert v1.shape == (4, 32, 32, 3)
+
 
 class TestOptimizers:
     def test_sgd_matches_torch_semantics(self):
@@ -269,3 +306,29 @@ class TestCheckpoint:
             list(sd['factors'])[0]]}}
         with pytest.raises(ValueError, match='do not match'):
             dkfac.load_state_dict(sd, state.params)
+
+
+class TestAsyncCheckpoint:
+    def test_async_save_then_restore_roundtrip(self, tmp_path):
+        """save() is async by default (round-2 VERDICT #8): it returns
+        before durability, later manager calls join the write, and the
+        restored tree is exact."""
+        mgr = ckpt_lib.CheckpointManager(str(tmp_path / 'ck'))
+        tree = {'params': {'w': jnp.arange(8.0)},
+                'scalars': {'step': 7}}
+        mgr.save(0, tree)              # non-blocking
+        # Training-loop work proceeds here while orbax writes...
+        _ = jnp.sum(jnp.ones((64, 64)) @ jnp.ones((64, 64)))
+        mgr.wait_until_finished()
+        restored = mgr.restore(like=tree)
+        np.testing.assert_array_equal(restored['params']['w'],
+                                      np.arange(8.0))
+        assert int(restored['scalars']['step']) == 7
+        # A second async save joins implicitly through restore().
+        tree2 = {'params': {'w': jnp.arange(8.0) * 2},
+                 'scalars': {'step': 9}}
+        mgr.save(1, tree2)
+        restored2 = mgr.restore(like=tree2)
+        np.testing.assert_array_equal(restored2['params']['w'],
+                                      np.arange(8.0) * 2)
+        mgr.close()
