@@ -41,15 +41,38 @@ from distributed_kfac_pytorch_tpu.training import (
 )
 
 
+class _MLP:
+    """BN-free MLP classifier over flattened images — the workload
+    family K-FAC's advantage is cleanest on (no batch-stat lag under
+    large preconditioned steps; the original K-FAC papers' domain)."""
+
+    @staticmethod
+    def build():
+        import flax.linen as nn
+
+        class MLP(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=True):
+                x = x.reshape(x.shape[0], -1)
+                x = nn.Dense(512)(x)
+                x = nn.relu(x)
+                x = nn.Dense(256)(x)
+                x = nn.relu(x)
+                return nn.Dense(10)(x)
+        return MLP()
+
+
 def run_one(use_kfac: bool, args, data):
     (train_x, train_y), (val_x, val_y) = data
-    model = cifar_resnet.get_model(args.model)
+    model = (_MLP.build() if args.model == 'mlp'
+             else cifar_resnet.get_model(args.model))
     cfg = optimizers.OptimConfig(
         base_lr=args.base_lr, momentum=0.9, weight_decay=5e-4,
         warmup_epochs=args.warmup, lr_decay=args.lr_decay,
         workers=1,
         kfac_inv_update_freq=args.kfac_update_freq if use_kfac else 0,
-        kfac_cov_update_freq=1, damping=0.003, kl_clip=0.001)
+        kfac_cov_update_freq=1, damping=args.damping,
+        kl_clip=0.001, eigh_method=args.eigh_method)
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(
         model, cfg)
 
@@ -59,7 +82,9 @@ def run_one(use_kfac: bool, args, data):
     else:
         variables = model.init(jax.random.PRNGKey(args.seed), x0)
     params = variables['params']
-    extra = {'batch_stats': variables['batch_stats']}
+    extra = ({'batch_stats': variables['batch_stats']}
+             if 'batch_stats' in variables else {})
+    mutable = tuple(extra)
     mesh = D.make_kfac_mesh()
     opt_state = tx.init(params)
 
@@ -73,13 +98,12 @@ def run_one(use_kfac: bool, args, data):
         dkfac = D.DistributedKFAC(kfac, mesh, params)
         kstate = dkfac.init_state(params)
         step_fn = dkfac.build_train_step(
-            loss_fn, tx, metrics_fn=metrics_fn,
-            mutable_cols=('batch_stats',))
+            loss_fn, tx, metrics_fn=metrics_fn, mutable_cols=mutable)
     else:
         dkfac, kstate = None, None
         step_fn = engine.build_sgd_train_step(
             model, loss_fn, tx, mesh, metrics_fn=metrics_fn,
-            mutable_cols=('batch_stats',))
+            mutable_cols=mutable)
     eval_step = engine.make_eval_step(
         model, loss_fn, mesh, model_args_fn=lambda b: (b[0], False))
 
@@ -129,6 +153,14 @@ def main(argv=None):
     p.add_argument('--warmup', type=float, default=2)
     p.add_argument('--lr-decay', type=int, nargs='+', default=[15, 23])
     p.add_argument('--kfac-update-freq', type=int, default=10)
+    p.add_argument('--damping', type=float, default=0.003)
+    p.add_argument('--eigh-method', default='auto')
+    p.add_argument('--label-noise', type=float, default=0.0,
+                   help='fraction of train labels flipped (fixed seed): '
+                        'makes the synthetic task non-separable so the '
+                        'accuracy target is meaningful')
+    p.add_argument('--only', default=None, choices=['kfac', 'sgd'],
+                   help='run a single optimizer (hyperparameter sweeps)')
     p.add_argument('--synthetic-size', type=int, default=4096)
     p.add_argument('--data-dir', default=None)
     p.add_argument('--seed', type=int, default=42)
@@ -145,17 +177,31 @@ def main(argv=None):
 
     data = datasets.get_cifar(args.data_dir,
                               synthetic_size=args.synthetic_size)
+    if args.label_noise > 0:
+        (tx_, ty_), val = data
+        rng = np.random.default_rng(123)
+        flip = rng.random(len(ty_)) < args.label_noise
+        noisy = rng.integers(0, int(ty_.max()) + 1,
+                             len(ty_)).astype(ty_.dtype)
+        ty_ = np.where(flip, noisy, ty_)
+        data = ((tx_, ty_), val)
     print(f'backend={jax.default_backend()} devices={jax.device_count()} '
-          f'train={data[0][0].shape} val={data[1][0].shape}', flush=True)
+          f'train={data[0][0].shape} val={data[1][0].shape} '
+          f'label_noise={args.label_noise}', flush=True)
 
-    kfac_curve, kfac_wall = run_one(True, args, data)
-    sgd_curve, sgd_wall = run_one(False, args, data)
+    results_blocks = {}
+    if args.only in (None, 'kfac'):
+        kfac_curve, kfac_wall = run_one(True, args, data)
+        results_blocks['kfac'] = (kfac_curve, kfac_wall)
+    if args.only in (None, 'sgd'):
+        sgd_curve, sgd_wall = run_one(False, args, data)
+        results_blocks['sgd'] = (sgd_curve, sgd_wall)
 
-    best_sgd = max(r['val_acc'] for r in sgd_curve)
-    best_kfac = max(r['val_acc'] for r in kfac_curve)
-    # Epochs-to-target at the best accuracy BOTH reach (the papers'
-    # time-to-accuracy framing, BASELINE.md).
-    target = min(best_sgd, best_kfac) * 0.995
+    bests = {k: max(r['val_acc'] for r in c)
+             for k, (c, _) in results_blocks.items()}
+    # Epochs-to-target at the best accuracy EVERY ran optimizer reaches
+    # (the papers' time-to-accuracy framing, BASELINE.md).
+    target = min(bests.values()) * 0.995
     result = {
         'workload': f'{args.model}_cifar_'
                     f'{"synthetic" if args.data_dir is None else "real"}',
@@ -163,25 +209,27 @@ def main(argv=None):
         'devices': jax.device_count(),
         'epochs': args.epochs,
         'batch_size': args.batch_size,
+        'label_noise': args.label_noise,
+        'damping': args.damping,
         'target_val_acc': round(target, 4),
-        'kfac': {'best_val_acc': best_kfac,
-                 'epochs_to_target': epochs_to_target(kfac_curve, target),
-                 'wall_s': round(kfac_wall, 1),
-                 'curve': kfac_curve},
-        'sgd': {'best_val_acc': best_sgd,
-                'epochs_to_target': epochs_to_target(sgd_curve, target),
-                'wall_s': round(sgd_wall, 1),
-                'curve': sgd_curve},
     }
+    if args.only:
+        # Single-optimizer sweep artifact: emit ONLY the ran block so
+        # the file can never masquerade as a two-optimizer comparison.
+        result['only'] = args.only
+    for k, (curve, wall) in results_blocks.items():
+        result[k] = {'best_val_acc': bests[k],
+                     'epochs_to_target': epochs_to_target(curve, target),
+                     'wall_s': round(wall, 1),
+                     'curve': curve}
     with open(args.out, 'w') as f:
         json.dump(result, f, indent=1)
-    print(json.dumps({k: v for k, v in result.items()
-                      if k not in ('kfac', 'sgd')}
-                     | {'kfac_best': best_kfac, 'sgd_best': best_sgd,
-                        'kfac_epochs_to_target':
-                            result['kfac']['epochs_to_target'],
-                        'sgd_epochs_to_target':
-                            result['sgd']['epochs_to_target']}))
+    summary = {k: v for k, v in result.items()
+               if k not in ('kfac', 'sgd')}
+    for k in results_blocks:
+        summary[f'{k}_best'] = bests[k]
+        summary[f'{k}_epochs_to_target'] = result[k]['epochs_to_target']
+    print(json.dumps(summary))
 
 
 if __name__ == '__main__':
