@@ -8,6 +8,7 @@ mutation, NHWC conv layout, and patch extraction via XLA's
 
 from __future__ import annotations
 
+import os
 from typing import Sequence
 
 import jax
@@ -193,6 +194,148 @@ def extract_conv2d_patches(x: jax.Array,
     return patches.reshape(b, oh, ow, kh * kw * c)
 
 
+def extract_conv2d_patches_slices(x: jax.Array,
+                                  kernel_size: Sequence[int],
+                                  strides: Sequence[int],
+                                  padding) -> jax.Array:
+    """im2col via explicit pad + KH*KW static strided slices + concat.
+
+    Same value and (kh, kw, c) feature order as
+    ``extract_conv2d_patches`` but assembled from shifted views instead
+    of the identity-kernel convolution that
+    ``conv_general_dilated_patches`` lowers to — the conv lowering costs
+    ``rows * d * d`` MXU FLOPs (as many as the covariance contraction
+    itself), while slicing is pure data movement. The natural piece
+    order here is (kh, kw, c), so no basis permutation is needed
+    downstream.
+    """
+    from distributed_kfac_pytorch_tpu.ops.pallas_kernels import _canonical_pad
+
+    kh, kw = kernel_size
+    sh, sw = strides
+    b, h, w, c = x.shape
+    (ph_lo, ph_hi), (pw_lo, pw_hi) = _canonical_pad(
+        padding, (kh, kw), (h, w), (sh, sw))
+    xp = jnp.pad(x, ((0, 0), (ph_lo, ph_hi), (pw_lo, pw_hi), (0, 0)))
+    oh = (h + ph_lo + ph_hi - kh) // sh + 1
+    ow = (w + pw_lo + pw_hi - kw) // sw + 1
+    pieces = [
+        jax.lax.slice(xp, (0, ki, kj, 0),
+                      (b, ki + sh * (oh - 1) + 1, kj + sw * (ow - 1) + 1, c),
+                      (1, sh, sw, 1))
+        for ki in range(kh) for kj in range(kw)]
+    return jnp.concatenate(pieces, axis=-1)
+
+
+def _conv_out_geometry(a: jax.Array, kernel_size, strides, padding):
+    """(oh, ow, rows, spatial) of the conv output for NHWC input ``a``."""
+    from distributed_kfac_pytorch_tpu.ops.pallas_kernels import _canonical_pad
+
+    kh, kw = kernel_size
+    sh, sw = strides
+    b, h, w, _ = a.shape
+    (ph_lo, ph_hi), (pw_lo, pw_hi) = _canonical_pad(
+        padding, (kh, kw), (h, w), (sh, sw))
+    oh = (h + ph_lo + ph_hi - kh) // sh + 1
+    ow = (w + pw_lo + pw_hi - kw) // sw + 1
+    spatial = oh * ow
+    return oh, ow, b * spatial, spatial
+
+
+def _conv_bias_col(a: jax.Array, kernel_size, strides, padding,
+                   rows: int, spatial: int) -> jax.Array:
+    """Per-feature patch-row mean in (kh, kw, c) order, from the padded
+    input's batch-sum instead of a second full read of the ~KH*KW x
+    blown-up patch tensor (the covariance dot and a column reduce cannot
+    be fused into one pass by XLA)."""
+    from distributed_kfac_pytorch_tpu.ops.pallas_kernels import _canonical_pad
+
+    kh, kw = kernel_size
+    sh, sw = strides
+    b, h, w, c = a.shape
+    (ph_lo, ph_hi), (pw_lo, pw_hi) = _canonical_pad(
+        padding, (kh, kw), (h, w), (sh, sw))
+    oh = (h + ph_lo + ph_hi - kh) // sh + 1
+    ow = (w + pw_lo + pw_hi - kw) // sw + 1
+    xp_sum = jnp.pad(a, ((0, 0), (ph_lo, ph_hi), (pw_lo, pw_hi),
+                         (0, 0))).sum(0, dtype=jnp.float32)
+    piece_means = [
+        jax.lax.slice(
+            xp_sum, (ki, kj, 0),
+            (ki + sh * (oh - 1) + 1, kj + sw * (ow - 1) + 1, c),
+            (sh, sw, 1)).sum((0, 1)) / rows
+        for ki in range(kh) for kj in range(kw)]
+    return jnp.concatenate(piece_means) / (spatial * spatial)
+
+
+def _conv_a_cov_crosscov(a: jax.Array, kernel_size, strides, padding,
+                         compute_dtype) -> jax.Array | None:
+    """Patch-Gram ``P^T P`` without materializing the im2col tensor.
+
+    Exact reordering of the covariance sum: with ``U_ki`` the h-shifted
+    strided view of the padded input flattened to ``(B*OH, Wp*C)``,
+
+        M(ki, ki')[(w, c), (w', c')] = U_ki^T U_ki'
+        A[(ki, kj, c), (ki', kj', c')] = sum_q M(ki, ki')
+                                           [(kj + sw*q, c), (kj' + sw*q, c')]
+
+    i.e. one full-lane-width matmul per unique (ki <= ki') pair followed
+    by a tiny band-trace (diagonal gather + einsum) on the (Wp*C)^2
+    output. Versus the materialized-patches path this skips the KH*KW x
+    patch-tensor HBM write+read and the lane-starved (rows, KH*KW*C)
+    contraction (C=16 stage-1 CIFAR blocks use 16 of 128 MXU lanes; the
+    (Wp*C, Wp*C) output here uses them all). Measured on v5e it cut the
+    tracked-config A-factor phase by ~2x (PERF.md round 2).
+
+    Returns the unscaled Gram sum in (kh, kw, c) feature order, or None
+    when the shape is out of the profitable/VMEM-safe regime (Wp*C >
+    1024 — e.g. ImageNet-resolution convs — or 1x1 kernels, where there
+    is no patch blowup to avoid); callers fall back to the slices path.
+    """
+    from distributed_kfac_pytorch_tpu.ops.pallas_kernels import _canonical_pad
+
+    kh, kw = kernel_size
+    sh, sw = strides
+    b, h, w, c = a.shape
+    (ph_lo, ph_hi), (pw_lo, pw_hi) = _canonical_pad(
+        padding, (kh, kw), (h, w), (sh, sw))
+    wp = w + pw_lo + pw_hi
+    if kh * kw == 1 or wp * c > 1024:
+        return None
+    xp = jnp.pad(a, ((0, 0), (ph_lo, ph_hi), (pw_lo, pw_hi), (0, 0)))
+    oh = (h + ph_lo + ph_hi - kh) // sh + 1
+    ow = (w + pw_lo + pw_hi - kw) // sw + 1
+    precision = None
+    if compute_dtype is not None and jnp.dtype(compute_dtype) == jnp.float32:
+        precision = jax.lax.Precision.HIGHEST
+
+    u = [jax.lax.slice(xp, (0, ki, 0, 0),
+                       (b, ki + sh * (oh - 1) + 1, wp, c),
+                       (1, sh, 1, 1)).reshape(b * oh, wp * c)
+         for ki in range(kh)]
+    # q-window index grid: row q of the band for w-offset kj
+    qidx = (jnp.arange(kw)[:, None] + sw * jnp.arange(ow)[None, :])  # (kw, ow)
+    blocks: dict[tuple[int, int], jax.Array] = {}
+    for ki in range(kh):
+        for ki2 in range(ki, kh):
+            m = jnp.matmul(u[ki].T, u[ki2],
+                           preferred_element_type=jnp.float32,
+                           precision=precision).reshape(wp, c, wp, c)
+            g1 = jnp.take(m, qidx, axis=0)           # (kw, ow, c, wp, c)
+            g2 = jnp.take(g1, qidx, axis=3)          # (kw, ow, c, kw, ow, c)
+            # diagonal over the two q axes + sum: the band trace
+            blocks[(ki, ki2)] = jnp.einsum('kqcmqd->kcmd', g2)
+    rows_out = []
+    for ki in range(kh):
+        row = []
+        for ki2 in range(kh):
+            blk = (blocks[(ki, ki2)] if ki <= ki2
+                   else jnp.transpose(blocks[(ki2, ki)], (2, 3, 0, 1)))
+            row.append(blk.reshape(kw * c, kw * c))
+        rows_out.append(jnp.concatenate(row, axis=1))
+    return jnp.concatenate(rows_out, axis=0)
+
+
 def conv2d_a_factor(a: jax.Array, kernel_size, strides, padding,
                     has_bias: bool, compute_dtype=None) -> jax.Array:
     """A factor for conv2d from NHWC inputs via im2col patches.
@@ -213,19 +356,15 @@ def conv2d_a_factor(a: jax.Array, kernel_size, strides, padding,
     kh, kw = kernel_size
     c = a.shape[-1]
     d = kh * kw * c
-    if jax.default_backend() == 'tpu' and d <= 640:
-        # Fused VMEM patch-covariance Pallas kernel: never materializes
-        # the KH*KW x im2col blowup in HBM (measured ~14 ms/iter of
-        # patch-tensor write+read on the tracked CIFAR config — the
-        # single largest K-FAC cost after round 1). Guarded to factor
-        # dims whose (D, D) accumulator + patch block fit VMEM
-        # comfortably (d<=640 covers every CIFAR ResNet conv and the
-        # ImageNet conv1/stage-1 convs); bigger convs take the
-        # bf16-patch XLA path below. The
-        # one-time fused_patch_cov_supported probe compiles AND runs a
-        # tiny instance first — Mosaic failures are not catchable at
-        # this dispatch site — and KFAC_DISABLE_FUSED_PATCH_COV=1
-        # force-disables.
+    if os.environ.get('KFAC_FUSED_PATCH_COV', '') == '1' and (
+            jax.default_backend() == 'tpu' and d <= 640):
+        # Opt-in fused VMEM patch-covariance Pallas kernel. Measured on
+        # v5e (chained, cache-proof methodology): ~11 ms per stage-1
+        # CIFAR layer vs ~0.6 ms for the XLA path below — Mosaic lowers
+        # the in-kernel patch assembly (strided sublane slices + lane
+        # concat of 16-lane pieces) as VPU shuffles that dwarf the
+        # matmul, so the HBM-traffic saving never materializes. Kept as
+        # an opt-in study kernel (like the Jacobi eigh); see PERF.md §2.
         from distributed_kfac_pytorch_tpu.ops import pallas_kernels
         try:
             if not pallas_kernels.fused_patch_cov_supported():
@@ -246,6 +385,45 @@ def conv2d_a_factor(a: jax.Array, kernel_size, strides, padding,
         # dominates conv factor updates. Strict fp32
         # (compute_dtype=float32) keeps fp32 patches.
         a = a.astype(jnp.bfloat16)
+    impl = os.environ.get('KFAC_CONV_PATCH_IMPL', 'auto')
+    if impl in ('auto', 'crosscov'):
+        # Preferred: cross-covariance band-trace formulation — never
+        # materializes the patch tensor and runs full-lane-width
+        # matmuls (see _conv_a_cov_crosscov). Falls through to the
+        # slices path outside its shape regime.
+        a_cc = a if compute_dtype is None else a.astype(compute_dtype)
+        gram = _conv_a_cov_crosscov(a_cc, kernel_size, strides, padding,
+                                    compute_dtype)
+        if gram is not None:
+            oh, ow, rows, spatial = _conv_out_geometry(
+                a, kernel_size, strides, padding)
+            cov = gram * (1.0 / (rows * spatial * spatial))
+            if not has_bias:
+                return cov
+            bias_col = _conv_bias_col(a, kernel_size, strides, padding,
+                                      rows, spatial).astype(cov.dtype)
+            return _assemble_bias_factor(cov, bias_col,
+                                         1.0 / (spatial * spatial))
+    if impl in ('auto', 'crosscov', 'slices'):
+        # pad+slice+concat assembly. The dilated-patches op
+        # lowers to an identity-kernel conv whose MXU FLOPs equal the
+        # covariance contraction itself; slicing is pure data movement
+        # and emits (kh, kw, c) feature order directly (no (D, D)
+        # basis permutation afterwards).
+        patches = extract_conv2d_patches_slices(a, kernel_size, strides,
+                                                padding)
+        b, oh, ow, d = patches.shape
+        spatial = oh * ow
+        rows = b * spatial
+        p2 = patches.reshape(rows, d)
+        cov = get_cov(p2, scale=rows * spatial * spatial,
+                      compute_dtype=compute_dtype)
+        if not has_bias:
+            return cov
+        bias_col = _conv_bias_col(a, kernel_size, strides, padding,
+                                  rows, spatial).astype(cov.dtype)
+        return _assemble_bias_factor(cov, bias_col,
+                                     1.0 / (spatial * spatial))
     patches = jax.lax.conv_general_dilated_patches(
         a, filter_shape=(kh, kw), window_strides=tuple(strides),
         padding=padding, dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
