@@ -280,17 +280,23 @@ def _conv_a_cov_crosscov(a: jax.Array, kernel_size, strides, padding,
                                            [(kj + sw*q, c), (kj' + sw*q, c')]
 
     i.e. one full-lane-width matmul per unique (ki <= ki') pair followed
-    by a tiny band-trace (diagonal gather + einsum) on the (Wp*C)^2
-    output. Versus the materialized-patches path this skips the KH*KW x
-    patch-tensor HBM write+read and the lane-starved (rows, KH*KW*C)
-    contraction (C=16 stage-1 CIFAR blocks use 16 of 128 MXU lanes; the
-    (Wp*C, Wp*C) output here uses them all). Measured on v5e it cut the
-    tracked-config A-factor phase by ~2x (PERF.md round 2).
+    by a band-trace (diagonal gather + einsum) on the (Wp*C)^2 output.
+    The hope was to skip the KH*KW x patch-tensor HBM write+read and the
+    lane-starved (rows, KH*KW*C) contraction.
+
+    MEASURED NEGATIVE (round 2 → 3): as the default this regressed the
+    tracked-config whole step from 24.3 to 80.2 ms/iter on v5e
+    (BENCH_r02.json; VERDICT round 2 bisection). Analytically the
+    (Wp*C)^2 pair matmuls do ~2.6x the MACs of the patch contraction,
+    and the band trace is built from ``jnp.take``/diagonal-einsum — the
+    gather class :func:`pack_symmetric`'s note calls out as slow on
+    TPU. Kept as an opt-in study path (KFAC_CONV_PATCH_IMPL=crosscov);
+    the production default is the slices path. See PERF.md.
 
     Returns the unscaled Gram sum in (kh, kw, c) feature order, or None
-    when the shape is out of the profitable/VMEM-safe regime (Wp*C >
-    1024 — e.g. ImageNet-resolution convs — or 1x1 kernels, where there
-    is no patch blowup to avoid); callers fall back to the slices path.
+    when the shape is out of the VMEM-safe regime (Wp*C > 1024 — e.g.
+    ImageNet-resolution convs — or 1x1 kernels, where there is no patch
+    blowup to avoid); callers fall back to the slices path.
     """
     from distributed_kfac_pytorch_tpu.ops.pallas_kernels import _canonical_pad
 
@@ -333,7 +339,10 @@ def _conv_a_cov_crosscov(a: jax.Array, kernel_size, strides, padding,
                    else jnp.transpose(blocks[(ki2, ki)], (2, 3, 0, 1)))
             row.append(blk.reshape(kw * c, kw * c))
         rows_out.append(jnp.concatenate(row, axis=1))
-    return jnp.concatenate(rows_out, axis=0)
+    gram = jnp.concatenate(rows_out, axis=0)
+    # Explicit symmetrization for consistency with get_cov: the diagonal
+    # (ki == ki') blocks rely on u^T u being exactly symmetric otherwise.
+    return (gram + gram.T) * 0.5
 
 
 def conv2d_a_factor(a: jax.Array, kernel_size, strides, padding,
@@ -343,15 +352,24 @@ def conv2d_a_factor(a: jax.Array, kernel_size, strides, padding,
     Same value as the reference formula (kfac/layers/conv.py:24-34:
     ``a / spatial_size`` after ``append_bias_ones``, then cov over all
     B*OH*OW rows), restructured so nothing batch-sized is ever copied:
+    the 1/spatial scaling folds into the covariance output scale and the
+    bias row/column is assembled analytically (profiled on v5e: relayout
+    copies, the ones-column concat, and the spatial-size divide were
+    ~95% of the whole K-FAC step time in a naive translation).
 
-      - patches stay in ``conv_general_dilated_patches``'s native
-        (c, kh, kw) feature order; the basis permutation to (kh, kw, c)
-        is applied to the *small* (D, D) covariance instead of
-        transposing the ~300 MB patch tensor (profiled on v5e: those
-        relayout copies, the ones-column concat, and the spatial-size
-        divide were ~95% of the whole K-FAC step time);
-      - the 1/spatial scaling folds into the covariance output scale;
-      - the bias row/column is assembled analytically.
+    Patch-extraction dispatch (``KFAC_CONV_PATCH_IMPL``):
+
+      - ``auto``/``slices`` (default): pad + KH*KW strided slices +
+        concat in (kh, kw, c) order — the measured-fastest path on v5e
+        (24.3 ms/iter whole-step on the tracked config).
+      - ``crosscov``: band-trace Gram that never materializes the patch
+        tensor — measured 3.3x whole-step regression, opt-in study path
+        only (see _conv_a_cov_crosscov).
+      - ``dilated``: legacy ``conv_general_dilated_patches`` path with
+        the (c, kh, kw) -> (kh, kw, c) permutation applied to the small
+        (D, D) covariance; ~38 ms/iter whole-step (BENCH_r01).
+      - ``KFAC_FUSED_PATCH_COV=1``: opt-in fused Pallas study kernel
+        (measured 18x slower than XLA per layer; kept for study).
     """
     kh, kw = kernel_size
     c = a.shape[-1]
@@ -386,11 +404,15 @@ def conv2d_a_factor(a: jax.Array, kernel_size, strides, padding,
         # (compute_dtype=float32) keeps fp32 patches.
         a = a.astype(jnp.bfloat16)
     impl = os.environ.get('KFAC_CONV_PATCH_IMPL', 'auto')
-    if impl in ('auto', 'crosscov'):
-        # Preferred: cross-covariance band-trace formulation — never
-        # materializes the patch tensor and runs full-lane-width
-        # matmuls (see _conv_a_cov_crosscov). Falls through to the
-        # slices path outside its shape regime.
+    if impl not in ('auto', 'slices', 'crosscov', 'dilated'):
+        raise ValueError(
+            f'KFAC_CONV_PATCH_IMPL={impl!r}: expected one of '
+            "'auto', 'slices', 'crosscov', 'dilated'")
+    if impl == 'crosscov':
+        # Opt-in ONLY: measured 3.3x whole-step regression as the
+        # default on v5e (BENCH_r02.json) — see _conv_a_cov_crosscov's
+        # MEASURED NEGATIVE note. Falls through to the slices path
+        # outside its shape regime.
         a_cc = a if compute_dtype is None else a.astype(compute_dtype)
         gram = _conv_a_cov_crosscov(a_cc, kernel_size, strides, padding,
                                     compute_dtype)
@@ -404,12 +426,14 @@ def conv2d_a_factor(a: jax.Array, kernel_size, strides, padding,
                                       rows, spatial).astype(cov.dtype)
             return _assemble_bias_factor(cov, bias_col,
                                          1.0 / (spatial * spatial))
-    if impl in ('auto', 'crosscov', 'slices'):
-        # pad+slice+concat assembly. The dilated-patches op
-        # lowers to an identity-kernel conv whose MXU FLOPs equal the
-        # covariance contraction itself; slicing is pure data movement
-        # and emits (kh, kw, c) feature order directly (no (D, D)
-        # basis permutation afterwards).
+    if impl in ('auto', 'slices', 'crosscov'):
+        # DEFAULT: pad+slice+concat assembly — measured 24.3 ms/iter
+        # whole-step on the tracked v5e config vs 80.2 for crosscov and
+        # ~38 for dilated (BENCH_r01/r02 + round-2 verdict bisection).
+        # The dilated-patches op lowers to an identity-kernel conv whose
+        # MXU FLOPs equal the covariance contraction itself; slicing is
+        # pure data movement and emits (kh, kw, c) feature order
+        # directly (no (D, D) basis permutation afterwards).
         patches = extract_conv2d_patches_slices(a, kernel_size, strides,
                                                 padding)
         b, oh, ow, d = patches.shape
@@ -424,6 +448,7 @@ def conv2d_a_factor(a: jax.Array, kernel_size, strides, padding,
                                   rows, spatial).astype(cov.dtype)
         return _assemble_bias_factor(cov, bias_col,
                                      1.0 / (spatial * spatial))
+    # impl == 'dilated': legacy identity-kernel-conv im2col.
     patches = jax.lax.conv_general_dilated_patches(
         a, filter_shape=(kh, kw), window_strides=tuple(strides),
         padding=padding, dimension_numbers=('NHWC', 'HWIO', 'NHWC'))

@@ -374,13 +374,10 @@ def fused_patch_cov_supported() -> bool:
     Mosaic failures (VMEM overflow, unsupported lowering) surface at
     jit-compile or run time — not as catchable trace-time errors at the
     dispatch site — so the dispatcher calls this once per process and
-    falls back to the XLA path for good if the probe fails. Operators
-    can also force the fallback with KFAC_DISABLE_FUSED_PATCH_COV=1.
+    falls back to the XLA path for good if the probe fails. The kernel
+    itself is opt-in (KFAC_FUSED_PATCH_COV=1 at the dispatch site,
+    factors.conv2d_a_factor) — not opting in is the only disable switch.
     """
-    import os
-
-    if os.environ.get('KFAC_DISABLE_FUSED_PATCH_COV', '') == '1':
-        return False
     if jax.default_backend() != 'tpu':
         return False
     try:

@@ -262,3 +262,45 @@ class TestFusedPatchCov:
             block_batch=2, interpret=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestConvPatchImplDispatch:
+    """KFAC_CONV_PATCH_IMPL dispatch: every named impl computes the same
+    A factor (slices is the measured-fastest default after the round-2
+    crosscov regression — VERDICT r2 / BENCH_r02.json), and unknown
+    values are rejected loudly instead of silently hitting a legacy
+    path."""
+
+    @pytest.mark.parametrize('impl', ['slices', 'crosscov', 'dilated'])
+    @pytest.mark.parametrize('cfg', [
+        dict(h=8, w=8, c=3, k=(3, 3), s=(1, 1), pad='SAME', bias=True),
+        dict(h=9, w=7, c=2, k=(3, 3), s=(2, 2), pad='VALID', bias=False),
+    ], ids=['same', 'valid-stride2'])
+    def test_impls_agree(self, impl, cfg, monkeypatch):
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(size=(4, cfg['h'], cfg['w'],
+                                         cfg['c'])), jnp.float32)
+        monkeypatch.delenv('KFAC_CONV_PATCH_IMPL', raising=False)
+        ref = factors.conv2d_a_factor(x, cfg['k'], cfg['s'], cfg['pad'],
+                                      cfg['bias'],
+                                      compute_dtype=jnp.float32)
+        monkeypatch.setenv('KFAC_CONV_PATCH_IMPL', impl)
+        got = factors.conv2d_a_factor(x, cfg['k'], cfg['s'], cfg['pad'],
+                                      cfg['bias'],
+                                      compute_dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_crosscov_symmetric(self, monkeypatch):
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.normal(size=(4, 8, 8, 3)), jnp.float32)
+        monkeypatch.setenv('KFAC_CONV_PATCH_IMPL', 'crosscov')
+        got = np.asarray(factors.conv2d_a_factor(
+            x, (3, 3), (1, 1), 'SAME', False, compute_dtype=jnp.float32))
+        np.testing.assert_array_equal(got, got.T)
+
+    def test_unknown_impl_rejected(self, monkeypatch):
+        x = jnp.zeros((2, 4, 4, 3), jnp.float32)
+        monkeypatch.setenv('KFAC_CONV_PATCH_IMPL', 'bogus')
+        with pytest.raises(ValueError, match='KFAC_CONV_PATCH_IMPL'):
+            factors.conv2d_a_factor(x, (3, 3), (1, 1), 'SAME', True)
