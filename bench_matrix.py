@@ -41,9 +41,10 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-# Single source of truth for the chained-carry timing methodology (the
-# only trustworthy form on the tunneled backend — see bench.py).
-from bench import time_chained
+# Single source of truth for the chained-carry timing methodology and
+# the FLOPs-floor sanity gate (the only trustworthy form on the tunneled
+# backend — see bench.py).
+from bench import flops_floor_ms, time_chained
 
 
 def emit(obj):
@@ -81,7 +82,10 @@ def scan_block_runner(make_body_pair, carry, inv_freq, n_iters):
     return run
 
 
-def build_cnn_bodies(model, x, y, kfac_kwargs, inv_freq):
+def build_cnn_bodies(model, x, y, kfac_kwargs, inv_freq, floor=None):
+    """``floor=None`` computes the FLOPs floor (shape-only; identical
+    across a kfac_kwargs sweep, so sweeps pass the first label's floor
+    back in to skip the redundant eval_shape traces)."""
     from distributed_kfac_pytorch_tpu import KFAC
 
     kfac = KFAC(model, factor_update_freq=1, inv_update_freq=inv_freq,
@@ -111,8 +115,11 @@ def build_cnn_bodies(model, x, y, kfac_kwargs, inv_freq):
             return (params, opt_state, kstate, {**extra, **updated}), loss
         return body
 
+    if floor is None:
+        floor = flops_floor_ms(kfac, variables, x, y,
+                               mutable_cols=('batch_stats',))
     return ((make_body(True), make_body(False)),
-            (params, opt_state, kstate, extra))
+            (params, opt_state, kstate, extra), floor)
 
 
 def config1_cifar_methods(args):
@@ -123,13 +130,16 @@ def config1_cifar_methods(args):
     y = jax.random.randint(jax.random.PRNGKey(2), (512,), 0, 10)
     out = {}
     n = rounded_iters(args.iters, 10)
+    floor = None
     for label, kw in (('eigen', {}),
                       ('eigen-xla', {'eigh_method': 'xla'}),
                       ('cholesky', {'inverse_method': 'cholesky'}),
                       ('newton', {'inverse_method': 'newton'})):
-        bodies, carry = build_cnn_bodies(model, x, y, kw, inv_freq=10)
+        bodies, carry, floor = build_cnn_bodies(model, x, y, kw,
+                                                inv_freq=10, floor=floor)
         run = scan_block_runner(bodies, carry, 10, n)
-        out[label] = round(time_chained(run, carry, n), 2)
+        out[label] = round(time_chained(run, carry, n, floor_ms=floor,
+                                        leg=label), 2)
     emit({'config': 1, 'workload': 'resnet32_cifar10_b512_invfreq10',
           'backend': jax.default_backend(), 'unit': 'ms/iter', **out})
 
@@ -145,9 +155,9 @@ def config2_imagenet(args):
     # inverses/100, reference torch_imagenet_resnet.py:75-78), so the
     # recorded number upper-bounds the production overhead.
     n = rounded_iters(args.iters, 10)
-    bodies, carry = build_cnn_bodies(model, x, y, {}, inv_freq=10)
+    bodies, carry, floor = build_cnn_bodies(model, x, y, {}, inv_freq=10)
     run = scan_block_runner(bodies, carry, 10, n)
-    ms = time_chained(run, carry, n)
+    ms = time_chained(run, carry, n, floor_ms=floor, leg='imagenet')
     emit({'config': 2,
           'workload': f'{args.imagenet_model}_imagenet176_b64'
                       '_stress_cadence_f1_inv10',
@@ -252,7 +262,8 @@ def config4_transformer_lm(args):
     n = rounded_iters(args.iters, 10)
     run = scan_block_runner((make_body(True), make_body(False)), carry,
                             10, n)
-    ms = time_chained(run, carry, n)
+    floor = flops_floor_ms(kfac, variables, ids, tgt, loss=loss_fn)
+    ms = time_chained(run, carry, n, floor_ms=floor, leg='transformer')
     emit({'config': 4,
           'workload': 'transformer_lm_d512_L4_seq256_b16_invfreq10',
           'backend': jax.default_backend(), 'unit': 'ms/iter',
@@ -266,15 +277,18 @@ def config5_bf16_factors(args):
     x = jax.random.normal(jax.random.PRNGKey(1), (512, 32, 32, 3))
     y = jax.random.randint(jax.random.PRNGKey(2), (512,), 0, 10)
     out = {}
+    floor = None
     for label, kw in (
             ('fp32_default', {}),
             ('bf16_factors', {'factor_dtype': jnp.bfloat16,
                               'factor_compute_dtype': jnp.bfloat16}),
             ('fp32_strict', {'factor_compute_dtype': jnp.float32})):
-        bodies, carry = build_cnn_bodies(model, x, y, kw, inv_freq=10)
+        bodies, carry, floor = build_cnn_bodies(model, x, y, kw,
+                                                inv_freq=10, floor=floor)
         n = rounded_iters(args.iters, 10)
         run = scan_block_runner(bodies, carry, 10, n)
-        out[label] = round(time_chained(run, carry, n), 2)
+        out[label] = round(time_chained(run, carry, n, floor_ms=floor,
+                                        leg=label), 2)
     emit({'config': 5,
           'workload': 'resnet32_cifar10_b512_factor_dtype_sweep',
           'backend': jax.default_backend(), 'unit': 'ms/iter', **out})

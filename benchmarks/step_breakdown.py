@@ -1,0 +1,181 @@
+"""Per-phase breakdown of the tracked-config K-FAC step (on-chip).
+
+Times cumulative program variants of the bench.py workload (ResNet-32 /
+CIFAR-10, batch 512, reference CIFAR cadence) so the per-phase cost of
+every pipeline stage is a recorded number, not an inference:
+
+  sgd            plain SGD step (fwd+bwd+momentum)
+  capture        fwd+bwd through the K-FAC capture machinery, SGD update
+                 (isolates the interception cost vs plain value_and_grad)
+  precond        + preconditioning with frozen inverses + KL clip
+                 (factor_update=False, inv_update=False)
+  factors        + factor EWMA every iter (factor_update=True)
+  full           + amortized inverse updates every ``inv_freq`` iters
+  full_polishN   full with eigh_polish_iters=N variants
+
+The phase cost is the difference between adjacent rows; the rows are
+cumulative so each is independently meaningful. Methodology = bench.py
+(scanned loop, chained carries, median-of-repeats, FLOPs floor).
+
+Reference cost centers this decomposes: compute_factors / allreduce
+(preconditioner.py:566-575), compute_inverses (:555-564),
+precondition+clip (:577-585,661-682).
+
+    python benchmarks/step_breakdown.py [--iters 30] [--polish 8 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import jax
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import bench as B  # noqa: E402  (repo root: the timing methodology)
+from distributed_kfac_pytorch_tpu import KFAC
+from distributed_kfac_pytorch_tpu.models import cifar_resnet
+
+
+def build(model, x, y, inv_freq, n_iters, mode, polish_iters=None):
+    """One scanned runner for a cumulative phase ``mode``."""
+    kw = {}
+    if polish_iters is not None:
+        kw['eigh_polish_iters'] = polish_iters
+    kfac = KFAC(model, factor_update_freq=1, inv_update_freq=inv_freq,
+                damping=0.003, lr=0.1, **kw)
+    variables, kstate = kfac.init(jax.random.PRNGKey(0), x)
+    params = variables['params']
+    extra = {k: v for k, v in variables.items() if k != 'params'}
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def loss(out):
+        return B.loss_fn(out, y)
+
+    def make_body(factor_update, inv_update, use_precond):
+        def body(carry, _):
+            params, opt_state, kstate, extra = carry
+            loss_v, _, grads, captures, updated = (
+                kfac.capture.loss_and_grads(
+                    loss, params, x, extra_vars=extra,
+                    mutable_cols=('batch_stats',)))
+            if use_precond:
+                g, kstate2 = kfac.step(kstate, grads, captures,
+                                       factor_update=factor_update,
+                                       inv_update=inv_update)
+            else:
+                g, kstate2 = grads, kstate
+            updates, opt_state = tx.update(g, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state, kstate2, {**extra, **updated}), loss_v
+        return body
+
+    if mode == 'sgd':
+        def sgd_body(carry, _):
+            params, opt_state, extra = carry
+
+            def wrapped(p):
+                out, updated = model.apply({'params': p, **extra}, x,
+                                           mutable=['batch_stats'])
+                return loss(out), updated
+            (l, updated), grads = jax.value_and_grad(
+                wrapped, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state, {**extra, **updated}), l
+
+        @jax.jit
+        def run(carry):
+            carry, losses = jax.lax.scan(sgd_body, carry, None,
+                                         length=n_iters)
+            return carry, losses[-1]
+        return run, (params, opt_state, extra)
+
+    if mode == 'capture':
+        body = make_body(False, False, use_precond=False)
+    elif mode == 'precond':
+        body = make_body(False, False, use_precond=True)
+    elif mode == 'factors':
+        body = make_body(True, False, use_precond=True)
+    elif mode == 'full':
+        inv_body = make_body(True, True, use_precond=True)
+        plain_body = make_body(True, False, use_precond=True)
+
+        def block(carry, _):
+            carry, l0 = inv_body(carry, None)
+            carry, ls = jax.lax.scan(plain_body, carry, None,
+                                     length=inv_freq - 1)
+            return carry, ls[-1]
+
+        @jax.jit
+        def run(carry):
+            carry, losses = jax.lax.scan(block, carry, None,
+                                         length=n_iters // inv_freq)
+            return carry, losses[-1]
+        return run, (params, opt_state, kstate, extra)
+    else:
+        raise ValueError(mode)
+
+    @jax.jit
+    def run(carry):
+        carry, losses = jax.lax.scan(body, carry, None, length=n_iters)
+        return carry, losses[-1]
+    return run, (params, opt_state, kstate, extra)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--iters', type=int, default=30)
+    p.add_argument('--polish', type=int, nargs='*', default=[16, 8])
+    args = p.parse_args(argv)
+
+    on_tpu = jax.default_backend() == 'tpu'
+    if on_tpu:
+        model = cifar_resnet.get_model('resnet32')
+        b = 512
+    else:
+        model = cifar_resnet.get_model('resnet20')
+        b = 16
+    x = jax.random.normal(jax.random.PRNGKey(1), (b, 32, 32, 3))
+    y = jax.random.randint(jax.random.PRNGKey(2), (b,), 0, 10)
+    inv_freq = 10
+    n_iters = (args.iters // inv_freq) * inv_freq or inv_freq
+
+    kfac = KFAC(model, factor_update_freq=1, inv_update_freq=inv_freq)
+    variables, _ = kfac.init(jax.random.PRNGKey(0), x)
+    floor_ms = B.flops_floor_ms(kfac, variables, x, y,
+                                mutable_cols=('batch_stats',))
+
+    rows = {}
+    for mode in ('sgd', 'capture', 'precond', 'factors', 'full'):
+        run, carry = build(model, x, y, inv_freq, n_iters, mode)
+        ms = B.time_chained(run, carry, n_iters, floor_ms=floor_ms,
+                            leg=mode)
+        rows[mode] = round(ms, 2)
+        print(json.dumps({'phase': mode, 'ms_per_iter': rows[mode]}),
+              flush=True)
+    for n in args.polish:
+        run, carry = build(model, x, y, inv_freq, n_iters, 'full',
+                           polish_iters=n)
+        ms = B.time_chained(run, carry, n_iters, floor_ms=floor_ms,
+                            leg=f'full_polish{n}')
+        rows[f'full_polish{n}'] = round(ms, 2)
+        print(json.dumps({'phase': f'full_polish{n}',
+                          'ms_per_iter': rows[f'full_polish{n}']}),
+              flush=True)
+    deltas = {
+        'capture_cost': round(rows['capture'] - rows['sgd'], 2),
+        'precond_clip_cost': round(rows['precond'] - rows['capture'], 2),
+        'factor_cost': round(rows['factors'] - rows['precond'], 2),
+        'inverse_amortized_cost': round(rows['full'] - rows['factors'], 2),
+    }
+    print(json.dumps({'summary': rows, 'deltas': deltas}), flush=True)
+
+
+if __name__ == '__main__':
+    main()
