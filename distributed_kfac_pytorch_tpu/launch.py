@@ -41,9 +41,16 @@ def initialize_multihost(coordinator_address: str | None = None,
         process_id = int(os.environ['JAX_PROCESS_ID'])
     explicit = (coordinator_address or num_processes
                 or process_id is not None)
-    multi_env = any(v in os.environ for v in (
-        'SLURM_JOB_ID', 'OMPI_COMM_WORLD_SIZE', 'TPU_WORKER_HOSTNAMES'))
-    if explicit or multi_env:
+    # Initialize only when explicitly configured OR the environment
+    # actually declares >1 process. Presence of a cluster-ish env var
+    # alone is NOT enough: single-host environments export lookalikes
+    # (observed live: the axon TPU runtime injects
+    # TPU_WORKER_HOSTNAMES=localhost into every interpreter via
+    # sitecustomize, and jax.distributed.initialize then dies with
+    # 'coordinator_address should be defined' — which broke every CLI
+    # on the dev chip while the 'skip when single' path was gated on
+    # the env var's absence).
+    if explicit or _detected_world_size() > 1:
         try:
             # Cross-process collectives on the CPU backend need an
             # implementation selected before the backend initializes;
@@ -59,18 +66,10 @@ def initialize_multihost(coordinator_address: str | None = None,
                 coordinator_address=coordinator_address,
                 num_processes=num_processes, process_id=process_id)
         except RuntimeError as e:
-            # Double-init is benign, as is auto-detection firing after the
-            # backend is already live *when the env says single-process*
-            # (notebooks/tests where the platform runtime exports
-            # TPU_WORKER_HOSTNAMES=localhost etc.). A job whose env
-            # declares >1 process must fail loudly, or every host would
-            # silently train alone on its own shard.
-            msg = str(e).lower()
-            benign = ('should only be called once' in msg
-                      or (_detected_world_size() <= 1
-                          and not explicit
-                          and 'must be called before' in msg))
-            if not benign:
+            # Double-init is benign. A job whose env declares >1 process
+            # must fail loudly, or every host would silently train alone
+            # on its own shard.
+            if 'should only be called once' not in str(e).lower():
                 raise
     return {'process_index': jax.process_index(),
             'process_count': jax.process_count(),

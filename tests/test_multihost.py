@@ -29,6 +29,44 @@ def _free_port():
         return s.getsockname()[1]
 
 
+def test_single_host_lookalike_env_is_noop(monkeypatch):
+    """Single-host cluster-lookalike env must not trigger (or crash on)
+    distributed init.
+
+    Regression: the axon TPU runtime injects
+    ``TPU_WORKER_HOSTNAMES=localhost`` into every interpreter via
+    sitecustomize; gating on the env var's *presence* sent every
+    single-process CLI into ``jax.distributed.initialize`` which dies
+    with 'coordinator_address should be defined' (caught live, round 3).
+    """
+    from distributed_kfac_pytorch_tpu import launch
+
+    _clear_cluster_env(monkeypatch)
+    monkeypatch.setenv('TPU_WORKER_HOSTNAMES', 'localhost')
+    assert launch._detected_world_size() == 1
+    info = launch.initialize_multihost()
+    assert info['process_count'] == 1
+    assert info['process_index'] == 0
+
+
+def _clear_cluster_env(monkeypatch):
+    """Isolate from ambient cluster env (CI inside SLURM, leaked
+    JAX_NUM_PROCESSES, ...) — _detected_world_size consults these
+    before TPU_WORKER_HOSTNAMES."""
+    for var in ('SLURM_NTASKS', 'SLURM_JOB_ID', 'OMPI_COMM_WORLD_SIZE',
+                'JAX_NUM_PROCESSES', 'JAX_PROCESS_ID',
+                'JAX_COORDINATOR_ADDRESS', 'TPU_WORKER_HOSTNAMES'):
+        monkeypatch.delenv(var, raising=False)
+
+
+def test_detected_world_size_multi_host_env(monkeypatch):
+    from distributed_kfac_pytorch_tpu import launch
+
+    _clear_cluster_env(monkeypatch)
+    monkeypatch.setenv('TPU_WORKER_HOSTNAMES', 'host-0,host-1,host-2')
+    assert launch._detected_world_size() == 3
+
+
 @pytest.mark.slow
 def test_two_process_run_matches_single_process(tmp_path):
     # Reference: same training, one process, the 8-device test mesh.
