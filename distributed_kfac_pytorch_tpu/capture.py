@@ -152,15 +152,45 @@ class KFACCapture:
     """
 
     def __init__(self, model: nn.Module,
-                 skip_layers: str | Sequence[str] | None = None):
+                 skip_layers: str | Sequence[str] | None = None,
+                 capture_dtype: Any = 'auto'):
         self.model = model
         if skip_layers is None:
             skip_layers = []
         elif isinstance(skip_layers, str):
             skip_layers = [skip_layers]
         self.skip_layers = frozenset(s.lower() for s in skip_layers)
+        # Dtype for captured activations ('a'). The captures feed ONLY
+        # the factor statistics, whose covariance matmuls round fp32
+        # inputs to bf16 on the TPU MXU anyway (ops.factors.get_cov
+        # precision contract) — so storing them bf16 loses nothing the
+        # matmul keeps, while halving the capture write and (for convs)
+        # the im2col patch materialization traffic that dominates the
+        # factor phase (PERF.md round 3). This is also production
+        # reference behavior: under --fp16/AMP its hooks capture the
+        # autocast half-precision activations (kfac/layers/base.py:385,
+        # README.md:150-160). 'auto' = bf16 on TPU for float inputs,
+        # passthrough elsewhere; None = always passthrough (strict-fp32
+        # parity); an explicit dtype forces the cast. Output-grad
+        # captures ('g') are never cast here — they are read once, so a
+        # cast would add traffic, not save it.
+        self.capture_dtype = capture_dtype
         self._specs: dict[str, LayerSpec] | None = None
         self._skipped: dict[str, str] = {}
+
+    def _cast_capture(self, x):
+        cd = self.capture_dtype
+        if cd is None:
+            return x
+        if cd == 'auto':
+            if (jax.default_backend() == 'tpu'
+                    and x.dtype == jnp.float32):
+                cd = jnp.bfloat16
+            else:
+                return x
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != cd:
+            return x.astype(cd)
+        return x
 
     # -- registration ------------------------------------------------------
 
@@ -201,7 +231,7 @@ class KFACCapture:
 
             idx = call_counts.get(path, 0)
             call_counts[path] = idx + 1
-            mod.sow(CAPTURE_COL, 'a', a_in,
+            mod.sow(CAPTURE_COL, 'a', self._cast_capture(a_in),
                     init_fn=tuple, reduce_fn=lambda p, x: p + (x,))
             y = next_fun(*args, **kwargs)
             y = mod.perturb(f'probe{idx}', y, collection=PROBE_COL)

@@ -359,9 +359,14 @@ def conv2d_a_factor(a: jax.Array, kernel_size, strides, padding,
 
     Patch-extraction dispatch (``KFAC_CONV_PATCH_IMPL``):
 
-      - ``auto``/``slices`` (default): pad + KH*KW strided slices +
-        concat in (kh, kw, c) order — the measured-fastest path on v5e
-        (24.3 ms/iter whole-step on the tracked config).
+      - ``auto`` (default): measured per-shape rule — ``dilated`` in
+        the large-spatial/small-d regime (output spatial >= 2048 and
+        d <= 640, e.g. ResNet-50 stem/conv2_x at ImageNet resolution),
+        ``slices`` everywhere else (every CIFAR class). Basis:
+        benchmarks/conv_a_microbench.py on v5e.
+      - ``slices``: pad + KH*KW strided slices + concat in (kh, kw, c)
+        order — the measured-fastest path on the tracked CIFAR config
+        (24.3 ms/iter whole-step).
       - ``crosscov``: band-trace Gram that never materializes the patch
         tensor — measured 3.3x whole-step regression, opt-in study path
         only (see _conv_a_cov_crosscov).
@@ -408,6 +413,22 @@ def conv2d_a_factor(a: jax.Array, kernel_size, strides, padding,
         raise ValueError(
             f'KFAC_CONV_PATCH_IMPL={impl!r}: expected one of '
             "'auto', 'slices', 'crosscov', 'dilated'")
+    if impl == 'auto':
+        # Measured per-shape dispatch (benchmarks/conv_a_microbench.py,
+        # v5e, overhead-corrected ms per A-factor):
+        #   slices wins every CIFAR class (1.08/0.61/0.44 vs dilated
+        #   1.07/0.74/0.69) and every large-d class (d>=1152: dilated
+        #   4-5x worse — the identity-kernel conv burns rows*d*d MXU
+        #   FLOPs); dilated wins the large-spatial small-d regime
+        #   (c64@56x56: 2.16 vs 3.25 — the 9-slice concat relayouts
+        #   degrade on big spatial extents while the conv tiles well).
+        oh, ow, _, spatial = _conv_out_geometry(a, kernel_size, strides,
+                                                padding)
+        # kh*kw == 1 stays on slices: a 1x1 "patch extraction" is a
+        # single strided slice with no concat relayout, and the dilated
+        # path's rows*d*d identity-conv FLOPs are pure waste there.
+        impl = ('dilated' if spatial >= 2048 and d <= 640
+                and kh * kw > 1 else 'slices')
     if impl == 'crosscov':
         # Opt-in ONLY: measured 3.3x whole-step regression as the
         # default on v5e (BENCH_r02.json) — see _conv_a_cov_crosscov's

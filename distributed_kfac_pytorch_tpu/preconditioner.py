@@ -135,6 +135,14 @@ class KFAC:
         analogue of the reference's fp16 factor mode (``--fp16``,
         launch_node_torch_imagenet.sh:73-87) with better accumulation.
         See ops.factors.get_cov for the measured numbers.
+      capture_dtype: dtype for captured activations ('a'). Default
+        'auto' = bf16 on TPU (what the covariance matmul keeps anyway;
+        halves capture + im2col patch traffic — see KFACCapture), fp32
+        passthrough elsewhere and under strict
+        ``factor_compute_dtype=float32`` parity. ``None`` = always
+        passthrough; explicit dtype forces the cast. Reference parity:
+        hooks capture the autocast dtype under AMP
+        (kfac/layers/base.py:385).
       inv_dtype: dtype for stored inverses (default fp32; decompositions
         always *computed* in fp32, reference base.py:432-441).
       skip_layers: module names/classes to skip (case-insensitive, prunes
@@ -165,6 +173,7 @@ class KFAC:
                  newton_iters: int = 100,
                  factor_dtype: Any = None,
                  factor_compute_dtype: Any = None,
+                 capture_dtype: Any = 'auto',
                  inv_dtype: Any = jnp.float32,
                  skip_layers: str | Sequence[str] | None = None,
                  symmetry_aware_comm: bool = False,
@@ -182,7 +191,14 @@ class KFAC:
         if assignment_strategy not in ('compute', 'memory'):
             raise ValueError("assignment_strategy must be 'compute' or "
                              "'memory'")
-        self.capture = KFACCapture(model, skip_layers=skip_layers)
+        if (capture_dtype == 'auto' and factor_compute_dtype is not None
+                and jnp.dtype(factor_compute_dtype) == jnp.float32):
+            # Strict-fp32 factor parity implies fp32 captures: a bf16
+            # capture would discard the precision the HIGHEST-precision
+            # covariance contraction exists to keep.
+            capture_dtype = None
+        self.capture = KFACCapture(model, skip_layers=skip_layers,
+                                   capture_dtype=capture_dtype)
         self.model = model
         self.damping = damping
         self.factor_decay = factor_decay

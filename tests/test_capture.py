@@ -303,3 +303,62 @@ class TestSkippedReporting:
         with pytest.warns(UserWarning):
             cap.init(jax.random.PRNGKey(0), jnp.zeros((2, 8, 8, 3)))
         assert cap.skipped_modules.get('Dense_0') == 'skip_layers match'
+
+
+class TestCaptureDtype:
+    """capture_dtype: 'a' captures cast at source (bf16 on TPU by
+    default — halves capture/patch traffic, PERF.md round 3); 'g'
+    captures never cast. CPU 'auto' is passthrough, so these pin the
+    explicit-dtype path and the KFAC strict-fp32 gate."""
+
+    def test_explicit_bf16_casts_a_not_g(self):
+        cap = KFACCapture(MLP(), capture_dtype=jnp.bfloat16)
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 6))
+        variables, _ = cap.init(jax.random.PRNGKey(1), x)
+        _, _, _, captures, _ = cap.loss_and_grads(
+            lambda out: (out ** 2).mean(), variables['params'], x)
+        for name in captures:
+            assert all(a.dtype == jnp.bfloat16
+                       for a in captures[name]['a']), name
+            assert all(g.dtype == jnp.float32
+                       for g in captures[name]['g']), name
+
+    def test_auto_is_passthrough_on_cpu(self):
+        cap = KFACCapture(MLP())  # 'auto'
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 6))
+        variables, _ = cap.init(jax.random.PRNGKey(1), x)
+        _, _, _, captures, _ = cap.loss_and_grads(
+            lambda out: (out ** 2).mean(), variables['params'], x)
+        for name in captures:
+            assert all(a.dtype == jnp.float32
+                       for a in captures[name]['a']), name
+
+    def test_bf16_factors_close_to_fp32(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (32, 6))
+        ref_cap = KFACCapture(MLP(), capture_dtype=None)
+        variables, _ = ref_cap.init(jax.random.PRNGKey(1), x)
+        params = variables['params']
+
+        def factors_for(cap):
+            _, _, _, captures, _ = cap.loss_and_grads(
+                lambda out: (out ** 2).mean(), params, x)
+            a = jnp.concatenate(
+                [c.astype(jnp.float32)
+                 for c in captures['d1']['a']])
+            from distributed_kfac_pytorch_tpu.ops import factors as F
+            return F.linear_a_factor(a, has_bias=True)
+
+        a_fp32 = factors_for(ref_cap)
+        bf16_cap = KFACCapture(MLP(), capture_dtype=jnp.bfloat16)
+        bf16_cap.init(jax.random.PRNGKey(1), x)
+        a_bf16 = factors_for(bf16_cap)
+        np.testing.assert_allclose(np.asarray(a_bf16),
+                                   np.asarray(a_fp32),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_strict_fp32_parity_disables_auto_cast(self):
+        from distributed_kfac_pytorch_tpu import KFAC
+        kfac = KFAC(MLP(), factor_compute_dtype=jnp.float32)
+        assert kfac.capture.capture_dtype is None
+        kfac2 = KFAC(MLP())
+        assert kfac2.capture.capture_dtype == 'auto'
