@@ -51,6 +51,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from distributed_kfac_pytorch_tpu import fp16 as fp16_ops
 from distributed_kfac_pytorch_tpu import layers as L
 from distributed_kfac_pytorch_tpu.capture import EMBEDDING
 from distributed_kfac_pytorch_tpu.ops import factors as F
@@ -772,9 +773,18 @@ class DistributedKFAC:
             numerics match the single-pass step up to fp associativity
             (G contributions carry the exact ``1/accum**2`` loss-scale
             correction).
-          loss_scale: optional fp16 loss-scaling factor, forwarded to
-            ``KFACCapture.loss_and_grads`` (grads and output-grad
-            captures are unscaled before any factor statistics).
+          loss_scale: fp16 loss scaling. A float is a FIXED scale
+            forwarded to ``KFACCapture.loss_and_grads`` (grads and
+            output-grad captures are unscaled before any factor
+            statistics). The string ``'dynamic'`` enables the full
+            GradScaler-parity schedule (reference engine.py:38-41,
+            75-80): the live scale is read from
+            ``extra_vars['loss_scale']`` (seed with
+            ``fp16.init_loss_scale()``), non-finite captures are zeroed
+            before factor statistics, the parameter/optimizer update is
+            skipped collectively on any non-finite gradient, and the
+            scale state backs off / grows per ``fp16.update_loss_scale``.
+            Metrics gain ``loss_scale`` and ``overflow``.
 
         Returns a function
         ``step(params, opt_state, kfac_state, extra_vars, batch, hyper)
@@ -791,7 +801,10 @@ class DistributedKFAC:
         capture = self.kfac.capture
         mutable_cols = tuple(mutable_cols)
 
-        def fwd_bwd(params, extra_vars, batch):
+        dynamic_ls = loss_scale == 'dynamic'
+        static_ls = None if dynamic_ls else loss_scale
+
+        def fwd_bwd(params, extra_vars, batch, scale=None):
             """One micro/full-batch pass -> (loss, metrics, grads,
             contribs, updated_vars)."""
             def wrapped_loss(out):
@@ -803,10 +816,25 @@ class DistributedKFAC:
                 capture.loss_and_grads(
                     wrapped_loss, params, *model_args_fn(batch),
                     extra_vars=extra_vars, mutable_cols=mutable_cols,
-                    has_aux=True, loss_scale=loss_scale, **kwargs))
+                    has_aux=True,
+                    loss_scale=static_ls if scale is None else scale,
+                    **kwargs))
+            if dynamic_ls and captures:
+                # Reference hook behavior under GradScaler: non-finite
+                # grad-output tensors are dropped before factor
+                # statistics (kfac/layers/base.py:397-407); the SPMD
+                # form zeroes them (fp16.sanitize_captures). Steps whose
+                # *gradients* overflow are skipped wholesale in
+                # local_step — this sanitize covers the residual case of
+                # a non-finite per-call capture inside an otherwise
+                # finite step (e.g. one timestep of a multi-call layer),
+                # keeping the factor math NaN-free without poisoning the
+                # EWMA.
+                captures, _ = fp16_ops.sanitize_captures(captures)
             return loss, extra_metrics, grads, captures, updated
 
-        def accum_fwd_bwd(params, extra_vars, batch, do_factors):
+        def accum_fwd_bwd(params, extra_vars, batch, do_factors,
+                          scale=None):
             """Scan over micro-batches, averaging grads/contribs/metrics.
 
             Captures are reduced to factor contributions inside the scan
@@ -835,7 +863,7 @@ class DistributedKFAC:
             micro = jax.tree.map(split, batch, specs)
             first = jax.tree.map(lambda x: x[0], micro)
             loss_sh, extras_sh, grads_sh, captures_sh, _ = jax.eval_shape(
-                fwd_bwd, params, extra_vars, first)
+                fwd_bwd, params, extra_vars, first, scale)
             contribs_sh = jax.eval_shape(self.local_factor_contribs,
                                          captures_sh)
             zeros = lambda sh: jax.tree.map(
@@ -847,7 +875,7 @@ class DistributedKFAC:
             def body(carry, mb):
                 extra_c, sums = carry
                 loss, extra_metrics, grads, captures, updated = fwd_bwd(
-                    params, extra_c, mb)
+                    params, extra_c, mb, scale)
                 if isinstance(do_factors, bool):
                     # Static cadence: the contraction is simply present or
                     # absent from this program variant.
@@ -884,9 +912,19 @@ class DistributedKFAC:
         def make_local_step(factor_update, inv_update):
             def local_step(params, opt_state, kstate, extra_vars, batch,
                            hyper):
+                if dynamic_ls:
+                    if 'loss_scale' not in extra_vars:
+                        raise ValueError(
+                            "loss_scale='dynamic' requires a loss-scale "
+                            "state in extra_vars['loss_scale'] — seed it "
+                            'with fp16.init_loss_scale()')
+                    ls_state = extra_vars['loss_scale']
+                    scale = ls_state['scale']
+                else:
+                    scale = None
                 if grad_accum_steps == 1:
                     loss, extra_metrics, grads, captures, updated = fwd_bwd(
-                        params, extra_vars, batch)
+                        params, extra_vars, batch, scale)
                     contribs = None
                 else:
                     if factor_update is not None:
@@ -897,26 +935,62 @@ class DistributedKFAC:
                             f_freq = self.kfac.factor_update_freq
                         do_factors = kstate['step'] % f_freq == 0
                     loss, extra_metrics, grads, contribs, updated = (
-                        accum_fwd_bwd(params, extra_vars, batch, do_factors))
+                        accum_fwd_bwd(params, extra_vars, batch, do_factors,
+                                      scale))
                     captures = None
                 grads = jax.lax.pmean(grads, self.data_axes)
                 loss = jax.lax.pmean(loss, self.data_axes)
                 metrics = {'loss': loss,
                            **jax.lax.pmean(extra_metrics, self.data_axes)}
-                precond, kstate = self.spmd_step(
+                precond, new_kstate = self.spmd_step(
                     kstate, grads, captures, contribs=contribs,
                     damping=hyper['damping'], lr=hyper['lr'],
                     factor_decay=hyper.get('factor_decay'),
                     factor_update_freq=hyper.get('factor_update_freq'),
                     inv_update_freq=hyper.get('inv_update_freq'),
                     factor_update=factor_update, inv_update=inv_update)
-                updates, opt_state = tx.update(precond, opt_state, params)
-                params = jax.tree.map(lambda p, u: (p + u).astype(p.dtype),
-                                      params, updates)
+                updates, new_opt_state = tx.update(precond, opt_state,
+                                                   params)
+                new_params = jax.tree.map(
+                    lambda p, u: (p + u).astype(p.dtype), params, updates)
+                if dynamic_ls:
+                    # GradScaler semantics (reference engine.py:75-80):
+                    # on non-finite gradients skip the entire state
+                    # advance — params, optimizer, K-FAC factor/inverse
+                    # content (a zeroed-capture EWMA update would shrink
+                    # factors toward zero at full weight), and the
+                    # mutable collections (BN running stats computed
+                    # from a non-finite forward would be poisoned
+                    # forever: momentum*NaN stays NaN). Only the K-FAC
+                    # step counter and the loss-scale state advance, so
+                    # the static-cadence phase stays aligned with the
+                    # host counter. The pmean above propagates any
+                    # device's non-finite values to all devices, so the
+                    # skip is collective.
+                    finite = fp16_ops.tree_all_finite(grads)
+                    new_params, new_opt_state = fp16_ops.apply_if_finite(
+                        finite, (new_params, new_opt_state),
+                        (params, opt_state))
+                    new_kstate = {
+                        **fp16_ops.apply_if_finite(finite, new_kstate,
+                                                   kstate),
+                        'step': new_kstate['step']}
+                    if updated:
+                        updated = fp16_ops.apply_if_finite(
+                            finite, updated,
+                            {c: extra_vars[c] for c in updated})
+                    extra_vars = {
+                        **extra_vars,
+                        'loss_scale': fp16_ops.update_loss_scale(
+                            ls_state, finite)}
+                    metrics = {**metrics, 'loss_scale': scale,
+                               'overflow': 1.0
+                               - finite.astype(jnp.float32)}
                 if updated:
                     extra_vars = {**extra_vars,
                                   **jax.lax.pmean(updated, self.data_axes)}
-                return params, opt_state, kstate, extra_vars, metrics
+                return (new_params, new_opt_state, new_kstate, extra_vars,
+                        metrics)
             return local_step
 
         def make_step_impl(factor_update, inv_update):

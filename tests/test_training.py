@@ -332,3 +332,93 @@ class TestAsyncCheckpoint:
         np.testing.assert_array_equal(restored2['params']['w'],
                                       np.arange(8.0) * 2)
         mgr.close()
+
+
+class TestDynamicLossScale:
+    """loss_scale='dynamic' GradScaler parity through the distributed
+    step (reference engine.py:38-41,75-80): overflow steps are skipped
+    collectively, the scale backs off, factor statistics still advance
+    (sanitized captures), and finite steps train normally."""
+
+    def _build(self):
+        from distributed_kfac_pytorch_tpu import fp16
+
+        model = cifar_resnet.get_model('resnet20')
+        kfac = KFAC(model, factor_update_freq=1, inv_update_freq=1,
+                    damping=0.01, lr=0.05)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 16, 16, 3))
+        y = jax.random.randint(jax.random.PRNGKey(2), (8,), 0, 10)
+        variables, _ = kfac.init(jax.random.PRNGKey(0), x)
+        params = variables['params']
+        extra = {'batch_stats': variables['batch_stats'],
+                 'loss_scale': fp16.init_loss_scale(2.0 ** 10)}
+        mesh = D.make_kfac_mesh(jax.devices()[:4])
+        dkfac = D.DistributedKFAC(kfac, mesh, params)
+        kstate = dkfac.init_state(params)
+        tx = optax.sgd(0.05)
+        opt_state = tx.init(params)
+
+        def loss(out, batch):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                out, batch[1]).mean()
+
+        step = dkfac.build_train_step(loss, tx,
+                                      mutable_cols=('batch_stats',),
+                                      donate=False,
+                                      loss_scale='dynamic')
+        hyper = {'lr': 0.05, 'damping': 0.01,
+                 'factor_update_freq': 1, 'inv_update_freq': 1}
+        return step, params, opt_state, kstate, extra, (x, y), hyper
+
+    def test_finite_step_trains_and_tracks_scale(self):
+        step, params, opt_state, kstate, extra, batch, hyper = (
+            self._build())
+        p2, o2, k2, e2, m = step(params, opt_state, kstate, extra,
+                                 batch, hyper,
+                                 factor_update=True, inv_update=True)
+        assert float(m['overflow']) == 0.0
+        assert float(m['loss_scale']) == 2.0 ** 10
+        # Params moved; scale unchanged (growth_interval not reached).
+        moved = jax.tree.leaves(jax.tree.map(
+            lambda a, b: float(jnp.abs(a - b).max()), params, p2))
+        assert max(moved) > 0
+        assert float(e2['loss_scale']['scale']) == 2.0 ** 10
+        assert int(e2['loss_scale']['growth_count']) == 1
+
+    def test_overflow_skips_update_and_backs_off(self):
+        step, params, opt_state, kstate, extra, (x, y), hyper = (
+            self._build())
+        bad_x = x.at[0, 0, 0, 0].set(jnp.nan)
+        p2, o2, k2, e2, m = step(params, opt_state, kstate, extra,
+                                 (bad_x, y), hyper,
+                                 factor_update=True, inv_update=True)
+        assert float(m['overflow']) == 1.0
+        # Collective skip: params and optimizer state are bit-identical.
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), params, p2)
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), opt_state, o2)
+        # Scale halved, growth counter reset, K-FAC step still advanced
+        # (static-cadence phase stays aligned with the host counter).
+        assert float(e2['loss_scale']['scale']) == 2.0 ** 9
+        assert int(e2['loss_scale']['growth_count']) == 0
+        assert int(k2['step']) == int(kstate['step']) + 1
+        # Factor/inverse CONTENT did not advance (a zeroed-capture EWMA
+        # would shrink factors at full weight), and BN running stats
+        # were not poisoned by the non-finite forward pass.
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+            kstate['factors'], k2['factors'])
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+            extra['batch_stats'], e2['batch_stats'])
+        for leaf in jax.tree.leaves(e2['batch_stats']):
+            assert bool(jnp.isfinite(leaf).all())
+
+    def test_missing_state_raises(self):
+        step, params, opt_state, kstate, extra, batch, hyper = (
+            self._build())
+        extra.pop('loss_scale')
+        with pytest.raises(ValueError, match='init_loss_scale'):
+            step(params, opt_state, kstate, extra, batch, hyper,
+                 factor_update=True, inv_update=True)
