@@ -153,13 +153,23 @@ class KFACCapture:
 
     def __init__(self, model: nn.Module,
                  skip_layers: str | Sequence[str] | None = None,
-                 capture_dtype: Any = 'auto'):
+                 capture_dtype: Any = 'auto',
+                 trainable: Callable[[str], bool] | None = None):
         self.model = model
         if skip_layers is None:
             skip_layers = []
         elif isinstance(skip_layers, str):
             skip_layers = [skip_layers]
         self.skip_layers = frozenset(s.lower() for s in skip_layers)
+        # Frozen-parameter support (reference module_requires_grad,
+        # kfac/layers/__init__.py:38-40: modules whose params don't
+        # require grad are never registered). JAX has no requires_grad;
+        # fine-tuning freezes params via the optimizer (optax.masked /
+        # zero updates), so the caller states the same intent here:
+        # ``trainable('/'.join(module_path)) -> bool``. Frozen layers
+        # get no capture, no factor statistics, and no preconditioning
+        # — their (unused) gradients pass through untouched.
+        self.trainable = trainable
         # Dtype for captured activations ('a'). The captures feed ONLY
         # the factor statistics, whose covariance matmuls round fp32
         # inputs to bf16 on the TPU MXU anyway (ops.factors.get_cov
@@ -213,6 +223,13 @@ class KFACCapture:
             if self._is_skipped(mod, path):
                 if record_specs and path:
                     self._skipped['/'.join(path)] = 'skip_layers match'
+                return next_fun(*args, **kwargs)
+            if self.trainable is not None and \
+                    not self.trainable('/'.join(path)):
+                if record_specs and path:
+                    self._skipped['/'.join(path)] = (
+                        'frozen (trainable predicate): plain gradients, '
+                        'no factor work')
                 return next_fun(*args, **kwargs)
             if _spec_for_module(mod, path, 1) is None:
                 if record_specs and isinstance(mod, nn.Conv):

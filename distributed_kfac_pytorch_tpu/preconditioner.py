@@ -147,6 +147,11 @@ class KFAC:
         always *computed* in fp32, reference base.py:432-441).
       skip_layers: module names/classes to skip (case-insensitive, prunes
         subtrees).
+      trainable: optional predicate ``trainable(module_path) -> bool``
+        marking which layers actually train — frozen layers (e.g. an
+        optax.masked fine-tune) get plain gradients and NO factor/
+        inverse work (reference module_requires_grad,
+        kfac/layers/__init__.py:38-40).
       symmetry_aware_comm: communicate only ~half of each (symmetric)
         factor matrix — a gather-free rectangular triangular packing
         (ops.factors.pack_symmetric) before the allreduce (reference
@@ -176,6 +181,7 @@ class KFAC:
                  capture_dtype: Any = 'auto',
                  inv_dtype: Any = jnp.float32,
                  skip_layers: str | Sequence[str] | None = None,
+                 trainable: Any = None,
                  symmetry_aware_comm: bool = False,
                  assignment_strategy: str = 'compute',
                  comm_method: CommMethod = CommMethod.COMM_OPT,
@@ -198,7 +204,8 @@ class KFAC:
             # covariance contraction exists to keep.
             capture_dtype = None
         self.capture = KFACCapture(model, skip_layers=skip_layers,
-                                   capture_dtype=capture_dtype)
+                                   capture_dtype=capture_dtype,
+                                   trainable=trainable)
         self.model = model
         self.damping = damping
         self.factor_decay = factor_decay

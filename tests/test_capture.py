@@ -362,3 +362,37 @@ class TestCaptureDtype:
         assert kfac.capture.capture_dtype is None
         kfac2 = KFAC(MLP())
         assert kfac2.capture.capture_dtype == 'auto'
+
+
+class TestTrainablePredicate:
+    """Frozen-layer support (reference module_requires_grad,
+    kfac/layers/__init__.py:38-40): layers failing the trainable
+    predicate are not registered — no capture, no factor work, plain
+    gradients — and are reported in skipped_modules."""
+
+    def test_frozen_layer_not_registered(self):
+        cap = KFACCapture(MLP(), trainable=lambda p: p != 'd1')
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 6))
+        variables, specs = cap.init(jax.random.PRNGKey(1), x)
+        assert 'd1' not in specs and 'd2' in specs
+        assert 'frozen' in cap.skipped_modules['d1']
+        _, _, grads, captures, _ = cap.loss_and_grads(
+            lambda out: (out ** 2).mean(), variables['params'], x)
+        assert 'd1' not in captures and 'd2' in captures
+        # Frozen layer still gets its plain gradient.
+        assert 'd1' in grads
+
+    def test_kfac_end_to_end_skips_frozen(self):
+        from distributed_kfac_pytorch_tpu import KFAC
+        kfac = KFAC(MLP(), factor_update_freq=1, inv_update_freq=1,
+                    trainable=lambda p: p != 'd1')
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 6))
+        variables, state = kfac.init(jax.random.PRNGKey(1), x)
+        assert set(state['factors']) == {'d2'}
+        _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+            lambda out: (out ** 2).mean(), variables['params'], x)
+        precond, state = kfac.step(state, grads, captures,
+                                   factor_update=True, inv_update=True)
+        # Frozen layer's gradient passes through (scaled only by lr/clip
+        # like every unregistered param's).
+        assert 'd1' in precond
