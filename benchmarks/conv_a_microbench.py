@@ -9,6 +9,10 @@ round-2 crosscov regression bought us.
 Each timed leg scans ``inner`` A-factor computations over a chained
 f32 carry (the input is nudged each iteration so no two contractions
 see identical data), then applies bench.py's batch-window timing.
+Every reading has a measured same-structure null-program baseline
+(per-call dispatch + chain body) subtracted, so the reported ms are the
+A-factor op alone and reproduce across ``--inner`` choices; the
+baseline itself is reported per shape as ``overhead_baseline``.
 
     python benchmarks/conv_a_microbench.py [--inner 20]
 """
@@ -41,17 +45,29 @@ SHAPES = [
     ('imagenet_c256_14x14', 64, 14, 14, 256, (3, 3), (1, 1)),
     ('imagenet_c512_7x7', 64, 7, 7, 512, (3, 3), (1, 1)),
     ('imagenet_stem_c3_224x224_k7s2', 64, 224, 224, 3, (7, 7), (2, 2)),
+    ('imagenet_c128_s2_56to28', 64, 56, 56, 128, (3, 3), (2, 2)),
 ]
 
 IMPLS = ['slices', 'crosscov', 'dilated']
 
 
-def build_runner(x0, impl, inner, kernel, strides):
-    os.environ['KFAC_CONV_PATCH_IMPL'] = impl
+def build_runner(x0, impl, inner, kernel, strides, null=False):
+    """``null=True`` builds the overhead-baseline program: identical
+    scan/carry/chain structure with the A-factor computation replaced by
+    a trivial stand-in — what it measures is the per-call dispatch
+    (≈45 ms on the tunnel) plus the chain-body cost, which is
+    subtracted from every impl reading so the reported numbers are the
+    A-factor op alone and reproduce across --inner choices."""
+    if impl is not None:
+        os.environ['KFAC_CONV_PATCH_IMPL'] = impl
+    d = kernel[0] * kernel[1] * x0.shape[-1] + 1
 
     def body(carry, _):
         x, acc = carry
-        a = F.conv2d_a_factor(x, kernel, strides, 'SAME', True)
+        if null:
+            a = jnp.full((d, d), jnp.float32(1e-9)) * x[0, 0, 0, 0]
+        else:
+            a = F.conv2d_a_factor(x, kernel, strides, 'SAME', True)
         # Chain: nudge the input by a value-dependent epsilon so the
         # next iteration's contraction is a genuinely new problem.
         x = x * (1.0 + 1e-6 * a[0, 0])
@@ -62,7 +78,6 @@ def build_runner(x0, impl, inner, kernel, strides):
         carry, out = jax.lax.scan(body, carry, None, length=inner)
         return carry, out[-1]
 
-    d = kernel[0] * kernel[1] * x0.shape[-1] + 1
     return run, (x0, jnp.zeros((d, d), jnp.float32))
 
 
@@ -75,6 +90,10 @@ def main(argv=None):
         x0 = jax.random.normal(jax.random.PRNGKey(0), (b, h, w, c),
                                jnp.float32)
         row = {'shape': label}
+        run, carry = build_runner(x0, None, args.inner, kernel, strides,
+                                  null=True)
+        base = B.time_chained(run, carry, args.inner)
+        row['overhead_baseline'] = round(base, 3)
         for impl in IMPLS:
             key = impl
             if impl == 'crosscov':
@@ -92,7 +111,7 @@ def main(argv=None):
                                       strides)
             try:
                 ms = B.time_chained(run, carry, args.inner)
-                row[key] = round(ms, 3)
+                row[key] = round(max(ms - base, 0.0), 3)
             except Exception as e:  # e.g. compile failure on one impl
                 row[key] = f'error: {type(e).__name__}'
         os.environ.pop('KFAC_CONV_PATCH_IMPL', None)

@@ -414,14 +414,14 @@ def conv2d_a_factor(a: jax.Array, kernel_size, strides, padding,
             f'KFAC_CONV_PATCH_IMPL={impl!r}: expected one of '
             "'auto', 'slices', 'crosscov', 'dilated'")
     if impl == 'auto':
-        # Measured per-shape dispatch (benchmarks/conv_a_microbench.py,
-        # v5e, overhead-corrected ms per A-factor):
-        #   slices wins every CIFAR class (1.08/0.61/0.44 vs dilated
-        #   1.07/0.74/0.69) and every large-d class (d>=1152: dilated
-        #   4-5x worse — the identity-kernel conv burns rows*d*d MXU
-        #   FLOPs); dilated wins the large-spatial small-d regime
-        #   (c64@56x56: 2.16 vs 3.25 — the 9-slice concat relayouts
-        #   degrade on big spatial extents while the conv tiles well).
+        # Measured per-shape dispatch (benchmarks/conv_a_microbench.py
+        # on v5e — re-run it for current numbers; the PERF.md round-3
+        # table records the deciding measurements): slices wins every
+        # CIFAR class and every large-d class (d>=1152: dilated 3-5x
+        # worse — the identity-kernel conv burns rows*d*d MXU FLOPs);
+        # dilated wins the large-spatial small-d regime (c64@56x56
+        # ~1.4x, and the 7x7/s2 ImageNet stem ~50x, where the 49-slice
+        # concat relayouts are catastrophic while the conv tiles well).
         oh, ow, _, spatial = _conv_out_geometry(a, kernel_size, strides,
                                                 padding)
         # kh*kw == 1 stays on slices: a 1x1 "patch extraction" is a

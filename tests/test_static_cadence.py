@@ -118,6 +118,7 @@ def _run_distributed(static_cadence, n_steps: int = 5,
     return state
 
 
+@pytest.mark.slow
 def test_distributed_static_matches_dynamic_via_train_epoch():
     # 'auto' resolves to static (KFAC step + freqs present in hyper);
     # None forces the legacy dynamic lax.cond path.
@@ -131,6 +132,7 @@ def test_distributed_static_matches_dynamic_via_train_epoch():
                   st_sta.kfac_state['factors'])
 
 
+@pytest.mark.slow
 def test_grad_accum_static_matches_dynamic():
     """The micro-batch scan's statically-gated factor contraction (the
     isinstance(do_factors, bool) branch) matches the traced-cond form."""
