@@ -65,12 +65,45 @@ def bench_group_size(devices, grad_workers: int, size: int, iters: int):
             timed(x)
 
 
+def run_multihost(out_path: str) -> None:
+    """Spawn the 2-process gloo benchmark (tests/multihost_worker.py
+    'comm' mode) and record COMM_MULTIHOST.json — grouped-collective
+    timings with the KAISA grad-worker axis laid out within vs across
+    the process boundary (the ICI-vs-DCN placement evidence for the
+    MEM/HYBRID tradeoff; VERDICT r2 #10)."""
+    import json
+    import socket
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, 'tests', 'multihost_worker.py')
+    with socket.socket() as s:
+        s.bind(('localhost', 0))
+        port = s.getsockname()[1]
+    env = {**os.environ, 'PYTHONPATH': repo}
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(port), str(pid), '2', out_path,
+         'comm'], cwd=repo, env=env) for pid in range(2)]
+    for proc in procs:
+        assert proc.wait(timeout=600) == 0, 'worker failed'
+    with open(out_path) as f:
+        print(json.dumps(json.load(f)))
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument('--size', type=int, default=100,
                    help='square tensor edge (reference: 100x100)')
     p.add_argument('--iters', type=int, default=20)
+    p.add_argument('--multihost', action='store_true',
+                   help='spawn the 2-process gloo cross-boundary '
+                        'benchmark instead (writes --out)')
+    p.add_argument('--out', default='COMM_MULTIHOST.json')
     args = p.parse_args(argv)
+
+    if args.multihost:
+        run_multihost(args.out)
+        return
 
     devices = jax.devices()
     print(f'{len(devices)} devices ({jax.default_backend()}); '

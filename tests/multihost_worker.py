@@ -98,8 +98,70 @@ def run_training(n_steps=3):
     return params_host, losses
 
 
+def run_comm_bench(iters: int = 10, size: int = 256) -> dict:
+    """Grouped-collective timings with the KAISA group axes laid out
+    WITHIN vs ACROSS the process boundary (VERDICT r2 #10).
+
+    The MEM/HYBRID tradeoff question is whether inverse/grad broadcast
+    groups should be confined to the fast intra-host fabric (ICI on a
+    pod; shared memory here) or may span the slow inter-host one (DCN;
+    gloo-over-TCP here). The two mesh orientations below put the
+    grad-worker axis on each side of the 2-process boundary and time
+    the collectives the K-FAC pipeline actually issues. Absolute
+    numbers are CPU/gloo, not TPU/DCN — the *ratio* between
+    orientations is the recorded evidence (same caveat class as
+    bench_matrix config 3).
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_kfac_pytorch_tpu.parallel.distributed import (
+        GRAD_WORKER_AXIS,
+        INV_GROUP_AXIS,
+        KFAC_AXES,
+    )
+
+    devs = jax.devices()
+    n = len(devs)
+    half = n // 2
+    layouts = {
+        # rows = inverse groups, cols = grad workers (Mesh axes order
+        # KFAC_AXES = (ig, gw)).
+        'gw_intra_process': np.asarray(devs).reshape(2, half),
+        'gw_cross_process': np.stack([np.asarray(devs[:half]),
+                                      np.asarray(devs[half:])], axis=1),
+    }
+    out = {}
+    x = jnp.ones((size, size), jnp.float32)
+    for name, arr in layouts.items():
+        mesh = Mesh(arr, KFAC_AXES)
+        ops = {
+            'allreduce_world': lambda v: jax.lax.psum(v, KFAC_AXES) / n,
+            'gather_gw_axis': lambda v: jax.lax.all_gather(
+                v, GRAD_WORKER_AXIS, tiled=True),
+            'psum_ig_axis': lambda v: jax.lax.psum(v, INV_GROUP_AXIS),
+        }
+        out[name] = {}
+        for op_name, op in ops.items():
+            fn = jax.jit(jax.shard_map(op, mesh=mesh, in_specs=P(),
+                                       out_specs=P(), check_vma=False))
+            jax.block_until_ready(fn(x))  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                jax.block_until_ready(fn(x))
+            out[name][op_name] = round(
+                (time.perf_counter() - t0) / iters * 1000.0, 3)
+    return out
+
+
 def main():
     port, pid, nproc, out_path = sys.argv[1:5]
+    mode = sys.argv[5] if len(sys.argv) > 5 else 'train'
     _configure()
     from distributed_kfac_pytorch_tpu import launch
     info = launch.initialize_multihost(
@@ -107,6 +169,18 @@ def main():
         num_processes=int(nproc), process_id=int(pid))
     assert info['process_count'] == int(nproc), info
     assert info['global_devices'] == 4 * int(nproc), info
+    if mode == 'comm':
+        result = run_comm_bench()
+        if info['process_index'] == 0:
+            import json
+            with open(out_path, 'w') as f:
+                json.dump({'processes': int(nproc),
+                           'devices_per_process': 4,
+                           'transport': 'gloo (DCN stand-in) + '
+                                        'shared-memory (ICI stand-in)',
+                           'unit': 'ms/op', **result}, f, indent=1)
+        print(f'worker {pid} done', flush=True)
+        return
     params, losses = run_training()
     if info['process_index'] == 0:
         import numpy as np

@@ -224,6 +224,7 @@ def test_cholesky_inverse_path_parity():
         dparams, ref_params)
 
 
+@pytest.mark.slow
 def test_resnet20_with_batchnorm_trains():
     """Full CIFAR ResNet-20 (BatchNorm batch_stats) through the builder."""
     model = cifar_resnet.get_model('resnet20')
@@ -253,6 +254,7 @@ def test_resnet20_with_batchnorm_trains():
     assert set(extra) == {'batch_stats'}
 
 
+@pytest.mark.slow
 def test_grad_accumulation_matches_single_pass():
     """grad_accum_steps=2 == one full-batch pass (reference engine.py:33-65).
 
@@ -305,6 +307,7 @@ def test_grad_accumulation_matches_single_pass():
             s2['factors'], s1['factors'])
 
 
+@pytest.mark.slow
 def test_grad_accumulation_threads_batch_stats():
     """Mutable collections update sequentially across micro-batches."""
     model = cifar_resnet.get_model('resnet20')

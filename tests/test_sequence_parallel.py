@@ -106,6 +106,7 @@ def test_transformer_lm_kfac_step_runs_and_descends():
 
 
 @pytest.mark.parametrize('comm_method', ['COMM_OPT', 'MEM_OPT'])
+@pytest.mark.slow
 def test_distributed_kfac_train_step_with_seq_parallel(comm_method):
     """Full K-FAC train step on an (ig, gw, sp) mesh: batch sharded over
     the K-FAC axes, sequence sharded 4-way, ring attention inside."""

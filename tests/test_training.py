@@ -214,6 +214,7 @@ def _small_setup(n_epoch_batches=2, batch=32):
 
 
 class TestEngine:
+    @pytest.mark.slow
     def test_train_epoch_and_eval(self):
         (model, dkfac, tx, step_fn, state, data, mesh,
          loss_fn) = _small_setup()
@@ -257,6 +258,7 @@ class TestEngine:
 
 
 class TestCheckpoint:
+    @pytest.mark.slow
     def test_roundtrip_and_auto_resume(self, tmp_path):
         (model, dkfac, tx, step_fn, state, data, mesh,
          loss_fn) = _small_setup()
@@ -284,6 +286,7 @@ class TestCheckpoint:
             kstate2['factors'], state.kfac_state['factors'])
         mgr.close()
 
+    @pytest.mark.slow
     def test_factor_only_checkpoint_recomputes_inverses(self, tmp_path):
         (model, dkfac, tx, step_fn, state, data, mesh,
          loss_fn) = _small_setup()
@@ -370,6 +373,7 @@ class TestDynamicLossScale:
                  'factor_update_freq': 1, 'inv_update_freq': 1}
         return step, params, opt_state, kstate, extra, (x, y), hyper
 
+    @pytest.mark.slow
     def test_finite_step_trains_and_tracks_scale(self):
         step, params, opt_state, kstate, extra, batch, hyper = (
             self._build())
@@ -385,6 +389,7 @@ class TestDynamicLossScale:
         assert float(e2['loss_scale']['scale']) == 2.0 ** 10
         assert int(e2['loss_scale']['growth_count']) == 1
 
+    @pytest.mark.slow
     def test_overflow_skips_update_and_backs_off(self):
         step, params, opt_state, kstate, extra, (x, y), hyper = (
             self._build())
