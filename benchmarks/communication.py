@@ -77,6 +77,7 @@ def run_multihost(out_path: str) -> None:
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     worker = os.path.join(repo, 'tests', 'multihost_worker.py')
+    out_path = os.path.abspath(out_path)
     with socket.socket() as s:
         s.bind(('localhost', 0))
         port = s.getsockname()[1]
@@ -84,8 +85,14 @@ def run_multihost(out_path: str) -> None:
     procs = [subprocess.Popen(
         [sys.executable, worker, str(port), str(pid), '2', out_path,
          'comm'], cwd=repo, env=env) for pid in range(2)]
-    for proc in procs:
-        assert proc.wait(timeout=600) == 0, 'worker failed'
+    try:
+        rcs = [proc.wait(timeout=600) for proc in procs]
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()  # don't leave the sibling in the rendezvous
+    if any(rcs):
+        raise RuntimeError(f'worker exit codes {rcs}')
     with open(out_path) as f:
         print(json.dumps(json.load(f)))
 

@@ -155,16 +155,21 @@ def run_sweep(args, data):
     anecdote.
     """
     sweep: dict[str, dict] = {'kfac': {}, 'sgd': {}}
+    damp_grid = args.kfac_damping_grid or [args.damping]
     for use_kfac in (True, False):
         name = 'kfac' if use_kfac else 'sgd'
         for lr in args.lr_grid:
-            a = argparse.Namespace(**vars(args))
-            a.base_lr = lr
-            print(f'=== {name} lr={lr} ===', flush=True)
-            curve, wall = run_one(use_kfac, a, data)
-            sweep[name][lr] = {'curve': curve, 'wall_s': round(wall, 1),
-                               'best_val_acc': max(r['val_acc']
-                                                   for r in curve)}
+            for damping in (damp_grid if use_kfac else [args.damping]):
+                a = argparse.Namespace(**vars(args))
+                a.base_lr = lr
+                a.damping = damping
+                key = (f'lr={lr},damping={damping}' if use_kfac
+                       else f'lr={lr}')
+                print(f'=== {name} {key} ===', flush=True)
+                curve, wall = run_one(use_kfac, a, data)
+                sweep[name][key] = {
+                    'curve': curve, 'wall_s': round(wall, 1),
+                    'best_val_acc': max(r['val_acc'] for r in curve)}
 
     # Common target: the weaker optimizer's best achievable accuracy
     # (x0.995 tolerance) — both optimizers can reach it, so
@@ -175,18 +180,18 @@ def run_sweep(args, data):
     chosen = {}
     for name, runs in sweep.items():
         scored = []
-        for lr, entry in runs.items():
+        for key, entry in runs.items():
             ett = epochs_to_target(entry['curve'], target)
             entry['epochs_to_target'] = ett
             scored.append((ett if ett is not None else 10 ** 9,
-                           -entry['best_val_acc'], lr))
+                           -entry['best_val_acc'], key))
         scored.sort()
-        best_lr = scored[0][2]
-        chosen[name] = {'lr': best_lr,
+        best = scored[0][2]
+        chosen[name] = {'config': best,
                         'epochs_to_target':
-                            runs[best_lr]['epochs_to_target'],
-                        'best_val_acc': runs[best_lr]['best_val_acc'],
-                        'wall_s': runs[best_lr]['wall_s']}
+                            runs[best]['epochs_to_target'],
+                        'best_val_acc': runs[best]['best_val_acc'],
+                        'wall_s': runs[best]['wall_s']}
 
     result = {
         'study': 'both_tuned_lr_sweep',
@@ -195,15 +200,16 @@ def run_sweep(args, data):
         'backend': jax.default_backend(),
         'devices': jax.device_count(),
         'epochs': args.epochs, 'batch_size': args.batch_size,
-        'label_noise': args.label_noise, 'damping': args.damping,
+        'label_noise': args.label_noise,
         'lr_grid': args.lr_grid,
+        'kfac_damping_grid': damp_grid,
+        'sgd_damping_na': 'damping applies to K-FAC only',
         'target_val_acc': round(target, 4),
         'chosen': chosen,
-        'sweep': {n: {str(lr): {k: v for k, v in e.items()
-                                if k != 'curve'}
-                      for lr, e in runs.items()}
+        'sweep': {n: {key: {k: v for k, v in e.items() if k != 'curve'}
+                      for key, e in runs.items()}
                   for n, runs in sweep.items()},
-        'curves': {n: {str(lr): e['curve'] for lr, e in runs.items()}
+        'curves': {n: {key: e['curve'] for key, e in runs.items()}
                    for n, runs in sweep.items()},
     }
     with open(args.out, 'w') as f:
@@ -237,7 +243,12 @@ def main(argv=None):
                         'the papers make) and record per-optimizer '
                         'bests plus the full sweep table')
     p.add_argument('--lr-grid', type=float, nargs='+',
-                   default=[0.03, 0.1, 0.3, 1.0])
+                   default=[0.003, 0.01, 0.03, 0.1])
+    p.add_argument('--kfac-damping-grid', type=float, nargs='+',
+                   default=None,
+                   help='sweep mode: damping values for the K-FAC leg '
+                        '(its step-size-control knob, swept like SGD '
+                        'sweeps lr; default: just --damping)')
     p.add_argument('--synthetic-size', type=int, default=4096)
     p.add_argument('--data-dir', default=None)
     p.add_argument('--seed', type=int, default=42)

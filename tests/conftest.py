@@ -35,8 +35,9 @@ def pytest_configure(config):
     # 2-process multihost rendezvous, the distributed static-cadence
     # equivalence runs) carry @pytest.mark.slow. They RUN by default so
     # the plain `pytest tests/` invocation covers everything (what the
-    # driver runs); the FAST TIER for dev loops is
-    # `pytest tests/ -m 'not slow'` or KFAC_SKIP_SLOW=1 (~2 min).
+    # driver runs; ~25 min single-core); the FAST TIER for dev loops is
+    # `pytest tests/ -m 'not slow'` or KFAC_SKIP_SLOW=1 (~2 min on a
+    # multi-core host; the compile-bound tests scale with cores).
     config.addinivalue_line('markers', 'slow: compile-heavy (~minutes)')
 
 

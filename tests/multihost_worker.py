@@ -131,8 +131,13 @@ def run_comm_bench(iters: int = 10, size: int = 256) -> dict:
     half = n // 2
     layouts = {
         # rows = inverse groups, cols = grad workers (Mesh axes order
-        # KFAC_AXES = (ig, gw)).
-        'gw_intra_process': np.asarray(devs).reshape(2, half),
+        # KFAC_AXES = (ig, gw)). Both layouts are (n/2, 2) — identical
+        # group sizes — so the recorded intra-vs-cross ratio isolates
+        # the fabric boundary, not collective size: 'intra' pairs grad
+        # workers within one process (C-order reshape keeps process-
+        # contiguous device pairs), 'cross' pairs device i of process 0
+        # with device i of process 1.
+        'gw_intra_process': np.asarray(devs).reshape(half, 2),
         'gw_cross_process': np.stack([np.asarray(devs[:half]),
                                       np.asarray(devs[half:])], axis=1),
     }
