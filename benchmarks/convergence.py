@@ -72,7 +72,8 @@ def run_one(use_kfac: bool, args, data):
         workers=1,
         kfac_inv_update_freq=args.kfac_update_freq if use_kfac else 0,
         kfac_cov_update_freq=1, damping=args.damping,
-        kl_clip=0.001, eigh_method=args.eigh_method)
+        kl_clip=0.001, eigh_method=args.eigh_method,
+        eigh_polish_iters=args.eigh_polish_iters)
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(
         model, cfg)
 
@@ -231,6 +232,7 @@ def main(argv=None):
     p.add_argument('--kfac-update-freq', type=int, default=10)
     p.add_argument('--damping', type=float, default=0.003)
     p.add_argument('--eigh-method', default='auto')
+    p.add_argument('--eigh-polish-iters', type=int, default=8)
     p.add_argument('--label-noise', type=float, default=0.0,
                    help='fraction of train labels flipped (fixed seed): '
                         'makes the synthetic task non-separable so the '

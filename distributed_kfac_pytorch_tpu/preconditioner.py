@@ -117,8 +117,12 @@ class KFAC:
         while-loops run ~5x longer on trained covariance factors than
         on identity-seeded ones (PERF.md §6).
       eigh_polish_iters: fixed iteration count for the warm polish
-        (default 16 — ~1e-5 steady-state tracking accuracy at EWMA drift
-        rates; see ops.linalg.eigh_polish).
+        (default 8 — ~1e-3 worst-case preconditioner error at EWMA
+        drift rates, measured indistinguishable from 16 iters on the
+        workload-level convergence study while saving ~1.5 ms/iter on
+        the tracked config at inv_freq=10; pass 16 for the ~1e-5
+        tracking regime. Sweep data: PERF.md round 3; see
+        ops.linalg.eigh_polish).
       newton_iters: iteration cap for 'newton' (the loop exits early on
         a 1e-5 residual; ~log2(cond)+6 iterations are used in practice).
       factor_dtype: dtype for factor running averages (default fp32; pass
@@ -174,7 +178,7 @@ class KFAC:
                  use_eigen_decomp: bool | None = None,
                  inverse_method: str | None = None,
                  eigh_method: str = 'auto',
-                 eigh_polish_iters: int = 16,
+                 eigh_polish_iters: int = 8,
                  newton_iters: int = 100,
                  factor_dtype: Any = None,
                  factor_compute_dtype: Any = None,

@@ -53,6 +53,7 @@ class OptimConfig:
     # previous eigenbasis (the TPU fast path — see ops.linalg.eigh_polish);
     # 'xla' | 'jacobi' | 'warm' as in KFAC.
     eigh_method: str = 'auto'
+    eigh_polish_iters: int = 8
     # bf16 factor storage/averaging AND bf16 covariance-matmul inputs
     # (the matmuls accumulate fp32; the EWMA running averages are kept in
     # bf16) — the reference's --fp16 factor mode. For bf16 matmuls with
@@ -142,6 +143,7 @@ def get_optimizer(model, cfg: OptimConfig):
             use_eigen_decomp=cfg.use_eigen_decomp,
             inverse_method=cfg.inverse_method,
             eigh_method=cfg.eigh_method,
+            eigh_polish_iters=cfg.eigh_polish_iters,
             factor_dtype=jnp.bfloat16 if cfg.bf16_factors else None,
             factor_compute_dtype=(jnp.bfloat16 if cfg.bf16_factors
                                   else None),

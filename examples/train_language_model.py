@@ -93,6 +93,10 @@ def parse_args(argv=None):
                    help='eigen-path decomposition backend; auto = '
                         'warm-start matmul-only basis polish (TPU '
                         'fast path)')
+    p.add_argument('--eigh-polish-iters', type=int, default=8,
+                   help='warm-polish iterations per eigh firing (8: ~1e-3 '
+                        'tracking, the measured-equivalent fast default; 16: '
+                        '~1e-5)')
     p.add_argument('--stat-decay', type=float, default=0.95)
     p.add_argument('--damping', type=float, default=0.003)
     p.add_argument('--kl-clip', type=float, default=0.001)
@@ -161,6 +165,7 @@ def main(argv=None):
         damping=args.damping, factor_decay=args.stat_decay,
         kl_clip=args.kl_clip, inverse_method=args.inverse_method,
         eigh_method=args.eigh_method,
+        eigh_polish_iters=args.eigh_polish_iters,
         skip_layers=args.skip_layers, comm_method=args.comm_method,
         grad_worker_fraction=args.grad_worker_fraction,
         symmetry_aware_comm=args.symmetry_aware_comm,

@@ -26,9 +26,9 @@ class MLP(nn.Module):
         return nn.Dense(self.widths[-1], name='head')(x)
 
 
-def setup_mlp(seed=0, batch=16, din=6):
+def setup_mlp(seed=0, batch=16, din=6, **kfac_kw):
     kfac = KFAC(MLP(), factor_update_freq=1, inv_update_freq=1,
-                kl_clip=None, factor_decay=0.5)
+                kl_clip=None, factor_decay=0.5, **kfac_kw)
     x = jax.random.normal(jax.random.PRNGKey(seed + 1), (batch, din))
     variables, state = kfac.init(jax.random.PRNGKey(seed), x)
     return kfac, variables['params'], state, x
@@ -38,39 +38,71 @@ def loss_fn(out):
     return jnp.mean(out ** 2)
 
 
+def oracle_factors_and_precondition(captures, grads, name, damping):
+    """NumPy oracle shared by the pipeline-math tests: EWMA factors
+    from identity (factor_decay 0.5), exact eigh Kronecker solve.
+    Returns (A, G, want_precond_mat)."""
+    a = np.asarray(captures[name]['a'][0])
+    g = np.asarray(captures[name]['g'][0])
+    aug = np.concatenate([a, np.ones((a.shape[0], 1), a.dtype)], 1)
+    A = 0.5 * np.eye(aug.shape[1]) + 0.5 * (aug.T @ aug / a.shape[0])
+    G = 0.5 * np.eye(g.shape[1]) + 0.5 * (g.T @ g / g.shape[0])
+    grad_mat = np.concatenate(
+        [np.asarray(grads[name]['kernel']).T,
+         np.asarray(grads[name]['bias'])[:, None]], 1)
+    dG, QG = np.linalg.eigh(G)
+    dA, QA = np.linalg.eigh(A)
+    v = QG.T @ grad_mat @ QA / (dG[:, None] * dA[None, :] + damping)
+    want = QG @ v @ QA.T
+    return A, G, want
+
+
+def _precond_mat(precond, name):
+    return np.concatenate(
+        [np.asarray(precond[name]['kernel']).T,
+         np.asarray(precond[name]['bias'])[:, None]], 1)
+
+
 def test_step_matches_explicit_kfac_math():
-    """Full pipeline == hand-rolled factor/eigh/precondition in numpy."""
-    kfac, params, state, x = setup_mlp()
+    """Full pipeline == hand-rolled factor/eigh/precondition in numpy.
+
+    Runs the HIGH-accuracy polish setting (16 iters, ~1e-5 tracking):
+    this test pins the MATH of the pipeline against an exact oracle.
+    The shipped default is 8 iters (~1e-3 — measured equivalent on the
+    workload-level convergence study, PERF.md round 3); its looser
+    accuracy envelope is pinned separately below.
+    """
+    kfac, params, state, x = setup_mlp(eigh_polish_iters=16)
     loss, _, grads, captures, _ = kfac.capture.loss_and_grads(
         loss_fn, params, x)
     precond, new_state = kfac.step(state, grads, captures, damping=0.01)
 
     for name in ('d0', 'head'):
-        a = np.asarray(captures[name]['a'][0])
-        g = np.asarray(captures[name]['g'][0])
-        aug = np.concatenate([a, np.ones((a.shape[0], 1), a.dtype)], 1)
-        A_new = aug.T @ aug / a.shape[0]
-        A = 0.5 * np.eye(A_new.shape[0]) + 0.5 * A_new  # EWMA from identity
-        G_new = g.T @ g / g.shape[0]
-        G = 0.5 * np.eye(G_new.shape[0]) + 0.5 * G_new
+        A, G, want = oracle_factors_and_precondition(
+            captures, grads, name, 0.01)
         np.testing.assert_allclose(new_state['factors'][name]['A'], A,
                                    rtol=1e-4, atol=1e-6)
         np.testing.assert_allclose(new_state['factors'][name]['G'], G,
                                    rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(_precond_mat(precond, name), want,
+                                   rtol=1e-3, atol=1e-5)
 
-        # oracle precondition via Kronecker solve
-        grad_mat = np.concatenate(
-            [np.asarray(grads[name]['kernel']).T,
-             np.asarray(grads[name]['bias'])[:, None]], 1)
-        dG, QG = np.linalg.eigh(G)
-        dA, QA = np.linalg.eigh(A)
-        v = QG.T @ grad_mat @ QA
-        v /= (dG[:, None] * dA[None, :] + 0.01)
-        want = QG @ v @ QA.T
-        got = np.concatenate(
-            [np.asarray(precond[name]['kernel']).T,
-             np.asarray(precond[name]['bias'])[:, None]], 1)
-        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-5)
+
+def test_default_polish_precondition_accuracy_envelope():
+    """The shipped 8-iter polish default preconditions within ~1e-2 of
+    the exact oracle on a cold single step (steady-state tracking is
+    tighter; the workload-level equivalence evidence is PERF.md r3)."""
+    kfac, params, state, x = setup_mlp()  # default polish iters
+    _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+        loss_fn, params, x)
+    precond, _ = kfac.step(state, grads, captures, damping=0.01)
+    for name in ('d0', 'head'):
+        _, _, want = oracle_factors_and_precondition(
+            captures, grads, name, 0.01)
+        got = _precond_mat(precond, name)
+        rel = (np.abs(got - want).max()
+               / max(float(np.abs(want).max()), 1e-30))
+        assert rel < 1e-2, (name, rel)
 
 
 def test_cadence_gating():
@@ -214,7 +246,10 @@ def test_inverse_method_path():
 
 
 def test_state_dict_roundtrip_recomputes_inverses():
-    kfac, params, state, x = setup_mlp()
+    # High-accuracy polish: the test compares the warm-polish operator
+    # against the exact-eigh operator the reload recomputes, so the
+    # polish must be in its ~1e-5 regime for the rtol below.
+    kfac, params, state, x = setup_mlp(eigh_polish_iters=16)
     _, _, grads, captures, _ = kfac.capture.loss_and_grads(loss_fn, params, x)
     _, state = kfac.step(state, grads, captures)
 
