@@ -6,11 +6,15 @@ every tracked config has a *recorded number* rather than prose:
   1. resnet32_cifar10        — full K-FAC+SGD step, eigen/cholesky/
                                newton/eigen-xla (on-chip; bench.py's
                                config, broken out per method)
-  2. resnet18_imagenet       — on-chip steady state (ResNet-50 + K-FAC
+  2. resnet18_imagenet       — on-chip steady state as ONE program.
+                               The real config-2 flagship number is
+                               benchmarks/flagship_resnet50.py (round
+                               3): ResNet-50 measured per phase in
+                               isolated processes, composed per
+                               cadence — the monolithic ResNet-50 step
                                exceeds the tunneled dev chip's
-                               remote-compile size limit, PERF.md; the
-                               driver bench on a real TPU VM can lift
-                               this to resnet50 via --model)
+                               remote-compile size limit (PERF.md);
+                               --model resnet50 works on a real TPU VM
   3. hybrid_sweep            — HYBRID grad_worker_fraction relative
                                step times on the 8-device CPU mesh
                                (relative only: CPU mesh collectives are
