@@ -427,3 +427,53 @@ class TestDynamicLossScale:
         with pytest.raises(ValueError, match='init_loss_scale'):
             step(params, opt_state, kstate, extra, batch, hyper,
                  factor_update=True, inv_update=True)
+
+    @pytest.mark.slow
+    def test_dynamic_scale_with_grad_accum(self):
+        """The live scale threads through the micro-batch scan
+        (accum_fwd_bwd's scale parameter) and overflow-skip still works
+        when contributions come from accumulated micro-batches."""
+        from distributed_kfac_pytorch_tpu import fp16
+
+        model = cifar_resnet.get_model('resnet20')
+        kfac = KFAC(model, factor_update_freq=1, inv_update_freq=1,
+                    damping=0.01, lr=0.05)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 16, 16, 3))
+        y = jax.random.randint(jax.random.PRNGKey(2), (8,), 0, 10)
+        variables, _ = kfac.init(jax.random.PRNGKey(0), x)
+        params = variables['params']
+        extra = {'batch_stats': variables['batch_stats'],
+                 'loss_scale': fp16.init_loss_scale(2.0 ** 10)}
+        mesh = D.make_kfac_mesh(jax.devices()[:4])
+        dkfac = D.DistributedKFAC(kfac, mesh, params)
+        kstate = dkfac.init_state(params)
+        tx = optax.sgd(0.05)
+        opt_state = tx.init(params)
+
+        def loss(out, batch):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                out, batch[1]).mean()
+
+        step = dkfac.build_train_step(loss, tx,
+                                      mutable_cols=('batch_stats',),
+                                      donate=False, grad_accum_steps=2,
+                                      loss_scale='dynamic')
+        hyper = {'lr': 0.05, 'damping': 0.01,
+                 'factor_update_freq': 1, 'inv_update_freq': 1}
+        p2, o2, k2, e2, m = step(params, opt_state, kstate, extra,
+                                 (x, y), hyper,
+                                 factor_update=True, inv_update=True)
+        assert float(m['overflow']) == 0.0
+        moved = jax.tree.leaves(jax.tree.map(
+            lambda a, b: float(jnp.abs(a - b).max()), params, p2))
+        assert max(moved) > 0
+        # Overflow micro-batch poisons the summed grads -> whole step
+        # skipped collectively, scale backs off.
+        bad_x = x.at[0, 0, 0, 0].set(jnp.nan)
+        p3, o3, k3, e3, m3 = step(params, opt_state, kstate, extra,
+                                  (bad_x, y), hyper,
+                                  factor_update=True, inv_update=True)
+        assert float(m3['overflow']) == 1.0
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), params, p3)
+        assert float(e3['loss_scale']['scale']) == 2.0 ** 9
