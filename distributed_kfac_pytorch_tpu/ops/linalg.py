@@ -426,3 +426,57 @@ def precondition_diag_a(grad: jax.Array, a_inv_diag: jax.Array,
     Reference analogue: kfac/layers/embedding.py:87-99 (disabled there).
     """
     return (a_inv_diag[:, None] * grad.astype(jnp.float32)) @ g_inv
+
+
+def _eigen_side_inverse(q: jax.Array, d: jax.Array,
+                        damping: float | jax.Array) -> jax.Array:
+    """Per-side damped inverse from an eigendecomposition:
+    ``Q diag(1/(d+λ)) Q^T`` = ``(F + λI)^{-1}`` (exact when (Q, d) is)."""
+    q = q.astype(jnp.float32)
+    d = d.astype(jnp.float32)
+    return (q * (1.0 / (d + damping))[None, :]) @ q.T
+
+
+def precondition_dispatch(grad: jax.Array, entry: dict,
+                          damping: float | jax.Array,
+                          diag_a: jax.Array | None = None) -> jax.Array:
+    """Per-layer preconditioning, dispatched on the inverse slots present.
+
+    Single point of truth for the single-chip and SPMD preconditioners
+    under per-dim inverse dispatch (``inverse_method='auto'``): each side
+    of a layer is represented either by an eigendecomposition
+    (``QA``/``dA``, ``QG``/``dG``) or by a baked damped inverse
+    (``A_inv``, ``G_inv``), and the four combinations compose as
+
+      - both eigen: the reference eigen path with *joint* damping
+        ``1/(dG dA^T + λ)`` (kfac/layers/base.py:459-470);
+      - both inverse: ``G_inv @ grad @ A_inv`` with λ baked per side
+        (kfac/layers/base.py:472-475 — the reference non-eigen method);
+      - mixed: the eigen side applies its *per-side* damped inverse
+        ``Q diag(1/(d+λ)) Q^T = (F+λI)^{-1}``, matching the baked side's
+        convention, so a mixed layer is exactly the reference non-eigen
+        operator ``(G+λI)^{-1} ⊗ (A+λI)^{-1}`` computed from whichever
+        representation each side has. Damping-semantics note: PARITY.md.
+
+    ``diag_a``: diagonal A inverse for embedding layers (elementwise,
+    damping already baked) — then ``entry`` carries only the G side.
+    """
+    if diag_a is not None:
+        if 'QG' in entry:
+            v1 = grad.astype(jnp.float32) @ entry['QG']
+            v2 = v1 / (entry['dG'][None, :] + damping)
+            return diag_a[:, None] * (v2 @ entry['QG'].T)
+        return precondition_diag_a(grad, diag_a, entry['G_inv'])
+    a_eigen = 'QA' in entry
+    g_eigen = 'QG' in entry
+    if a_eigen and g_eigen:
+        return precondition_eigen(grad, entry['QA'], entry['QG'],
+                                  entry['dA'], entry['dG'], damping)
+    if not a_eigen and not g_eigen:
+        return precondition_inv(grad, entry['A_inv'], entry['G_inv'])
+    grad = grad.astype(jnp.float32)
+    if a_eigen:
+        right = _eigen_side_inverse(entry['QA'], entry['dA'], damping)
+        return entry['G_inv'] @ grad @ right
+    left = _eigen_side_inverse(entry['QG'], entry['dG'], damping)
+    return left @ grad @ entry['A_inv']

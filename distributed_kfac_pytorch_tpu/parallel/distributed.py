@@ -323,7 +323,11 @@ class DistributedKFAC:
         stacks = {}
         for dim, plan in self.assignment.buckets.items():
             n_slots = self.n_rows * plan.slots_per_row
-            if self.kfac.use_eigen_decomp:
+            # Buckets are dim-homogeneous, so the per-dim dispatch
+            # ('auto': eigen below the cutoff, damped inverse above —
+            # KFAC.method_for_dim) picks each bucket's representation
+            # wholesale; global modes make every bucket the same.
+            if self.kfac.method_for_dim(dim) == 'eigen':
                 # Identity bases / unit eigenvalues: the exact
                 # eigendecomposition of the identity-seeded factors, and
                 # a valid warm start for the eigh_method='auto' polish
@@ -460,7 +464,8 @@ class DistributedKFAC:
             local = jax.lax.dynamic_slice(
                 full, (row * plan.slots_per_row + col * s, 0, 0),
                 (s, dim, dim))
-            if kfac.use_eigen_decomp:
+            bucket_method = kfac.method_for_dim(dim)
+            if bucket_method == 'eigen':
                 q_prev = None
                 if prev_stacks is not None and eigh_method == 'auto':
                     # Inside shard_map the stored stack is the *local*
@@ -480,7 +485,7 @@ class DistributedKFAC:
                                     'd': d.astype(kfac.inv_dtype)}
             else:
                 inv = pallas_kernels.damped_inverse_stack(
-                    local, damping, kfac.inverse_method,
+                    local, damping, bucket_method,
                     iters=kfac.newton_iters)
                 inv = jax.lax.all_gather(
                     inv, GRAD_WORKER_AXIS, tiled=True)
@@ -506,14 +511,14 @@ class DistributedKFAC:
         if spec.kind != EMBEDDING:
             plan = self.assignment.buckets[a_dim]
             sl = plan.slot[(name, 'A')]
-            if kfac.use_eigen_decomp:
+            if kfac.method_for_dim(a_dim) == 'eigen':
                 out['QA'] = inv_stacks[str(a_dim)]['Q'][sl]
                 out['dA'] = inv_stacks[str(a_dim)]['d'][sl]
             else:
                 out['A_inv'] = inv_stacks[str(a_dim)]['inv'][sl]
         plan = self.assignment.buckets[g_dim]
         sl = plan.slot[(name, 'G')]
-        if kfac.use_eigen_decomp:
+        if kfac.method_for_dim(g_dim) == 'eigen':
             out['QG'] = inv_stacks[str(g_dim)]['Q'][sl]
             out['dG'] = inv_stacks[str(g_dim)]['d'][sl]
         else:
@@ -543,21 +548,13 @@ class DistributedKFAC:
             grad_mat = L.grads_to_matrix(spec, _get(grads, spec.path))
             grad_mats[name] = grad_mat
             inv = self._layer_inverses(inv_stacks, name)
-            if spec.kind == EMBEDDING:
-                if kfac.use_eigen_decomp:
-                    v1 = grad_mat.astype(jnp.float32) @ inv['QG']
-                    v2 = v1 / (inv['dG'][None, :] + damping)
-                    v = diag_inv[name][:, None] * (v2 @ inv['QG'].T)
-                else:
-                    v = linalg.precondition_diag_a(
-                        grad_mat, diag_inv[name], inv['G_inv'])
-            elif kfac.use_eigen_decomp:
-                v = linalg.precondition_eigen(
-                    grad_mat, inv['QA'], inv['QG'], inv['dA'], inv['dG'],
-                    damping)
-            else:
-                v = linalg.precondition_inv(grad_mat, inv['A_inv'],
-                                            inv['G_inv'])
+            # Same four-way per-side dispatch as the single-chip path
+            # (linalg.precondition_dispatch) so 'auto' mixed-method
+            # layers cannot drift between the two.
+            v = linalg.precondition_dispatch(
+                grad_mat, inv, damping,
+                diag_a=(diag_inv[name] if spec.kind == EMBEDDING
+                        else None))
             mask = (row == self.assignment.layer_row[name]).astype(v.dtype)
             precond_mats[name] = v * mask
 
@@ -681,8 +678,14 @@ class DistributedKFAC:
                 f'{sorted(sd["factors"])} vs {sorted(state["factors"])}')
         state = {**state, 'step': jnp.asarray(sd['step'], jnp.int32),
                  'factors': sd['factors']}
-        if 'inv_stacks' in sd and not self._degenerate_stacks(
-                sd['inv_stacks']):
+        # Layout compatibility: a checkpoint written under a different
+        # inverse dispatch (e.g. 'eigen' stacks loaded into an 'auto'
+        # config whose large buckets are 'inv'-typed) is rebuilt from
+        # factors rather than spliced in structurally mismatched.
+        compatible = 'inv_stacks' in sd and all(
+            set(sd['inv_stacks'].get(k, ())) == set(state['inv_stacks'][k])
+            for k in state['inv_stacks'])
+        if compatible and not self._degenerate_stacks(sd['inv_stacks']):
             state = {**state, 'inv_stacks': sd['inv_stacks'],
                      'diag_inv': sd['diag_inv']}
         else:
@@ -696,10 +699,9 @@ class DistributedKFAC:
         Q=0 is a fixed point of the warm polish, so such checkpoints
         must be rebuilt from factors instead of warm-started. Shares
         :func:`preconditioner.q_stack_degenerate` (multi-host safe:
-        inspects addressable shards only).
+        inspects addressable shards only). Under 'auto' dispatch only
+        the eigen buckets carry Q stacks — only those are checked.
         """
-        if not self.kfac.use_eigen_decomp:
-            return False
         return any(q_stack_degenerate(entry['Q'])
                    for entry in inv_stacks.values() if 'Q' in entry)
 

@@ -98,13 +98,30 @@ class KFAC:
       kl_clip: KL clipping parameter; None disables scaling (default 0.001).
       lr: learning rate used in the KL-clip scale (default 0.1).
       use_eigen_decomp: eigendecomposition method if True, else damped
-        inverses (default True; mutually consistent with
-        ``inverse_method`` — contradictory combinations raise).
-      inverse_method: 'eigen' (same as ``use_eigen_decomp=True``),
+        inverses (default None -> per-dim 'auto' dispatch; mutually
+        consistent with ``inverse_method`` — contradictory combinations
+        raise).
+      inverse_method: 'auto' (the default — per-factor-dim dispatch:
+        the eigen path with the warm-start polish where it wins, dims
+        <= ``auto_eigen_max_dim``; ``auto_large_method`` damped inverses
+        above, where the fp32 polish matmuls blow up — measured 41x at
+        flagship 4609-dim factors, PERF.md round 3/4. One default that
+        is fast at every scale, the analogue of the reference's one
+        eigen default serving all dims, kfac/layers/base.py:432-441),
+        'eigen' (same as ``use_eigen_decomp=True`` — every factor),
         'cholesky' (XLA Cholesky + triangular solves, the reference's
         non-eigen method) or 'newton' (matmul-only Newton–Schulz, Pallas
-        VMEM-resident on TPU — see ops.pallas_kernels). Defaults to
-        'eigen'/'cholesky' per ``use_eigen_decomp``.
+        VMEM-resident on TPU — see ops.pallas_kernels).
+      auto_eigen_max_dim: largest factor dim the 'auto' dispatch keeps
+        on the eigen path (default 640 — the measured v5e crossover
+        region: warm polish wins 3-5x over cold eigh at CIFAR-class
+        dims <= 577 and costs seconds per firing at 2305+; PERF.md).
+        Layers with one side above and one below mix representations;
+        any such *split* layer preconditions as the reference's
+        non-eigen operator ``(G+λI)^{-1} ⊗ (A+λI)^{-1}`` (damping
+        semantics note: PARITY.md; dispatch: linalg.precondition_dispatch).
+      auto_large_method: 'cholesky' (default) or 'newton' — the damped
+        inverse used above the cutoff in 'auto' mode.
       eigh_method: backend for the eigen path's decompositions:
         'auto' (default — the warm-start matmul-only basis polish,
         ops.linalg.eigh_polish, seeded from the previous firing's
@@ -177,6 +194,8 @@ class KFAC:
                  lr: float = 0.1,
                  use_eigen_decomp: bool | None = None,
                  inverse_method: str | None = None,
+                 auto_eigen_max_dim: int = 640,
+                 auto_large_method: str = 'cholesky',
                  eigh_method: str = 'auto',
                  eigh_polish_iters: int = 8,
                  newton_iters: int = 100,
@@ -218,23 +237,33 @@ class KFAC:
         self.kl_clip = kl_clip
         self.lr = lr
         if inverse_method is None:
-            inverse_method = ('cholesky' if use_eigen_decomp is False
-                              else 'eigen')
-        if inverse_method not in ('eigen', 'cholesky', 'newton'):
+            if use_eigen_decomp is None:
+                inverse_method = 'auto'
+            else:
+                inverse_method = ('eigen' if use_eigen_decomp
+                                  else 'cholesky')
+        if inverse_method not in ('auto', 'eigen', 'cholesky', 'newton'):
             raise ValueError(
-                "inverse_method must be 'eigen', 'cholesky' or 'newton', "
-                f'got {inverse_method!r}')
+                "inverse_method must be 'auto', 'eigen', 'cholesky' or "
+                f"'newton', got {inverse_method!r}")
         if use_eigen_decomp is not None and (
-                use_eigen_decomp != (inverse_method == 'eigen')):
+                inverse_method == 'auto'
+                or use_eigen_decomp != (inverse_method == 'eigen')):
             raise ValueError(
                 f'{use_eigen_decomp=} contradicts {inverse_method=}; '
                 'set one or the other')
+        if auto_large_method not in ('cholesky', 'newton'):
+            raise ValueError(
+                "auto_large_method must be 'cholesky' or 'newton', "
+                f'got {auto_large_method!r}')
         if eigh_method not in ('auto', 'xla', 'jacobi', 'warm'):
             raise ValueError(
                 "eigh_method must be 'auto', 'xla', 'jacobi' or 'warm', "
                 f'got {eigh_method!r}')
         self.inverse_method = inverse_method
         self.use_eigen_decomp = inverse_method == 'eigen'
+        self.auto_eigen_max_dim = auto_eigen_max_dim
+        self.auto_large_method = auto_large_method
         self.eigh_method = eigh_method
         self.eigh_polish_iters = eigh_polish_iters
         self.newton_iters = newton_iters
@@ -253,6 +282,7 @@ class KFAC:
         preconditioner.py:265-292)."""
         fields = ('damping', 'factor_decay', 'factor_update_freq',
                   'inv_update_freq', 'kl_clip', 'lr', 'inverse_method',
+                  'auto_eigen_max_dim', 'auto_large_method',
                   'eigh_method', 'eigh_polish_iters', 'newton_iters',
                   'factor_dtype',
                   'factor_compute_dtype', 'inv_dtype', 'symmetry_aware_comm',
@@ -263,6 +293,32 @@ class KFAC:
                     else '<uninitialized>')
         lines.append(f'  registered_layers: {n_layers}')
         return 'KFAC(\n' + '\n'.join(lines) + '\n)'
+
+    # ------------------------------------------------------------------
+    # Per-dim inverse dispatch
+    # ------------------------------------------------------------------
+
+    def method_for_dim(self, dim: int) -> str:
+        """Decomposition method for a dense factor of this dimension.
+
+        'auto' dispatches per dim (eigen below ``auto_eigen_max_dim``,
+        ``auto_large_method`` above — the measured v5e crossover,
+        PERF.md); global modes return themselves. Host-side, static:
+        the dispatch is baked into the trace, so it costs nothing at
+        runtime and the single-chip and SPMD paths share it (VERDICT r3
+        asks #1/#7).
+        """
+        if self.inverse_method == 'auto':
+            return ('eigen' if dim <= self.auto_eigen_max_dim
+                    else self.auto_large_method)
+        return self.inverse_method
+
+    def _side_methods(self, spec, a_dim: int, g_dim: int
+                      ) -> tuple[str | None, str]:
+        """(A-side, G-side) methods for one layer; diagonal A -> None."""
+        ma = (None if spec.kind == EMBEDDING
+              else self.method_for_dim(a_dim))
+        return ma, self.method_for_dim(g_dim)
 
     # ------------------------------------------------------------------
     # Registration / state init
@@ -317,30 +373,26 @@ class KFAC:
             a_dim, g_dim = L.factor_shapes(spec, _get(params, spec.path))
             fdt = self.factor_dtype or jnp.float32
             idt = self.inv_dtype
+            ma, mg = self._side_methods(spec, a_dim, g_dim)
+            entry: dict[str, Any] = {}
             if spec.kind == EMBEDDING:
                 factors[name] = {'A': jnp.ones((a_dim,), fdt),
                                  'G': jnp.eye(g_dim, dtype=fdt)}
-                if self.use_eigen_decomp:
-                    inverses[name] = {'A_inv': jnp.zeros((a_dim,), idt),
-                                      'QG': jnp.eye(g_dim, dtype=idt),
-                                      'dG': jnp.ones((g_dim,), idt)}
-                else:
-                    inverses[name] = {'A_inv': jnp.zeros((a_dim,), idt),
-                                      'G_inv': jnp.zeros((g_dim, g_dim),
-                                                         idt)}
+                entry['A_inv'] = jnp.zeros((a_dim,), idt)
             else:
                 factors[name] = {'A': jnp.eye(a_dim, dtype=fdt),
                                  'G': jnp.eye(g_dim, dtype=fdt)}
-                if self.use_eigen_decomp:
-                    inverses[name] = {
-                        'QA': jnp.eye(a_dim, dtype=idt),
-                        'QG': jnp.eye(g_dim, dtype=idt),
-                        'dA': jnp.ones((a_dim,), idt),
-                        'dG': jnp.ones((g_dim,), idt)}
+                if ma == 'eigen':
+                    entry['QA'] = jnp.eye(a_dim, dtype=idt)
+                    entry['dA'] = jnp.ones((a_dim,), idt)
                 else:
-                    inverses[name] = {
-                        'A_inv': jnp.zeros((a_dim, a_dim), idt),
-                        'G_inv': jnp.zeros((g_dim, g_dim), idt)}
+                    entry['A_inv'] = jnp.zeros((a_dim, a_dim), idt)
+            if mg == 'eigen':
+                entry['QG'] = jnp.eye(g_dim, dtype=idt)
+                entry['dG'] = jnp.ones((g_dim,), idt)
+            else:
+                entry['G_inv'] = jnp.zeros((g_dim, g_dim), idt)
+            inverses[name] = entry
         return {'step': jnp.zeros((), jnp.int32),
                 'factors': factors, 'inverses': inverses}
 
@@ -412,12 +464,15 @@ class KFAC:
         Non-eigen analogue of :meth:`_bucketed_eigh` (reference damped
         Cholesky inverse, kfac/layers/base.py:432-441): 'newton' runs the
         matmul-only Newton–Schulz stack (Pallas VMEM-resident on TPU),
-        'cholesky' a vmapped XLA Cholesky inverse.
+        'cholesky' a vmapped XLA Cholesky inverse. Per-bucket method
+        comes from :meth:`method_for_dim` (callers only route factors
+        here whose dim resolves to a non-eigen method).
         """
         out: dict[str, jax.Array] = {}
         for names, stack in _size_buckets(mats):
             invs = pallas_kernels.damped_inverse_stack(
-                stack, damping, self.inverse_method, iters=self.newton_iters)
+                stack, damping, self.method_for_dim(stack.shape[-1]),
+                iters=self.newton_iters)
             for i, n in enumerate(names):
                 out[n] = invs[i]
         return out
@@ -434,46 +489,53 @@ class KFAC:
         stored bases are untrustworthy (e.g. rebuilding from a
         factor-only checkpoint, where inverse slots are fresh identity).
         """
-        mats = {}
+        # Split the dense factors by per-dim method ('auto' mixes both
+        # groups; global modes put everything in one). Prev-basis warm
+        # starts apply only to the eigen group.
+        eigen_mats: dict[str, jax.Array] = {}
+        inv_mats: dict[str, jax.Array] = {}
+        prev: dict[str, jax.Array] = {}
+        sides: dict[str, tuple[str | None, str]] = {}
         for name, spec in self.specs.items():
-            if spec.kind != EMBEDDING:
-                mats[f'{name}/A'] = state['factors'][name]['A']
-            mats[f'{name}/G'] = state['factors'][name]['G']
+            f = state['factors'][name]
+            ma, mg = self._side_methods(spec, f['A'].shape[-1],
+                                        f['G'].shape[-1])
+            sides[name] = (ma, mg)
+            for which, m in (('A', ma), ('G', mg)):
+                if m is None:
+                    continue
+                key = f'{name}/{which}'
+                if m == 'eigen':
+                    eigen_mats[key] = f[which]
+                    if warm:
+                        prev[key] = state['inverses'][name][f'Q{which}']
+                else:
+                    inv_mats[key] = f[which]
+
+        eigs = self._bucketed_eigh(eigen_mats, prev if warm else None)
+        invs = self._bucketed_inverse(inv_mats, damping)
 
         new_inv = {}
-        if self.use_eigen_decomp:
-            prev = None
-            if warm:
-                prev = {}
-                for name, spec in self.specs.items():
-                    if spec.kind != EMBEDDING:
-                        prev[f'{name}/A'] = state['inverses'][name]['QA']
-                    prev[f'{name}/G'] = state['inverses'][name]['QG']
-            eigs = self._bucketed_eigh(mats, prev)
-            for name, spec in self.specs.items():
+        for name, spec in self.specs.items():
+            ma, mg = sides[name]
+            entry: dict[str, Any] = {}
+            if spec.kind == EMBEDDING:
+                entry['A_inv'] = linalg.get_elementwise_inverse(
+                    state['factors'][name]['A'].astype(jnp.float32),
+                    damping=damping).astype(self.inv_dtype)
+            elif ma == 'eigen':
+                qa, da = eigs[f'{name}/A']
+                entry['QA'] = qa.astype(self.inv_dtype)
+                entry['dA'] = da.astype(self.inv_dtype)
+            else:
+                entry['A_inv'] = invs[f'{name}/A'].astype(self.inv_dtype)
+            if mg == 'eigen':
                 qg, dg = eigs[f'{name}/G']
-                entry = {'QG': qg.astype(self.inv_dtype),
-                         'dG': dg.astype(self.inv_dtype)}
-                if spec.kind == EMBEDDING:
-                    entry['A_inv'] = linalg.get_elementwise_inverse(
-                        state['factors'][name]['A'].astype(jnp.float32),
-                        damping=damping).astype(self.inv_dtype)
-                else:
-                    qa, da = eigs[f'{name}/A']
-                    entry['QA'] = qa.astype(self.inv_dtype)
-                    entry['dA'] = da.astype(self.inv_dtype)
-                new_inv[name] = entry
-        else:
-            invs = self._bucketed_inverse(mats, damping)
-            for name, spec in self.specs.items():
-                entry = {'G_inv': invs[f'{name}/G'].astype(self.inv_dtype)}
-                if spec.kind == EMBEDDING:
-                    entry['A_inv'] = linalg.get_elementwise_inverse(
-                        state['factors'][name]['A'].astype(jnp.float32),
-                        damping=damping).astype(self.inv_dtype)
-                else:
-                    entry['A_inv'] = invs[f'{name}/A'].astype(self.inv_dtype)
-                new_inv[name] = entry
+                entry['QG'] = qg.astype(self.inv_dtype)
+                entry['dG'] = dg.astype(self.inv_dtype)
+            else:
+                entry['G_inv'] = invs[f'{name}/G'].astype(self.inv_dtype)
+            new_inv[name] = entry
         return new_inv
 
     def precondition(self, state: dict, grads: dict, damping, lr,
@@ -492,23 +554,13 @@ class KFAC:
             spec = self.specs[name]
             grad_mat = L.grads_to_matrix(spec, _get(grads, spec.path))
             inv = state['inverses'][name]
-            if spec.kind == EMBEDDING:
-                if self.use_eigen_decomp:
-                    # G in eigenbasis, A diagonal: v = A_inv*grad QG /(dG+λ) QG^T
-                    v1 = grad_mat.astype(jnp.float32) @ inv['QG']
-                    v2 = v1 / (inv['dG'][None, :] + damping)
-                    v = (inv['A_inv'][:, None] * (v2 @ inv['QG'].T))
-                else:
-                    v = linalg.precondition_diag_a(
-                        grad_mat, inv['A_inv'], inv['G_inv'])
-            elif self.use_eigen_decomp:
-                v = linalg.precondition_eigen(
-                    grad_mat, inv['QA'], inv['QG'], inv['dA'], inv['dG'],
-                    damping)
-            else:
-                v = linalg.precondition_inv(grad_mat, inv['A_inv'],
-                                            inv['G_inv'])
-            precond_mats[name] = v
+            # Four-way per-side dispatch (eigen / baked inverse on each
+            # side — the 'auto' mode mixes them per dim); embedding A is
+            # the diagonal elementwise inverse. Shared with the SPMD
+            # preconditioner: linalg.precondition_dispatch.
+            precond_mats[name] = linalg.precondition_dispatch(
+                grad_mat, inv, damping,
+                diag_a=(inv['A_inv'] if spec.kind == EMBEDDING else None))
 
         if self.kl_clip is not None:
             vg_sum = jnp.zeros((), jnp.float32)
@@ -624,8 +676,13 @@ class KFAC:
                 f'{sorted(sd["factors"])} vs {sorted(state["factors"])}')
         state = {**state, 'step': jnp.asarray(sd['step'], jnp.int32),
                  'factors': sd['factors']}
-        if 'inverses' in sd and not _degenerate_bases(sd['inverses'],
-                                                      self.use_eigen_decomp):
+        # A checkpoint written under a different inverse layout (e.g.
+        # 'eigen' saved, 'auto' loading) is structurally incompatible —
+        # rebuild from factors instead of splicing mismatched slots in.
+        compatible = 'inverses' in sd and all(
+            set(sd['inverses'].get(n, ())) == set(state['inverses'][n])
+            for n in state['inverses'])
+        if compatible and not _degenerate_bases(sd['inverses']):
             state = {**state, 'inverses': sd['inverses']}
         elif compute_inverses:
             # warm=False: the fresh state's identity bases are not a
@@ -675,13 +732,12 @@ def q_stack_degenerate(q) -> bool:
     return shard_bad(q)
 
 
-def _degenerate_bases(inverses: dict, use_eigen: bool) -> bool:
+def _degenerate_bases(inverses: dict) -> bool:
     """True if any stored eigenbasis in a per-layer inverse dict is
     unusable (see :func:`q_stack_degenerate`); the caller falls back to
     recomputing inverses from factors (the reference's behavior,
-    preconditioner.py:347-353)."""
-    if not use_eigen:
-        return False
+    preconditioner.py:347-353). Checks whatever eigen slots exist —
+    under 'auto' dispatch only the below-cutoff sides carry bases."""
     return any(q_stack_degenerate(entry[key])
                for entry in inverses.values()
                for key in ('QA', 'QG') if key in entry)

@@ -48,7 +48,10 @@ class OptimConfig:
     factor_decay: float = 0.95
     kl_clip: float = 0.001
     use_eigen_decomp: bool | None = None  # None: follow inverse_method
-    inverse_method: str | None = None     # 'eigen' | 'cholesky' | 'newton'
+    # 'auto' | 'eigen' | 'cholesky' | 'newton'; None (default) -> the
+    # per-dim 'auto' dispatch (eigen below KFAC.auto_eigen_max_dim,
+    # cholesky above — fast at every factor scale).
+    inverse_method: str | None = None
     # 'auto' (default): warm-start basis polish seeded from the state's
     # previous eigenbasis (the TPU fast path — see ops.linalg.eigh_polish);
     # 'xla' | 'jacobi' | 'warm' as in KFAC.

@@ -135,7 +135,10 @@ def main(argv=None):
         kfac_inv_update_freq=args.kfac_update_freq,
         kfac_cov_update_freq=args.kfac_cov_update_freq,
         damping=args.damping, factor_decay=args.stat_decay,
-        kl_clip=args.kl_clip, use_eigen_decomp=not args.use_inv_kfac,
+        # Default (flag absent) -> None -> the per-dim 'auto' dispatch;
+        # identical to eigen at CIFAR factor dims (all <= 577 < cutoff).
+        kl_clip=args.kl_clip,
+        use_eigen_decomp=False if args.use_inv_kfac else None,
         eigh_method=args.eigh_method,
         eigh_polish_iters=args.eigh_polish_iters,
         skip_layers=args.skip_layers, comm_method=args.comm_method,

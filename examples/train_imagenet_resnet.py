@@ -72,8 +72,11 @@ def parse_args(argv=None):
     p.add_argument('--kfac-update-freq-alpha', type=float, default=10)
     p.add_argument('--kfac-update-freq-decay', type=int, nargs='+',
                    default=[])
-    p.add_argument('--inverse-method', default='eigen',
-                   choices=['eigen', 'cholesky', 'newton'])
+    p.add_argument('--inverse-method', default='auto',
+                   choices=['auto', 'eigen', 'cholesky', 'newton'],
+                   help='auto = per-dim dispatch: eigen below the '
+                        'measured cutoff, cholesky above (the TPU '
+                        'default that is fast at flagship factor dims)')
     p.add_argument('--eigh-method', default='auto',
                    choices=['auto', 'xla', 'jacobi', 'warm'],
                    help='eigen-path decomposition backend; auto = '

@@ -86,8 +86,11 @@ def parse_args(argv=None):
     p.add_argument('--kfac-update-freq', type=int, default=10,
                    help='inverse update interval; 0 disables K-FAC')
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
-    p.add_argument('--inverse-method', default='eigen',
-                   choices=['eigen', 'cholesky', 'newton'])
+    p.add_argument('--inverse-method', default='auto',
+                   choices=['auto', 'eigen', 'cholesky', 'newton'],
+                   help='auto = per-dim dispatch: eigen below the '
+                        'measured cutoff, cholesky above (the TPU '
+                        'default that is fast at LM factor dims)')
     p.add_argument('--eigh-method', default='auto',
                    choices=['auto', 'xla', 'jacobi', 'warm'],
                    help='eigen-path decomposition backend; auto = '
