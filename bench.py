@@ -46,6 +46,7 @@ is a slight *underestimate*.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import jax
@@ -67,6 +68,16 @@ TPU_BF16_PEAK = {
 }
 V5E_BF16_PEAK = TPU_BF16_PEAK['v5e']  # tracked dev chip
 
+# device_kind spellings that don't contain the canonical generation tag
+# (ADVICE r3: some stacks report v5e as 'TPU v5 lite', silently dropping
+# MFU fields). Checked before the substring scan.
+TPU_KIND_ALIASES = {
+    'v5 lite': 'v5e',
+    'v5litepod': 'v5e',
+    'v5lite': 'v5e',
+    'v6 lite': 'v6e',
+}
+
 
 def detected_tpu_peak():
     """(peak_flops_or_None, floor_peak): best-known bf16 peak for MFU and
@@ -82,7 +93,13 @@ def detected_tpu_peak():
     if not gen:
         try:
             kind = jax.devices()[0].device_kind.lower()
-            gen = next((g for g in TPU_BF16_PEAK if g in kind), '')
+            gen = next((v for k, v in TPU_KIND_ALIASES.items()
+                        if k in kind), '')
+            gen = gen or next((g for g in TPU_BF16_PEAK if g in kind), '')
+            if not gen:
+                print(f'# bench: unrecognized TPU device_kind {kind!r} — '
+                      'MFU fields omitted (floor stays conservative)',
+                      file=sys.stderr)
         except Exception:
             gen = ''
     peak = TPU_BF16_PEAK.get(gen)

@@ -48,7 +48,7 @@ SHAPES = [
     ('imagenet_c128_s2_56to28', 64, 56, 56, 128, (3, 3), (2, 2)),
 ]
 
-IMPLS = ['slices', 'crosscov', 'dilated']
+IMPLS = ['slices', 'crosscov', 'dilated', 'pairs']
 
 
 def build_runner(x0, impl, inner, kernel, strides, null=False):

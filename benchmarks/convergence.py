@@ -73,7 +73,11 @@ def run_one(use_kfac: bool, args, data):
         kfac_inv_update_freq=args.kfac_update_freq if use_kfac else 0,
         kfac_cov_update_freq=1, damping=args.damping,
         kl_clip=0.001, eigh_method=args.eigh_method,
-        eigh_polish_iters=args.eigh_polish_iters)
+        eigh_polish_iters=args.eigh_polish_iters,
+        damping_alpha=args.damping_alpha,
+        damping_schedule=args.damping_decay,
+        kfac_update_freq_alpha=args.kfac_freq_alpha,
+        kfac_update_freq_schedule=args.kfac_freq_decay)
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(
         model, cfg)
 
@@ -231,6 +235,12 @@ def main(argv=None):
     p.add_argument('--lr-decay', type=int, nargs='+', default=[15, 23])
     p.add_argument('--kfac-update-freq', type=int, default=10)
     p.add_argument('--damping', type=float, default=0.003)
+    # KFACParamScheduler knobs (the round-3 analysis prescribed a
+    # damping/update-freq schedule for the conv/BN study; VERDICT r3 #6).
+    p.add_argument('--damping-alpha', type=float, default=1.0)
+    p.add_argument('--damping-decay', type=int, nargs='+', default=[])
+    p.add_argument('--kfac-freq-alpha', type=float, default=1.0)
+    p.add_argument('--kfac-freq-decay', type=int, nargs='+', default=[])
     p.add_argument('--eigh-method', default='auto')
     p.add_argument('--eigh-polish-iters', type=int, default=8)
     p.add_argument('--label-noise', type=float, default=0.0,

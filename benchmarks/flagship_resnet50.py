@@ -56,7 +56,7 @@ def emit(obj):
 # Single-phase workers (run in a fresh process via --phase)
 # ---------------------------------------------------------------------------
 
-def _setup(model_name, batch, image, **kfac_kw):
+def _setup(model_name, batch, image, model_dtype=None, **kfac_kw):
     import jax
     import jax.numpy as jnp
     import optax
@@ -65,7 +65,13 @@ def _setup(model_name, batch, image, **kfac_kw):
     from distributed_kfac_pytorch_tpu import KFAC
     from distributed_kfac_pytorch_tpu.models import imagenet_resnet
 
-    model = imagenet_resnet.get_model(model_name)
+    # bf16 model compute = the TPU-native analogue of the reference's
+    # fp16 production ImageNet recipe (launch_node_torch_imagenet.sh:
+    # 73-87 passes --fp16); also what makes batch 128 @ 224px fit in a
+    # single v5e's 16 GB HBM (fp32 activations RESOURCE_EXHAUST there).
+    dt = {None: jnp.float32, 'fp32': jnp.float32,
+          'bf16': jnp.bfloat16}[model_dtype]
+    model = imagenet_resnet.get_model(model_name, dtype=dt)
     x = jax.random.normal(jax.random.PRNGKey(1), (batch, image, image, 3))
     y = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 1000)
     kfac = KFAC(model, factor_update_freq=1, inv_update_freq=1,
@@ -74,10 +80,11 @@ def _setup(model_name, batch, image, **kfac_kw):
     return (jax, jnp, optax, B, model, kfac, variables, kstate, x, y)
 
 
-def phase_step_leg(model_name, batch, image, mode, n_iters, **kfac_kw):
+def phase_step_leg(model_name, batch, image, mode, n_iters,
+                   model_dtype=None, **kfac_kw):
     """sgd | precond | factors | inv: scanned train-step variants."""
     (jax, jnp, optax, B, model, kfac, variables, kstate, x, y) = _setup(
-        model_name, batch, image, **kfac_kw)
+        model_name, batch, image, model_dtype=model_dtype, **kfac_kw)
     params = variables['params']
     extra = {k: v for k, v in variables.items() if k != 'params'}
     tx = optax.sgd(0.1, momentum=0.9)
@@ -125,7 +132,15 @@ def phase_step_leg(model_name, batch, image, mode, n_iters, **kfac_kw):
 
     floor = B.flops_floor_ms(kfac, variables, x, y,
                              mutable_cols=('batch_stats',))
-    return B.time_chained(run, carry0, n_iters, floor_ms=floor, leg=mode)
+    ms = B.time_chained(run, carry0, n_iters, floor_ms=floor, leg=mode)
+    # Hand-counted model-math MFU (fwd+bwd FLOPs over wall time; K-FAC
+    # work is overhead, so its legs read lower — VERDICT r3 ask #2).
+    peak, _ = B.detected_tpu_peak()
+    mfu = None
+    if peak:
+        flops = B.model_flops_per_step(kfac, params, x, y, extra)
+        mfu = round(flops / (ms * 1e-3) / peak, 4)
+    return ms, mfu
 
 
 def phase_firing(model_name, batch, image, n_firings, **kfac_kw):
@@ -178,10 +193,12 @@ def run_phase(args):
     if args.phase == 'firing':
         ms = phase_firing(args.model, args.batch, args.image, args.iters,
                           **kw)
+        emit({'phase_result': round(ms, 2)})
     else:
-        ms = phase_step_leg(args.model, args.batch, args.image,
-                            args.phase, args.iters, **kw)
-    emit({'phase_result': round(ms, 2)})
+        ms, mfu = phase_step_leg(args.model, args.batch, args.image,
+                                 args.phase, args.iters,
+                                 model_dtype=args.model_dtype, **kw)
+        emit({'phase_result': round(ms, 2), 'mfu': mfu})
 
 
 # ---------------------------------------------------------------------------
@@ -189,10 +206,12 @@ def run_phase(args):
 # ---------------------------------------------------------------------------
 
 def spawn_phase(phase, model, batch, image, iters, bf16=False,
-                inverse_method=None):
+                inverse_method=None, model_dtype=None):
     cmd = [sys.executable, os.path.abspath(__file__), '--phase', phase,
            '--model', model, '--batch', str(batch), '--image', str(image),
            '--iters', str(iters)]
+    if model_dtype:
+        cmd += ['--model-dtype', model_dtype]
     if bf16:
         cmd.append('--bf16-factors')
     if inverse_method:
@@ -201,51 +220,53 @@ def spawn_phase(phase, model, batch, image, iters, bf16=False,
         out = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=2400, cwd=REPO)
     except subprocess.TimeoutExpired:
-        return 'failed: timeout'
+        return 'failed: timeout', None
     for line in reversed(out.stdout.strip().splitlines()):
         try:
-            return json.loads(line)['phase_result']
+            obj = json.loads(line)
+            return obj['phase_result'], obj.get('mfu')
         except Exception:
             continue
     err = (out.stderr or '').strip().splitlines()
-    return 'failed: ' + (err[-1][:120] if err else f'rc={out.returncode}')
+    return ('failed: ' + (err[-1][:120] if err else f'rc={out.returncode}'),
+            None)
 
 
 def config2(args):
-    rows = {}
+    rows, mfus = {}, {}
     if args.reuse_legs:
         # 'sgd=16.03,precond=19.54,factors=31.28' from a prior recorded
         # run — each ~10 min of compile on the tunnel; they reproduced
-        # within 1% across three round-3 runs.
+        # within 1% across round-3 runs (no MFU fields for reused legs).
         rows = {k: float(v) for k, v in
                 (kv.split('=') for kv in args.reuse_legs.split(','))}
         emit({'config': 2, 'reused_legs': rows})
     for mode in ('sgd', 'precond', 'factors'):
         if mode in rows:
             continue
-        rows[mode] = spawn_phase(mode, args.model, args.batch, args.image,
-                                 args.iters)
+        rows[mode], mfus[mode] = spawn_phase(
+            mode, args.model, args.batch, args.image, args.iters,
+            model_dtype=args.model_dtype)
         emit({'config': 2, 'phase': mode, 'batch': args.batch,
-              'image': args.image, 'ms_per_iter': rows[mode]})
+              'image': args.image, 'ms_per_iter': rows[mode],
+              'mfu': mfus.get(mode)})
     # The monolithic capture+factors+inverse program exceeds the compile
     # limit (tried each round; poisons the session) — the firing is
     # measured standalone instead, which IS the production execution
-    # shape under static cadence. Per-method: the 4609-dim flagship
-    # factors move the eigen-vs-cholesky tradeoff, so record both.
-    firing = spawn_phase('firing', args.model, 8, args.image, args.iters)
-    emit({'config': 2, 'phase': 'inverse_firing_standalone_eigen',
-          'ms_per_firing': firing})
-    firing_chol = spawn_phase('firing', args.model, 8, args.image,
-                              args.iters, inverse_method='cholesky')
-    emit({'config': 2, 'phase': 'inverse_firing_standalone_cholesky',
-          'ms_per_firing': firing_chol})
+    # shape under static cadence. Per-method, 'auto' FIRST: the per-dim
+    # dispatch is the out-of-the-box default (round 4), so the headline
+    # composed row is the default config's; eigen/cholesky record the
+    # endpoints the dispatch interpolates between.
+    firings = {}
+    for method in ('auto', 'cholesky', 'eigen'):
+        firings[method], _ = spawn_phase('firing', args.model, 8,
+                                         args.image, args.iters,
+                                         inverse_method=method)
+        emit({'config': 2,
+              'phase': f'inverse_firing_standalone_{method}',
+              'ms_per_firing': firings[method]})
 
-    # Compose cadence totals per available firing method — cholesky
-    # FIRST: it is 41x cheaper per firing at flagship factor dims and
-    # the recommended flagship mode (PERF.md round 3), so the headline
-    # composed row must be reproducible from this tool's output.
-    methods = [(m, v) for m, v in (('cholesky', firing_chol),
-                                   ('eigen', firing))
+    methods = [(m, v) for m, v in firings.items()
                if isinstance(v, (int, float))]
     if all(isinstance(v, (int, float)) for v in rows.values()) \
             and methods:
@@ -255,6 +276,7 @@ def config2(args):
                    'workload': f'{args.model}_imagenet{args.image}'
                                f'_b{args.batch}',
                    'unit': 'ms/iter', 'sgd': rows['sgd'],
+                   'mfu_sgd': mfus.get('sgd'),
                    'every_iter': rows['precond'],
                    'factor_cost': round(factor_cost, 2),
                    'inv_firing_method': fire_method,
@@ -265,24 +287,32 @@ def config2(args):
                 total = rows['precond'] + factor_cost / f + fire_ms / i
                 out[label] = round(total, 2)
                 out[label + '_vs_sgd'] = round(total / rows['sgd'], 3)
+                # Model-math MFU at this cadence: flops fixed per step,
+                # so mfu scales as sgd_ms/total from the SGD leg's MFU.
+                if mfus.get('sgd'):
+                    out[label + '_mfu'] = round(
+                        mfus['sgd'] * rows['sgd'] / total, 4)
             emit(out)
     else:
         emit({'config': 2, 'workload': args.model, 'partial': rows,
-              'inv_firing_eigen': firing,
-              'inv_firing_cholesky': firing_chol})
+              'firings': firings})
 
 
 def config5(args):
     """ResNet-152 full factor set through the real decomposition path,
     bf16 factors + fp32 eigendecomp (BASELINE config 5). 64px input:
     factor dims depend on channel/kernel structure only."""
-    firing = spawn_phase('firing', 'resnet152', 4, 64, args.iters,
-                         bf16=True)
+    # inverse_method='eigen' explicitly: this config tracks the fp32
+    # EIGENDECOMPOSITION cost series across rounds — the round-4 'auto'
+    # default would silently send the >640-dim factors to cholesky and
+    # corrupt the baseline series under the same label.
+    firing, _ = spawn_phase('firing', 'resnet152', 4, 64, args.iters,
+                            bf16=True, inverse_method='eigen')
     emit({'config': 5,
           'workload': 'resnet152_full_factor_set_bf16_fp32eigh',
           'decomposition_firing_ms': firing})
-    factors = spawn_phase('factors', 'resnet152', 4, 64, args.iters,
-                          bf16=True)
+    factors, _ = spawn_phase('factors', 'resnet152', 4, 64, args.iters,
+                             bf16=True)
     emit({'config': 5, 'phase': 'factors_b4_64px',
           'ms_per_iter': factors})
 
@@ -297,8 +327,13 @@ def main(argv=None):
     p.add_argument('--phase', default=None,
                    help='internal: run a single measurement leg')
     p.add_argument('--bf16-factors', action='store_true')
+    p.add_argument('--model-dtype', default=None,
+                   choices=['fp32', 'bf16'],
+                   help='model compute dtype for the step legs; bf16 = '
+                        "the reference fp16 production recipe's TPU "
+                        'analogue (and what fits b128 @ 224px in HBM)')
     p.add_argument('--inverse-method', default=None,
-                   choices=['eigen', 'cholesky', 'newton'])
+                   choices=['auto', 'eigen', 'cholesky', 'newton'])
     p.add_argument('--reuse-legs', default=None,
                    help="e.g. 'sgd=16.03,precond=19.54,factors=31.28' "
                         'from a prior recorded run')

@@ -20,6 +20,22 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 
+def _norm_layer(norm: str, train: bool, dtype, name: str):
+    """BatchNorm (reference parity) or GroupNorm (stateless control).
+
+    The 'group' variant exists for the convergence methodology: BN's
+    running statistics lag large preconditioned weight movement on
+    small synthetic sets (the recorded round-3 val-oscillation
+    negative); GroupNorm has no cross-step state, so a GN run isolates
+    whether BN statistics — not the preconditioner — drive the
+    oscillation. 8 groups (standard; >= 2 channels/group at planes=16).
+    """
+    if norm == 'group':
+        return nn.GroupNorm(num_groups=8, dtype=dtype, name=name)
+    return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                        dtype=dtype, name=name)
+
+
 class BasicBlock(nn.Module):
     """3x3 conv -> BN -> relu -> 3x3 conv -> BN + shortcut -> relu.
 
@@ -30,6 +46,7 @@ class BasicBlock(nn.Module):
     planes: int
     stride: int = 1
     dtype: jnp.dtype = jnp.float32
+    norm: str = 'batch'
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -38,15 +55,13 @@ class BasicBlock(nn.Module):
                     padding=1, use_bias=False, dtype=self.dtype,
                     kernel_init=nn.initializers.kaiming_normal(),
                     name='conv1')(x)
-        y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         dtype=self.dtype, name='bn1')(y)
+        y = _norm_layer(self.norm, train, self.dtype, 'bn1')(y)
         y = nn.relu(y)
         y = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False,
                     dtype=self.dtype,
                     kernel_init=nn.initializers.kaiming_normal(),
                     name='conv2')(y)
-        y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         dtype=self.dtype, name='bn2')(y)
+        y = _norm_layer(self.norm, train, self.dtype, 'bn2')(y)
         if self.stride != 1 or in_planes != self.planes:
             # Option A: subsample spatially, zero-pad channels (NHWC).
             sc = x[:, ::2, ::2, :]
@@ -66,20 +81,20 @@ class CifarResNet(nn.Module):
     num_blocks: Sequence[int]
     num_classes: int = 10
     dtype: jnp.dtype = jnp.float32
+    norm: str = 'batch'
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         y = nn.Conv(16, (3, 3), padding=1, use_bias=False, dtype=self.dtype,
                     kernel_init=nn.initializers.kaiming_normal(),
                     name='conv1')(x)
-        y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         dtype=self.dtype, name='bn1')(y)
+        y = _norm_layer(self.norm, train, self.dtype, 'bn1')(y)
         y = nn.relu(y)
         for stage, (planes, stride) in enumerate(
                 zip((16, 32, 64), (1, 2, 2)), start=1):
             for i in range(self.num_blocks[stage - 1]):
                 y = BasicBlock(planes, stride if i == 0 else 1,
-                               dtype=self.dtype,
+                               dtype=self.dtype, norm=self.norm,
                                name=f'layer{stage}_block{i}')(y, train=train)
         y = jnp.mean(y, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=self.dtype,
@@ -92,19 +107,25 @@ _DEPTHS = {20: (3, 3, 3), 32: (5, 5, 5), 44: (7, 7, 7), 56: (9, 9, 9),
 
 
 def resnet(depth: int, num_classes: int = 10,
-           dtype: jnp.dtype = jnp.float32) -> CifarResNet:
+           dtype: jnp.dtype = jnp.float32,
+           norm: str = 'batch') -> CifarResNet:
     """CIFAR ResNet by depth (20/32/44/56/110/1202)."""
     if depth not in _DEPTHS:
         raise ValueError(f'unsupported CIFAR ResNet depth {depth}; '
                          f'choose from {sorted(_DEPTHS)}')
     return CifarResNet(num_blocks=_DEPTHS[depth], num_classes=num_classes,
-                       dtype=dtype)
+                       dtype=dtype, norm=norm)
 
 
 def get_model(name: str, num_classes: int = 10,
               dtype: jnp.dtype = jnp.float32) -> CifarResNet:
-    """Model by name, e.g. 'resnet32' (reference cifar_resnet.py:40-51)."""
+    """Model by name, e.g. 'resnet32' (reference cifar_resnet.py:40-51);
+    a 'gn' suffix ('resnet20gn') swaps BatchNorm for GroupNorm (the
+    stateless-normalization control used by the convergence study)."""
     name = name.lower()
     if not name.startswith('resnet'):
         raise ValueError(f'unknown CIFAR model {name!r}')
-    return resnet(int(name[len('resnet'):]), num_classes, dtype)
+    norm = 'batch'
+    if name.endswith('gn'):
+        norm, name = 'group', name[:-2]
+    return resnet(int(name[len('resnet'):]), num_classes, dtype, norm)

@@ -10,6 +10,8 @@ weight through its embedding registration only.
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -24,21 +26,25 @@ class LSTMLanguageModel(nn.Module):
     dropout: float = 0.5
     tie_weights: bool = False
     kfac_cell: bool = True
+    dtype: Any = None    # compute dtype (params stay fp32); None = infer
 
     @nn.compact
     def __call__(self, ids, states=None, *, train: bool = True):
         if self.tie_weights and self.embedding_dim != self.hidden_dim:
             raise ValueError('tie_weights requires embedding_dim == '
                              'hidden_dim (reference rnn lstm.py:38-41)')
-        embed = nn.Embed(self.vocab_size, self.embedding_dim, name='embed')
+        embed = nn.Embed(self.vocab_size, self.embedding_dim,
+                         dtype=self.dtype, name='embed')
         x = embed(ids)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
         x, states = LSTM(self.hidden_dim, num_layers=self.num_layers,
                          dropout=self.dropout, kfac_cell=self.kfac_cell,
+                         dtype=self.dtype,
                          name='lstm')(x, states, train=train)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
         if self.tie_weights:
             logits = embed.attend(x)
         else:
-            logits = nn.Dense(self.vocab_size, name='decoder')(x)
+            logits = nn.Dense(self.vocab_size, dtype=self.dtype,
+                              name='decoder')(x)
         return logits, states

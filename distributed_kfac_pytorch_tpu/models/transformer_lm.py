@@ -16,6 +16,8 @@ analogue (SURVEY.md §5: sequence handling = BPTT truncation only).
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -29,6 +31,7 @@ class CausalSelfAttention(nn.Module):
     """Multi-head causal self-attention from four K-FAC-visible Denses."""
     num_heads: int
     seq_axis: str | None = None
+    dtype: Any = None    # compute dtype (params stay fp32); None = infer
 
     @nn.compact
     def __call__(self, x):
@@ -41,15 +44,15 @@ class CausalSelfAttention(nn.Module):
         def heads(y):
             return y.reshape(*y.shape[:-1], self.num_heads, head_dim)
 
-        q = heads(nn.Dense(d_model, name='q_proj')(x))
-        k = heads(nn.Dense(d_model, name='k_proj')(x))
-        v = heads(nn.Dense(d_model, name='v_proj')(x))
+        q = heads(nn.Dense(d_model, dtype=self.dtype, name='q_proj')(x))
+        k = heads(nn.Dense(d_model, dtype=self.dtype, name='k_proj')(x))
+        v = heads(nn.Dense(d_model, dtype=self.dtype, name='v_proj')(x))
         if self.seq_axis is not None:
             o = ring_self_attention(q, k, v, axis_name=self.seq_axis)
         else:
             o = local_causal_attention(q, k, v)
         o = o.reshape(*x.shape[:-1], d_model).astype(x.dtype)
-        return nn.Dense(d_model, name='out_proj')(o)
+        return nn.Dense(d_model, dtype=self.dtype, name='out_proj')(o)
 
 
 class TransformerBlock(nn.Module):
@@ -58,18 +61,21 @@ class TransformerBlock(nn.Module):
     mlp_ratio: int = 4
     dropout: float = 0.0
     seq_axis: str | None = None
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
         d_model = x.shape[-1]
         h = CausalSelfAttention(self.num_heads, seq_axis=self.seq_axis,
-                                name='attn')(nn.LayerNorm(name='ln1')(x))
+                                dtype=self.dtype, name='attn')(
+            nn.LayerNorm(dtype=self.dtype, name='ln1')(x))
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         x = x + h
-        y = nn.LayerNorm(name='ln2')(x)
-        y = nn.Dense(self.mlp_ratio * d_model, name='mlp_in')(y)
+        y = nn.LayerNorm(dtype=self.dtype, name='ln2')(x)
+        y = nn.Dense(self.mlp_ratio * d_model, dtype=self.dtype,
+                     name='mlp_in')(y)
         y = nn.gelu(y)
-        y = nn.Dense(d_model, name='mlp_out')(y)
+        y = nn.Dense(d_model, dtype=self.dtype, name='mlp_out')(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         return x + y
 
@@ -93,25 +99,28 @@ class TransformerLM(nn.Module):
     dropout: float = 0.1
     tie_weights: bool = True
     seq_axis: str | None = None
+    dtype: Any = None    # compute dtype (params stay fp32); None = infer
 
     @nn.compact
     def __call__(self, ids, *, train: bool = True, pos_offset=0):
-        embed = nn.Embed(self.vocab_size, self.d_model, name='embed')
+        embed = nn.Embed(self.vocab_size, self.d_model,
+                         dtype=self.dtype, name='embed')
         x = embed(ids)
         pos_table = self.param(
             'pos_embed', nn.initializers.normal(0.02),
             (self.max_len, self.d_model))
         pos = pos_offset + jnp.arange(ids.shape[-1])
-        x = x + pos_table[pos]
+        x = x + pos_table[pos].astype(x.dtype)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
         for i in range(self.num_layers):
             x = TransformerBlock(self.num_heads, dropout=self.dropout,
-                                 seq_axis=self.seq_axis,
+                                 seq_axis=self.seq_axis, dtype=self.dtype,
                                  name=f'block{i}')(x, train=train)
-        x = nn.LayerNorm(name='ln_f')(x)
+        x = nn.LayerNorm(dtype=self.dtype, name='ln_f')(x)
         if self.tie_weights:
             return embed.attend(x)
-        return nn.Dense(self.vocab_size, name='decoder')(x)
+        return nn.Dense(self.vocab_size, dtype=self.dtype,
+                        name='decoder')(x)
 
 
 def get_model(vocab_size: int, size: str = 'small',

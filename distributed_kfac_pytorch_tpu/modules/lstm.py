@@ -33,6 +33,7 @@ class LSTMCellKFAC(nn.Module):
     torch's ``bias_ih``/``bias_hh`` pair collapsed to one.
     """
     hidden_size: int
+    dtype: Any = None    # compute dtype (params stay fp32); None = infer
 
     @nn.compact
     def __call__(self, x, state):
@@ -40,9 +41,9 @@ class LSTMCellKFAC(nn.Module):
         gates = {}
         for name in ('i', 'f', 'g', 'o'):
             wx = nn.Dense(self.hidden_size, use_bias=True,
-                          name=f'w_{name}x')(x)
+                          dtype=self.dtype, name=f'w_{name}x')(x)
             wh = nn.Dense(self.hidden_size, use_bias=True,
-                          name=f'w_{name}h')(h)
+                          dtype=self.dtype, name=f'w_{name}h')(h)
             gates[name] = wx + wh
         i = nn.sigmoid(gates['i'])
         f = nn.sigmoid(gates['f'])
@@ -61,12 +62,15 @@ class LSTMCell(nn.Module):
     K-FAC blocks per cell.
     """
     hidden_size: int
+    dtype: Any = None    # compute dtype (params stay fp32); None = infer
 
     @nn.compact
     def __call__(self, x, state):
         h, c = state
-        zx = nn.Dense(4 * self.hidden_size, use_bias=True, name='w_ih')(x)
-        zh = nn.Dense(4 * self.hidden_size, use_bias=True, name='w_hh')(h)
+        zx = nn.Dense(4 * self.hidden_size, use_bias=True,
+                      dtype=self.dtype, name='w_ih')(x)
+        zh = nn.Dense(4 * self.hidden_size, use_bias=True,
+                      dtype=self.dtype, name='w_hh')(h)
         z = zx + zh
         i, f, g, o = jnp.split(z, 4, axis=-1)
         new_c = nn.sigmoid(f) * c + nn.sigmoid(i) * nn.tanh(g)
@@ -84,6 +88,7 @@ class LSTMLayer(nn.Module):
     hidden_size: int
     kfac_cell: bool = True    # 8 per-gate blocks vs 2 fused blocks
     reverse: bool = False
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, xs, state=None, lengths=None):
@@ -118,7 +123,7 @@ class LSTMLayer(nn.Module):
         distortion is modest and affects bias updates only.
         """
         cell_cls = LSTMCellKFAC if self.kfac_cell else LSTMCell
-        cell = cell_cls(self.hidden_size, name='cell')
+        cell = cell_cls(self.hidden_size, dtype=self.dtype, name='cell')
         batch = xs.shape[0]
         if state is None:
             h = jnp.zeros((batch, self.hidden_size), xs.dtype)
@@ -156,6 +161,7 @@ class LSTM(nn.Module):
     dropout: float = 0.0
     bidirectional: bool = False
     kfac_cell: bool = True
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, xs, states=None, *, lengths=None,
@@ -171,7 +177,8 @@ class LSTM(nn.Module):
                 idx = layer * n_dirs + d
                 seq, st = LSTMLayer(
                     self.hidden_size, kfac_cell=self.kfac_cell,
-                    reverse=(d == 1), name=f'layer{layer}_d{d}')(
+                    reverse=(d == 1), dtype=self.dtype,
+                    name=f'layer{layer}_d{d}')(
                         out, states[idx], lengths=lengths)
                 dirs.append(seq)
                 new_states.append(st)

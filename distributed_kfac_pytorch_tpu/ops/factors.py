@@ -227,6 +227,76 @@ def extract_conv2d_patches_slices(x: jax.Array,
     return jnp.concatenate(pieces, axis=-1)
 
 
+def _conv_a_cov_pairs(a: jax.Array, kernel_size, strides, padding,
+                      compute_dtype) -> jax.Array:
+    """Blocked pairwise shifted-view contraction (round-4 third angle).
+
+    The A-factor weight block decomposes over kernel offsets:
+    ``A[(i, c), (j, c')] = Σ_rows view_i[r, c] · view_j[r, c']`` where
+    ``view_i`` is the i-th strided *view* of the padded input (the same
+    shifted slices the ``slices`` path concatenates into the patch
+    tensor). Each of the ``n(n+1)/2`` upper block pairs
+    (``n = kh·kw``) is ONE ``dot_general`` contracting the
+    ``(b, oh, ow)`` dims of two views directly; lower blocks are
+    transposes. vs the materialized-patch path:
+
+      - ~half the MACs — the block symmetry ``B(j,i) = B(i,j)^T`` is
+        exploitable here, while the patch-Gram ``P^T P`` matmul cannot
+        skip its lower triangle;
+      - no ``(rows, kh·kw·c)`` patch concat is ever written — operands
+        are slices of the one padded input buffer (whether XLA fuses
+        the slice into the contraction or materializes per-view copies
+        is the measured question; see PERF.md round 4);
+      - distinct from the failed crosscov band-trace (KFAC_CONV_PATCH_
+        IMPL=crosscov, the round-2 3.3x regression): rows are
+        contracted directly — the (W_p·C)^2 spatial Gram never exists
+        and nothing is gather-assembled.
+
+    Returns the (d, d) fp32 Gram (sum over rows, unscaled), in the
+    (kh, kw, c) feature basis.
+    """
+    from distributed_kfac_pytorch_tpu.ops.pallas_kernels import (
+        _canonical_pad,
+    )
+
+    kh, kw = kernel_size
+    sh, sw = strides
+    b, h, w, c = a.shape
+    (ph_lo, ph_hi), (pw_lo, pw_hi) = _canonical_pad(
+        padding, (kh, kw), (h, w), (sh, sw))
+    xp = jnp.pad(a, ((0, 0), (ph_lo, ph_hi), (pw_lo, pw_hi), (0, 0)))
+    oh = (h + ph_lo + ph_hi - kh) // sh + 1
+    ow = (w + pw_lo + pw_hi - kw) // sw + 1
+    precision = None
+    if compute_dtype is not None:
+        xp = xp.astype(compute_dtype)
+        if jnp.dtype(compute_dtype) == jnp.float32:
+            precision = jax.lax.Precision.HIGHEST
+    views = [
+        jax.lax.slice(xp, (0, ki, kj, 0),
+                      (b, ki + sh * (oh - 1) + 1,
+                       kj + sw * (ow - 1) + 1, c),
+                      (1, sh, sw, 1))
+        for ki in range(kh) for kj in range(kw)]
+    n = kh * kw
+    blocks: dict[tuple[int, int], jax.Array] = {}
+    for i in range(n):
+        for j in range(i, n):
+            blocks[(i, j)] = jax.lax.dot_general(
+                views[i], views[j],
+                dimension_numbers=(((0, 1, 2), (0, 1, 2)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=precision)
+    gram = jnp.concatenate(
+        [jnp.concatenate(
+            [blocks[(i, j)] if i <= j else blocks[(j, i)].T
+             for j in range(n)], axis=1)
+         for i in range(n)], axis=0)
+    # Diagonal blocks are v^T v (symmetric up to fp round-off); one
+    # cheap (d, d) symmetrization matches get_cov's contract.
+    return 0.5 * (gram + gram.T)
+
+
 def _conv_out_geometry(a: jax.Array, kernel_size, strides, padding):
     """(oh, ow, rows, spatial) of the conv output for NHWC input ``a``."""
     from distributed_kfac_pytorch_tpu.ops.pallas_kernels import _canonical_pad
@@ -409,26 +479,56 @@ def conv2d_a_factor(a: jax.Array, kernel_size, strides, padding,
         # (compute_dtype=float32) keeps fp32 patches.
         a = a.astype(jnp.bfloat16)
     impl = os.environ.get('KFAC_CONV_PATCH_IMPL', 'auto')
-    if impl not in ('auto', 'slices', 'crosscov', 'dilated'):
+    if impl not in ('auto', 'slices', 'crosscov', 'dilated', 'pairs'):
         raise ValueError(
             f'KFAC_CONV_PATCH_IMPL={impl!r}: expected one of '
-            "'auto', 'slices', 'crosscov', 'dilated'")
+            "'auto', 'slices', 'crosscov', 'dilated', 'pairs'")
     if impl == 'auto':
         # Measured per-shape dispatch (benchmarks/conv_a_microbench.py
-        # on v5e — re-run it for current numbers; the PERF.md round-3
-        # table records the deciding measurements): slices wins every
-        # CIFAR class and every large-d class (d>=1152: dilated 3-5x
-        # worse — the identity-kernel conv burns rows*d*d MXU FLOPs);
-        # dilated wins the large-spatial small-d regime (c64@56x56
-        # ~1.4x, and the 7x7/s2 ImageNet stem ~50x, where the 49-slice
-        # concat relayouts are catastrophic while the conv tiles well).
+        # on v5e — re-run it for current numbers; PERF.md rounds 3-4
+        # record the deciding measurements):
+        #   - dilated wins the large-spatial small-d regime (c64@56x56
+        #     ~1.3x, and the 7x7/s2 ImageNet stem ~60x, where the
+        #     49-slice concat relayouts are catastrophic while the
+        #     identity-kernel conv tiles well);
+        #   - pairs (round 4: blocked pairwise view contraction, ~half
+        #     the MACs via block symmetry) wins every measured d > 640
+        #     multi-tap class — ImageNet c128/c256/c512 3x3 at 1.2-2.2x
+        #     over slices, incl. stride 2;
+        #   - slices wins the remaining (CIFAR-class) shapes: at c<=64
+        #     the pairs path's c-wide blocks underfeed the MXU lanes
+        #     (stage2/3 measured 1.6-2.5x worse) while the 9c-wide
+        #     patch matmul tiles fine.
         oh, ow, _, spatial = _conv_out_geometry(a, kernel_size, strides,
                                                 padding)
         # kh*kw == 1 stays on slices: a 1x1 "patch extraction" is a
-        # single strided slice with no concat relayout, and the dilated
-        # path's rows*d*d identity-conv FLOPs are pure waste there.
-        impl = ('dilated' if spatial >= 2048 and d <= 640
-                and kh * kw > 1 else 'slices')
+        # single strided slice with no concat relayout, and both other
+        # paths' extra work is pure waste there.
+        if kh * kw == 1:
+            impl = 'slices'
+        elif spatial >= 2048 and d <= 640:
+            impl = 'dilated'
+        elif d > 640:
+            impl = 'pairs'
+        else:
+            impl = 'slices'
+    if impl == 'pairs' and kh * kw > 1:
+        # Round-4 third angle: blocked pairwise view contraction —
+        # ~half the patch path's MACs (block symmetry), no patch
+        # concat. Per-shape numbers: benchmarks/conv_a_microbench.py;
+        # dispatched from 'auto' only where measured to win (PERF.md
+        # round 4). kh*kw == 1 is a plain covariance — slices path.
+        gram = _conv_a_cov_pairs(a, kernel_size, strides, padding,
+                                 compute_dtype)
+        oh, ow, rows, spatial = _conv_out_geometry(
+            a, kernel_size, strides, padding)
+        cov = gram * (1.0 / (rows * spatial * spatial))
+        if not has_bias:
+            return cov
+        bias_col = _conv_bias_col(a, kernel_size, strides, padding,
+                                  rows, spatial).astype(cov.dtype)
+        return _assemble_bias_factor(cov, bias_col,
+                                     1.0 / (spatial * spatial))
     if impl == 'crosscov':
         # Opt-in ONLY: measured 3.3x whole-step regression as the
         # default on v5e (BENCH_r02.json) — see _conv_a_cov_crosscov's
@@ -447,7 +547,7 @@ def conv2d_a_factor(a: jax.Array, kernel_size, strides, padding,
                                       rows, spatial).astype(cov.dtype)
             return _assemble_bias_factor(cov, bias_col,
                                          1.0 / (spatial * spatial))
-    if impl in ('auto', 'slices', 'crosscov'):
+    if impl in ('auto', 'slices', 'crosscov', 'pairs'):
         # DEFAULT: pad+slice+concat assembly — measured 24.3 ms/iter
         # whole-step on the tracked v5e config vs 80.2 for crosscov and
         # ~38 for dilated (BENCH_r01/r02 + round-2 verdict bisection).

@@ -428,10 +428,17 @@ def precondition_diag_a(grad: jax.Array, a_inv_diag: jax.Array,
     return (a_inv_diag[:, None] * grad.astype(jnp.float32)) @ g_inv
 
 
-def _eigen_side_inverse(q: jax.Array, d: jax.Array,
-                        damping: float | jax.Array) -> jax.Array:
+def eigen_side_inverse(q: jax.Array, d: jax.Array,
+                       damping: float | jax.Array) -> jax.Array:
     """Per-side damped inverse from an eigendecomposition:
-    ``Q diag(1/(d+λ)) Q^T`` = ``(F + λI)^{-1}`` (exact when (Q, d) is)."""
+    ``Q diag(1/(d+λ)) Q^T`` = ``(F + λI)^{-1}`` (exact when (Q, d) is).
+
+    Used at inverse-*firing* time to bake a mixed-method layer's eigen
+    side into a dense damped inverse, so both sides of a split layer
+    carry the same firing-time λ (the reference non-eigen timing
+    semantics, kfac/layers/base.py:439: damping is baked at
+    compute-inverses time, not read at precondition time).
+    """
     q = q.astype(jnp.float32)
     d = d.astype(jnp.float32)
     return (q * (1.0 / (d + damping))[None, :]) @ q.T
@@ -443,40 +450,34 @@ def precondition_dispatch(grad: jax.Array, entry: dict,
     """Per-layer preconditioning, dispatched on the inverse slots present.
 
     Single point of truth for the single-chip and SPMD preconditioners
-    under per-dim inverse dispatch (``inverse_method='auto'``): each side
-    of a layer is represented either by an eigendecomposition
-    (``QA``/``dA``, ``QG``/``dG``) or by a baked damped inverse
-    (``A_inv``, ``G_inv``), and the four combinations compose as
+    under per-dim inverse dispatch (``inverse_method='auto'``):
 
-      - both eigen: the reference eigen path with *joint* damping
-        ``1/(dG dA^T + λ)`` (kfac/layers/base.py:459-470);
-      - both inverse: ``G_inv @ grad @ A_inv`` with λ baked per side
-        (kfac/layers/base.py:472-475 — the reference non-eigen method);
-      - mixed: the eigen side applies its *per-side* damped inverse
-        ``Q diag(1/(d+λ)) Q^T = (F+λI)^{-1}``, matching the baked side's
-        convention, so a mixed layer is exactly the reference non-eigen
-        operator ``(G+λI)^{-1} ⊗ (A+λI)^{-1}`` computed from whichever
-        representation each side has. Damping-semantics note: PARITY.md.
+      - both sides eigen (``QA``/``dA``/``QG``/``dG``, no baked
+        inverses): the reference eigen path with *joint* damping
+        ``1/(dG dA^T + λ)`` read at precondition time
+        (kfac/layers/base.py:459-470 — λ is the live scheduled value,
+        like the reference's);
+      - any baked inverse present: ``G_inv @ grad @ A_inv``
+        (kfac/layers/base.py:472-475). Mixed-method layers carry a
+        firing-time-baked dense inverse for their eigen side too
+        (:func:`eigen_side_inverse`, computed in the inverse update),
+        so BOTH sides of a split layer use the same firing-time λ —
+        the reference non-eigen timing semantics — and the per-step
+        eigen-side reconstruction cost is gone. Damping-semantics
+        note: PARITY.md.
 
     ``diag_a``: diagonal A inverse for embedding layers (elementwise,
     damping already baked) — then ``entry`` carries only the G side.
     """
     if diag_a is not None:
-        if 'QG' in entry:
-            v1 = grad.astype(jnp.float32) @ entry['QG']
-            v2 = v1 / (entry['dG'][None, :] + damping)
-            return diag_a[:, None] * (v2 @ entry['QG'].T)
-        return precondition_diag_a(grad, diag_a, entry['G_inv'])
-    a_eigen = 'QA' in entry
-    g_eigen = 'QG' in entry
-    if a_eigen and g_eigen:
+        if 'G_inv' in entry:
+            return precondition_diag_a(grad, diag_a, entry['G_inv'])
+        v1 = grad.astype(jnp.float32) @ entry['QG']
+        v2 = v1 / (entry['dG'][None, :] + damping)
+        return diag_a[:, None] * (v2 @ entry['QG'].T)
+    a_baked = 'A_inv' in entry
+    g_baked = 'G_inv' in entry
+    if not a_baked and not g_baked:
         return precondition_eigen(grad, entry['QA'], entry['QG'],
                                   entry['dA'], entry['dG'], damping)
-    if not a_eigen and not g_eigen:
-        return precondition_inv(grad, entry['A_inv'], entry['G_inv'])
-    grad = grad.astype(jnp.float32)
-    if a_eigen:
-        right = _eigen_side_inverse(entry['QA'], entry['dA'], damping)
-        return entry['G_inv'] @ grad @ right
-    left = _eigen_side_inverse(entry['QG'], entry['dG'], damping)
-    return left @ grad @ entry['A_inv']
+    return precondition_inv(grad, entry['A_inv'], entry['G_inv'])

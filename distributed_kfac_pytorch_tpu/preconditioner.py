@@ -221,10 +221,13 @@ class KFAC:
             raise ValueError("assignment_strategy must be 'compute' or "
                              "'memory'")
         if (capture_dtype == 'auto' and factor_compute_dtype is not None
-                and jnp.dtype(factor_compute_dtype) == jnp.float32):
-            # Strict-fp32 factor parity implies fp32 captures: a bf16
-            # capture would discard the precision the HIGHEST-precision
-            # covariance contraction exists to keep.
+                and jnp.dtype(factor_compute_dtype).itemsize
+                > jnp.dtype(jnp.bfloat16).itemsize):
+            # A strict high-precision factor request (fp32, fp64, ...)
+            # implies captures at least that wide: a bf16 capture would
+            # discard the precision the high-precision covariance
+            # contraction exists to keep (ADVICE r3: the old gate only
+            # matched fp32, leaking bf16 captures under fp64).
             capture_dtype = None
         self.capture = KFACCapture(model, skip_layers=skip_layers,
                                    capture_dtype=capture_dtype,
@@ -374,6 +377,11 @@ class KFAC:
             fdt = self.factor_dtype or jnp.float32
             idt = self.inv_dtype
             ma, mg = self._side_methods(spec, a_dim, g_dim)
+            # Mixed layers carry a firing-time-baked dense inverse for
+            # their eigen side too (zero-seeded; step 0 fires before
+            # first use) — see update_inverses.
+            mixed = (spec.kind != EMBEDDING
+                     and (ma == 'eigen') != (mg == 'eigen'))
             entry: dict[str, Any] = {}
             if spec.kind == EMBEDDING:
                 factors[name] = {'A': jnp.ones((a_dim,), fdt),
@@ -385,11 +393,15 @@ class KFAC:
                 if ma == 'eigen':
                     entry['QA'] = jnp.eye(a_dim, dtype=idt)
                     entry['dA'] = jnp.ones((a_dim,), idt)
+                    if mixed:
+                        entry['A_inv'] = jnp.zeros((a_dim, a_dim), idt)
                 else:
                     entry['A_inv'] = jnp.zeros((a_dim, a_dim), idt)
             if mg == 'eigen':
                 entry['QG'] = jnp.eye(g_dim, dtype=idt)
                 entry['dG'] = jnp.ones((g_dim,), idt)
+                if mixed:
+                    entry['G_inv'] = jnp.zeros((g_dim, g_dim), idt)
             else:
                 entry['G_inv'] = jnp.zeros((g_dim, g_dim), idt)
             inverses[name] = entry
@@ -518,6 +530,16 @@ class KFAC:
         new_inv = {}
         for name, spec in self.specs.items():
             ma, mg = sides[name]
+            # A dense layer with exactly one eigen side is *mixed*: its
+            # eigen side is additionally baked into a dense damped
+            # inverse at THIS firing's damping (linalg.
+            # eigen_side_inverse), so both sides of the split operator
+            # carry the same firing-time λ — the reference non-eigen
+            # timing semantics — and precondition does no per-step
+            # eigen-side reconstruction. Q/d stay stored for the next
+            # firing's warm start.
+            mixed = (spec.kind != EMBEDDING
+                     and (ma == 'eigen') != (mg == 'eigen'))
             entry: dict[str, Any] = {}
             if spec.kind == EMBEDDING:
                 entry['A_inv'] = linalg.get_elementwise_inverse(
@@ -527,12 +549,18 @@ class KFAC:
                 qa, da = eigs[f'{name}/A']
                 entry['QA'] = qa.astype(self.inv_dtype)
                 entry['dA'] = da.astype(self.inv_dtype)
+                if mixed:
+                    entry['A_inv'] = linalg.eigen_side_inverse(
+                        qa, da, damping).astype(self.inv_dtype)
             else:
                 entry['A_inv'] = invs[f'{name}/A'].astype(self.inv_dtype)
             if mg == 'eigen':
                 qg, dg = eigs[f'{name}/G']
                 entry['QG'] = qg.astype(self.inv_dtype)
                 entry['dG'] = dg.astype(self.inv_dtype)
+                if mixed:
+                    entry['G_inv'] = linalg.eigen_side_inverse(
+                        qg, dg, damping).astype(self.inv_dtype)
             else:
                 entry['G_inv'] = invs[f'{name}/G'].astype(self.inv_dtype)
             new_inv[name] = entry

@@ -26,6 +26,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
+from distributed_kfac_pytorch_tpu import fp16 as fp16_lib
 from distributed_kfac_pytorch_tpu import launch
 from distributed_kfac_pytorch_tpu.models import imagenet_resnet
 from distributed_kfac_pytorch_tpu.parallel import distributed as D
@@ -104,6 +105,14 @@ def parse_args(argv=None):
                    help='bf16 factor storage/averaging + bf16 covariance '
                         'matmul inputs (matmuls accumulate fp32); the '
                         'reference fp16 factor mode')
+    p.add_argument('--fp16', action='store_true',
+                   help='fp16 model compute with dynamic loss scaling + '
+                        'overflow-skip (GradScaler parity — the '
+                        "reference's production ImageNet recipe passes "
+                        '--fp16, launch_node_torch_imagenet.sh:73-87; '
+                        'engine.py:38-41,75-80). On TPU, bf16 is the '
+                        'native half mode and needs no scaler; --fp16 '
+                        'exists for exact reference-recipe parity.')
     return p.parse_args(argv)
 
 
@@ -154,7 +163,8 @@ def main(argv=None):
             (x.numpy(), y.numpy()) for x, y in
             val_ds.batch(vb, drop_remainder=True))
 
-    model = imagenet_resnet.get_model(args.model)
+    model = imagenet_resnet.get_model(
+        args.model, dtype=jnp.float16 if args.fp16 else jnp.float32)
     cfg = optimizers.OptimConfig(
         base_lr=args.base_lr, momentum=args.momentum,
         weight_decay=args.wd, warmup_epochs=args.warmup_epochs,
@@ -182,6 +192,12 @@ def main(argv=None):
         variables = model.init(jax.random.PRNGKey(args.seed), x0)
     params = variables['params']
     extra = {'batch_stats': variables['batch_stats']}
+    if args.fp16:
+        if kfac is None:
+            raise SystemExit('--fp16 requires the K-FAC step '
+                             '(--kfac-update-freq > 0); the SGD baseline '
+                             'path does not wire the loss scaler.')
+        extra['loss_scale'] = fp16_lib.init_loss_scale()
 
     mesh = D.make_kfac_mesh(
         comm_method=optimizers.COMM_METHODS[args.comm_method],
@@ -204,7 +220,8 @@ def main(argv=None):
         step_fn = dkfac.build_train_step(
             loss_fn, tx, metrics_fn=metrics_fn,
             mutable_cols=('batch_stats',),
-            grad_accum_steps=args.grad_accum)
+            grad_accum_steps=args.grad_accum,
+            loss_scale='dynamic' if args.fp16 else None)
     else:  # --kfac-update-freq 0: plain SGD (reference optimizers.py:28)
         dkfac, kstate = None, None
         step_fn = engine.build_sgd_train_step(

@@ -36,6 +36,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
 from jax.sharding import PartitionSpec as P
 
+from distributed_kfac_pytorch_tpu import fp16 as fp16_lib
 from distributed_kfac_pytorch_tpu import launch
 from distributed_kfac_pytorch_tpu.models import lstm_lm, transformer_lm
 from distributed_kfac_pytorch_tpu.parallel import distributed as D
@@ -116,20 +117,29 @@ def parse_args(argv=None):
                    help='bf16 factor storage/averaging + bf16 covariance '
                         'matmul inputs (matmuls accumulate fp32); the '
                         'reference fp16 factor mode')
+    p.add_argument('--fp16', action='store_true',
+                   help='fp16 model compute with dynamic loss scaling + '
+                        'overflow-skip (GradScaler parity, reference '
+                        'engine.py:38-41,75-80; the reference LM example '
+                        'lacks AMP — this completes the CLI surface). On '
+                        'TPU, bf16 is the native half mode and needs no '
+                        'scaler.')
     return p.parse_args(argv)
 
 
-def build_model(args, vocab_size, seq_axis=None):
+def build_model(args, vocab_size, seq_axis=None, dtype=None):
+    if dtype is None:
+        dtype = jnp.float16 if args.fp16 else None
     if args.arch == 'lstm':
         return lstm_lm.LSTMLanguageModel(
             vocab_size=vocab_size, embedding_dim=args.emsize,
             hidden_dim=args.nhid, num_layers=args.nlayers,
-            dropout=args.dropout, tie_weights=args.tied)
+            dropout=args.dropout, tie_weights=args.tied, dtype=dtype)
     return transformer_lm.TransformerLM(
         vocab_size=vocab_size, d_model=args.emsize,
         num_layers=args.nlayers, num_heads=args.nheads,
         max_len=max(args.bptt, 16), dropout=args.dropout,
-        tie_weights=args.tied, seq_axis=seq_axis)
+        tie_weights=args.tied, seq_axis=seq_axis, dtype=dtype)
 
 
 def main(argv=None):
@@ -219,7 +229,8 @@ def main(argv=None):
                  else P(D.KFAC_AXES))
     step_fn = dkfac.build_train_step(
         loss_fn, tx, model_kwargs_fn=model_kwargs_fn,
-        batch_spec=(data_spec, data_spec, P()))
+        batch_spec=(data_spec, data_spec, P()),
+        loss_scale='dynamic' if args.fp16 else None)
 
     def eval_loss(out, batch):
         return optax.softmax_cross_entropy_with_integer_labels(
@@ -231,7 +242,11 @@ def main(argv=None):
         metrics_fn=lambda o, b: {})
 
     state = engine.TrainState(params=params, opt_state=opt_state,
-                              kfac_state=kstate, extra_vars={})
+                              kfac_state=kstate,
+                              extra_vars=(
+                                  {'loss_scale':
+                                   fp16_lib.init_loss_scale()}
+                                  if args.fp16 else {}))
     mgr = ckpt_lib.CheckpointManager(args.checkpoint_dir)
     start_epoch = 0
     if not args.no_resume and mgr.latest_epoch() is not None:

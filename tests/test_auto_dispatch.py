@@ -59,11 +59,14 @@ def make_batch(n=32):
     return x, y
 
 
+# Mixed layers carry Q/d for the warm start PLUS a firing-time-baked
+# dense inverse for the eigen side (so both sides of the split operator
+# share one firing-time λ — the reference non-eigen timing semantics).
 EXPECTED_KEYS = {
     'l_ee': {'QA', 'dA', 'QG', 'dG'},
-    'l_ei': {'QA', 'dA', 'G_inv'},
+    'l_ei': {'QA', 'dA', 'A_inv', 'G_inv'},
     'l_ii': {'A_inv', 'G_inv'},
-    'l_ie': {'A_inv', 'QG', 'dG'},
+    'l_ie': {'A_inv', 'QG', 'dG', 'G_inv'},
 }
 
 
@@ -198,6 +201,63 @@ def test_spmd_parity_straddling_buckets(comm_method, frac):
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-2,
                                                 atol=1e-4),
         dstate['factors'], ref_state['factors'])
+
+
+def test_split_layers_use_firing_time_damping():
+    """Both sides of a split layer bake the FIRING-time λ; the joint
+    eigen layer reads the live λ at precondition time (the reference's
+    respective non-eigen / eigen timing semantics). Regression for the
+    round-4 review finding: under a damping schedule the two sides of a
+    mixed layer must not drift apart."""
+    model = StraddleMLP()
+    lam_fire, lam_now = 0.05, 0.002
+    kfac = KFAC(model, auto_eigen_max_dim=CUT, kl_clip=None,
+                factor_update_freq=1, inv_update_freq=1,
+                eigh_method='xla')
+    batch = make_batch()
+    variables, state = kfac.init(jax.random.PRNGKey(0), batch[0])
+    params = variables['params']
+    _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+        lambda out: loss_fn(out, batch), params, batch[0])
+    # Fire factors+inverses at lam_fire, then precondition a later
+    # non-firing step at lam_now.
+    _, fired = kfac.step(state, grads, captures, damping=lam_fire,
+                         factor_update=True, inv_update=True)
+    precond, _ = kfac.step(fired, grads, captures, damping=lam_now,
+                           factor_update=False, inv_update=False)
+
+    for short, lam_a, lam_g in (('l_ei', lam_fire, lam_fire),
+                                ('l_ie', lam_fire, lam_fire),
+                                ('l_ii', lam_fire, lam_fire)):
+        name = layer_key(kfac, short)
+        spec = kfac.specs[name]
+        grad_sub, out_sub = grads, precond
+        for p in spec.path:
+            grad_sub, out_sub = grad_sub[p], out_sub[p]
+        g_mat = np.asarray(L.grads_to_matrix(spec, grad_sub), np.float64)
+        v_mat = np.asarray(L.grads_to_matrix(spec, out_sub), np.float64)
+        a = np.asarray(fired['factors'][name]['A'], np.float64)
+        g = np.asarray(fired['factors'][name]['G'], np.float64)
+        want = (np.linalg.inv(g + lam_g * np.eye(len(g))) @ g_mat
+                @ np.linalg.inv(a + lam_a * np.eye(len(a))))
+        np.testing.assert_allclose(v_mat, want, rtol=1e-4, atol=1e-6)
+
+    # Joint eigen layer: live λ at precondition time (reference
+    # base.py:459-470 semantics).
+    name = layer_key(kfac, 'l_ee')
+    spec = kfac.specs[name]
+    grad_sub, out_sub = grads, precond
+    for p in spec.path:
+        grad_sub, out_sub = grad_sub[p], out_sub[p]
+    g_mat = np.asarray(L.grads_to_matrix(spec, grad_sub), np.float64)
+    v_mat = np.asarray(L.grads_to_matrix(spec, out_sub), np.float64)
+    a = np.asarray(fired['factors'][name]['A'], np.float64)
+    g = np.asarray(fired['factors'][name]['G'], np.float64)
+    da_, qa = np.linalg.eigh(a)
+    dg_, qg = np.linalg.eigh(g)
+    v1 = qg.T @ g_mat @ qa
+    want = qg @ (v1 / (dg_[:, None] * da_[None, :] + lam_now)) @ qa.T
+    np.testing.assert_allclose(v_mat, want, rtol=1e-4, atol=1e-6)
 
 
 def test_checkpoint_layout_mismatch_recomputes():

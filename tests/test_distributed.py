@@ -333,3 +333,55 @@ def test_grad_accumulation_threads_batch_stats():
     changed = jax.tree.leaves(jax.tree.map(
         lambda a, b: jnp.any(a != b), before, extra['batch_stats']))
     assert any(bool(c) for c in changed)
+
+
+@pytest.mark.parametrize('comm_method,frac', [
+    (CommMethod.MEM_OPT, 0.0),
+    (CommMethod.HYBRID_OPT, 0.5),
+])
+def test_rowsharded_precond_matches_masked(comm_method, frac):
+    """KAISA grad-worker compute sharding == replicate-and-mask.
+
+    ``shard_precond_compute=True`` (default) computes each row's own
+    layers only (stacked dynamic-slice, reference
+    preconditioner.py:577-585 semantics); False is the replicate-and-
+    mask oracle. Same model, same steps — parameters and K-FAC factors
+    must agree to fp tolerance (the matmuls are reassociated across a
+    vmap, so not bit-equal).
+    """
+    model = SmallCNN()
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 8, 8, 3))
+    y = jax.random.randint(jax.random.PRNGKey(2), (16,), 0, 10)
+
+    results = []
+    for sharded in (True, False):
+        kfac = KFAC(model, factor_update_freq=1, inv_update_freq=2,
+                    damping=0.003, lr=0.1, eigh_method='xla')
+        variables, _ = kfac.init(jax.random.PRNGKey(0), x)
+        params = variables['params']
+        mesh = D.make_kfac_mesh(comm_method=comm_method,
+                                grad_worker_fraction=frac)
+        dkfac = D.DistributedKFAC(kfac, mesh, params,
+                                  shard_precond_compute=sharded)
+        assert dkfac.shard_precond_compute == sharded
+        dstate = dkfac.init_state(params)
+        tx = optax.sgd(0.1)
+        opt_state = tx.init(params)
+        step = dkfac.build_train_step(loss_fn, tx, donate=False)
+        hyper = {'lr': 0.1, 'damping': 0.003}
+        dparams, extra = jax.tree.map(jnp.asarray, params), {}
+        for _ in range(3):
+            dparams, opt_state, dstate, extra, metrics = step(
+                dparams, opt_state, dstate, extra, (x, y), hyper)
+        results.append((dparams, dstate, metrics))
+
+    (p_sh, s_sh, m_sh), (p_ms, s_ms, m_ms) = results
+    np.testing.assert_allclose(m_sh['loss'], m_ms['loss'], rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4,
+                                                atol=1e-6),
+        p_sh, p_ms)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4,
+                                                atol=1e-6),
+        s_sh['factors'], s_ms['factors'])

@@ -271,11 +271,13 @@ class TestConvPatchImplDispatch:
     values are rejected loudly instead of silently hitting a legacy
     path."""
 
-    @pytest.mark.parametrize('impl', ['slices', 'crosscov', 'dilated'])
+    @pytest.mark.parametrize('impl', ['slices', 'crosscov', 'dilated',
+                                      'pairs'])
     @pytest.mark.parametrize('cfg', [
         dict(h=8, w=8, c=3, k=(3, 3), s=(1, 1), pad='SAME', bias=True),
         dict(h=9, w=7, c=2, k=(3, 3), s=(2, 2), pad='VALID', bias=False),
-    ], ids=['same', 'valid-stride2'])
+        dict(h=16, w=16, c=3, k=(7, 7), s=(2, 2), pad='SAME', bias=True),
+    ], ids=['same', 'valid-stride2', 'stem-7x7-s2'])
     def test_impls_agree(self, impl, cfg, monkeypatch):
         rng = np.random.default_rng(2)
         x = jnp.asarray(rng.normal(size=(4, cfg['h'], cfg['w'],
