@@ -126,7 +126,7 @@ def main(argv=None):
                                (rows, d)).astype(jnp.bfloat16)
         base_p = null_leg(p0, args.inner)
         ms_cov = max(cov_leg(p0, args.inner) - base_p, 0.0)
-        ms_full = max(full_leg(x0, args.inner, kernel) - base, 0.0)
+        ms_full = max(full_leg(x0, args.inner, kernel) - base, 1e-6)
         # Materialization roofline at the ACHIEVED copy bandwidth:
         # patch write (extract) + patch read (cov operand) + input read.
         mat_mb = 2 * patch_mb + input_mb

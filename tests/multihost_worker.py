@@ -112,13 +112,8 @@ def run_comm_bench(iters: int = 10, size: int = 256) -> dict:
     orientations is the recorded evidence (same caveat class as
     bench_matrix config 3).
     """
-    import time
-
     import jax
     import jax.numpy as jnp
-    import numpy as np
-    from jax.sharding import Mesh
-    from jax.sharding import PartitionSpec as P
 
     from distributed_kfac_pytorch_tpu.parallel.distributed import (
         GRAD_WORKER_AXIS,
@@ -126,33 +121,52 @@ def run_comm_bench(iters: int = 10, size: int = 256) -> dict:
         KFAC_AXES,
     )
 
+    n = len(jax.devices())
+    x = jnp.ones((size, size), jnp.float32)
+    cases = {
+        'allreduce_world': (x, lambda v: jax.lax.psum(v, KFAC_AXES) / n),
+        'gather_gw_axis': (x, lambda v: jax.lax.all_gather(
+            v, GRAD_WORKER_AXIS, tiled=True)),
+        'psum_ig_axis': (x, lambda v: jax.lax.psum(v, INV_GROUP_AXIS)),
+    }
+    return _time_grouped_collectives(cases, iters)
+
+
+def _time_grouped_collectives(cases, iters):
+    """Time {name: (tensor, op)} under both KAISA mesh orientations.
+
+    Single home for the layout construction (the process-boundary
+    invariant both comm benches rest on): rows = inverse groups, cols =
+    grad workers (Mesh axes order KFAC_AXES = (ig, gw)). Both layouts
+    are (n/2, 2) — identical group sizes — so the recorded
+    intra-vs-cross ratio isolates the fabric boundary, not collective
+    size: 'intra' pairs grad workers within one process (C-order
+    reshape keeps process-contiguous device pairs), 'cross' pairs
+    device i of process 0 with device i of process 1.
+    """
+    import time
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_kfac_pytorch_tpu.parallel.distributed import (
+        KFAC_AXES,
+    )
+
     devs = jax.devices()
-    n = len(devs)
-    half = n // 2
+    half = len(devs) // 2
     layouts = {
-        # rows = inverse groups, cols = grad workers (Mesh axes order
-        # KFAC_AXES = (ig, gw)). Both layouts are (n/2, 2) — identical
-        # group sizes — so the recorded intra-vs-cross ratio isolates
-        # the fabric boundary, not collective size: 'intra' pairs grad
-        # workers within one process (C-order reshape keeps process-
-        # contiguous device pairs), 'cross' pairs device i of process 0
-        # with device i of process 1.
         'gw_intra_process': np.asarray(devs).reshape(half, 2),
         'gw_cross_process': np.stack([np.asarray(devs[:half]),
                                       np.asarray(devs[half:])], axis=1),
     }
     out = {}
-    x = jnp.ones((size, size), jnp.float32)
     for name, arr in layouts.items():
         mesh = Mesh(arr, KFAC_AXES)
-        ops = {
-            'allreduce_world': lambda v: jax.lax.psum(v, KFAC_AXES) / n,
-            'gather_gw_axis': lambda v: jax.lax.all_gather(
-                v, GRAD_WORKER_AXIS, tiled=True),
-            'psum_ig_axis': lambda v: jax.lax.psum(v, INV_GROUP_AXIS),
-        }
         out[name] = {}
-        for op_name, op in ops.items():
+        for op_name, (x, op) in cases.items():
             fn = jax.jit(jax.shard_map(op, mesh=mesh, in_specs=P(),
                                        out_specs=P(), check_vma=False))
             jax.block_until_ready(fn(x))  # compile + warm
@@ -162,6 +176,45 @@ def run_comm_bench(iters: int = 10, size: int = 256) -> dict:
             out[name][op_name] = round(
                 (time.perf_counter() - t0) / iters * 1000.0, 3)
     return out
+
+
+def run_comm_bench_flagship(iters: int = 3) -> dict:
+    """Grouped-collective timings at FLAGSHIP factor dims (round 4;
+    VERDICT r3 stretch #9): the actual per-phase collectives the K-FAC
+    pipeline issues for a ResNet-50-class factor set, with the
+    grad-worker axis laid out within vs across the process boundary.
+
+    Tensor set (fp32): the flagship's largest A factor (4609^2, 85 MB),
+    a mid-size bucket stack (4 x 1153^2, the unit the inverse
+    all_gather moves), and a stage-4 gradient matrix (2048 x 2049, what
+    the precondition psum delivers). Absolute numbers are CPU/gloo; the
+    intra-vs-cross *ratio* is the recorded ICI-vs-DCN tradeoff shape
+    ("replicated eigh may beat comm; measure before committing",
+    SURVEY §7).
+    """
+    import jax.numpy as jnp
+
+    from distributed_kfac_pytorch_tpu.parallel.distributed import (
+        GRAD_WORKER_AXIS,
+        INV_GROUP_AXIS,
+        KFAC_AXES,
+    )
+
+    import jax
+
+    cases = {
+        'factor_pmean_4609sq': (
+            jnp.ones((4609, 4609), jnp.float32),
+            lambda v: jax.lax.pmean(v, KFAC_AXES)),
+        'inv_gather_gw_4x1153sq': (
+            jnp.ones((4, 1153, 1153), jnp.float32),
+            lambda v: jax.lax.all_gather(v, GRAD_WORKER_AXIS,
+                                         tiled=True)),
+        'grad_psum_ig_2048x2049': (
+            jnp.ones((2048, 2049), jnp.float32),
+            lambda v: jax.lax.psum(v, INV_GROUP_AXIS)),
+    }
+    return _time_grouped_collectives(cases, iters)
 
 
 def main():
@@ -174,8 +227,9 @@ def main():
         num_processes=int(nproc), process_id=int(pid))
     assert info['process_count'] == int(nproc), info
     assert info['global_devices'] == 4 * int(nproc), info
-    if mode == 'comm':
-        result = run_comm_bench()
+    if mode in ('comm', 'comm_flagship'):
+        result = (run_comm_bench_flagship() if mode == 'comm_flagship'
+                  else run_comm_bench())
         if info['process_index'] == 0:
             import json
             with open(out_path, 'w') as f:
