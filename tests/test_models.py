@@ -79,3 +79,30 @@ def test_skip_layers_prunes():
     precond.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)
     assert all(s.kind == 'conv2d' for s in precond.specs.values())
     assert len(precond.specs) == 19
+
+
+def test_cifar_groupnorm_variant():
+    """'gn'-suffixed names swap BatchNorm for GroupNorm: no batch_stats
+    collection (stateless normalization — the convergence study's BN
+    control), same parameter shapes for every conv/dense layer."""
+    bn = cifar_resnet.get_model('resnet20')
+    gn = cifar_resnet.get_model('resnet20gn')
+    x = jnp.ones((2, 32, 32, 3))
+    v_bn = bn.init(jax.random.key(0), x)
+    v_gn = gn.init(jax.random.key(0), x)
+    assert 'batch_stats' in v_bn
+    assert 'batch_stats' not in v_gn
+    # Same weight-bearing structure AND shapes for the K-FAC-visible
+    # layers (conv kernels + the Dense head).
+    def weight_shapes(params):
+        return {str(p): leaf.shape
+                for p, leaf in jax.tree_util.tree_flatten_with_path(
+                    params)[0]
+                if 'conv' in str(p) or 'linear' in str(p)}
+    shapes_b = weight_shapes(v_bn['params'])
+    shapes_g = weight_shapes(v_gn['params'])
+    assert shapes_b, 'conv/linear filter matched nothing'
+    assert shapes_b == shapes_g
+    out = gn.apply(v_gn, x, train=True)
+    assert out.shape == (2, 10)
+    assert bool(jnp.isfinite(out).all())
