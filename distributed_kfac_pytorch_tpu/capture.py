@@ -85,10 +85,6 @@ def _conv_decline_reason(mod: nn.Conv) -> str | None:
     it refuses, :31-33, where silence here would hide a partially
     preconditioned model).
     """
-    if type(mod) is not nn.Conv:
-        return (f'nn.Conv subclass {type(mod).__name__} (capture only '
-                'matches exact nn.Conv; its call/patch semantics may '
-                'differ)')
     if mod.feature_group_count != 1:
         return (f'grouped/depthwise conv (feature_group_count='
                 f'{mod.feature_group_count})')
@@ -102,21 +98,53 @@ def _conv_decline_reason(mod: nn.Conv) -> str | None:
     return None
 
 
+def _decline_reason(mod: nn.Module) -> str | None:
+    """Why a capturable-family module is NOT preconditioned, or None.
+
+    One policy for every registered family (round 4 — the round-3
+    review found Conv declined subclasses loudly while a Dense subclass
+    with overridden call semantics was silently captured as plain
+    Dense, so its factor math could mis-model it): the exact type and
+    flax's own lifted-transform wrappers (nn.remat / nn.scan — base
+    call semantics, wrapped execution) are accepted; any USER subclass
+    is declined loudly, plus the conv-configuration checks. A user
+    subclass that genuinely behaves like its base can be registered by
+    converting it to composition over the exact type.
+    """
+    for base in (nn.Dense, nn.Conv, nn.Embed):
+        if isinstance(mod, base) and type(mod) is not base:
+            # flax's lifted transforms (nn.remat / nn.scan / ...)
+            # generate subclasses in flax.linen.transforms whose call
+            # SEMANTICS are the base's (only execution is wrapped) —
+            # capture them like the base; decline user subclasses.
+            if type(mod).__module__.startswith('flax.linen.'):
+                break
+            return (f'{base.__name__} subclass {type(mod).__name__} '
+                    f'(capture only matches exact {base.__name__}; its '
+                    'call semantics may differ from the factor math)')
+    if isinstance(mod, nn.Conv):
+        return _conv_decline_reason(mod)
+    return None
+
+
 def _spec_for_module(mod: nn.Module, path: tuple[str, ...],
                      num_calls: int) -> LayerSpec | None:
     """Build a LayerSpec for a supported flax module, else None.
 
     Mirrors the registry dispatch in reference kfac/layers/__init__.py:13-36
     (module type -> KFACLayer class), with unsupported configurations
-    (grouped/dilated convs) skipped rather than mis-modelled (declines are
-    recorded and reported — see KFACCapture.skipped_modules).
+    (grouped/dilated convs, subclasses of the registered families)
+    skipped rather than mis-modelled (declines are recorded and
+    reported — see KFACCapture.skipped_modules).
     """
+    if _decline_reason(mod) is not None:
+        return None
+    # isinstance AFTER the decline gate: what reaches here is the exact
+    # type or a flax lifted-transform wrapper (accepted above).
     if isinstance(mod, nn.Dense):
         return LayerSpec(path=path, kind=LINEAR, has_bias=mod.use_bias,
                          num_calls=num_calls)
     if isinstance(mod, nn.Conv):
-        if _conv_decline_reason(mod) is not None:
-            return None
         strides = mod.strides
         if strides is None:
             strides = (1, 1)
@@ -231,11 +259,10 @@ class KFACCapture:
                         'frozen (trainable predicate): plain gradients, '
                         'no factor work')
                 return next_fun(*args, **kwargs)
-            if _spec_for_module(mod, path, 1) is None:
-                if record_specs and isinstance(mod, nn.Conv):
-                    reason = _conv_decline_reason(mod)
-                    if reason:
-                        self._skipped['/'.join(path)] = reason
+            reason = _decline_reason(mod)
+            if reason or _spec_for_module(mod, path, 1) is None:
+                if record_specs and reason:
+                    self._skipped['/'.join(path)] = reason
                 return next_fun(*args, **kwargs)
             # Dense/Conv/Embed all name their input 'inputs'; support both
             # positional and keyword call styles.
@@ -281,7 +308,7 @@ class KFACCapture:
         variables.pop(CAPTURE_COL, None)
         self._record_unregistered_params(variables.get('params', {}))
         declined = {n: r for n, r in self._skipped.items()
-                    if 'conv' in r.lower()}
+                    if 'conv' in r.lower() or 'subclass' in r}
         if declined:
             # The reference hard-errors on module kinds it refuses
             # (kfac/layers/__init__.py:31-33); silence here would hide a
@@ -290,7 +317,7 @@ class KFACCapture:
             import warnings
             lines = ', '.join(f'{n} ({r})' for n, r in declined.items())
             warnings.warn(
-                f'K-FAC cannot precondition {len(declined)} conv '
+                f'K-FAC cannot precondition {len(declined)} '
                 f'module(s); their params get plain gradients: {lines}. '
                 'See KFACCapture.skipped_modules for the full report.')
         return variables, dict(self._specs)

@@ -89,16 +89,22 @@ class ImageNetResNet(nn.Module):
     bottleneck: bool = True
     num_classes: int = 1000
     dtype: jnp.dtype = jnp.float32
+    # Stem width; stage s uses width * 2^(s-1) planes. 64 is the paper
+    # network. Narrow widths (e.g. 8) keep the exact 54-layer flagship
+    # topology — bottlenecks, strided shortcut convs, depth — at
+    # single-core-compilable program sizes (tests/test_flagship.py's
+    # narrow variant).
+    width: int = 64
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        y = nn.Conv(64, (7, 7), (2, 2), padding=3, use_bias=False,
+        y = nn.Conv(self.width, (7, 7), (2, 2), padding=3, use_bias=False,
                     dtype=self.dtype, kernel_init=_KAIMING, name='conv1')(x)
         y = nn.relu(_bn(train, self.dtype, 'bn1')(y))
         y = nn.max_pool(y, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         block = Bottleneck if self.bottleneck else BasicBlockV1
         for stage, n_blocks in enumerate(self.stage_sizes, start=1):
-            planes = 64 * 2 ** (stage - 1)
+            planes = self.width * 2 ** (stage - 1)
             for i in range(n_blocks):
                 stride = 2 if (stage > 1 and i == 0) else 1
                 y = block(planes, stride, dtype=self.dtype,

