@@ -82,7 +82,8 @@ def _setup(model_name, batch, image, model_dtype=None, **kfac_kw):
 
 def phase_step_leg(model_name, batch, image, mode, n_iters,
                    model_dtype=None, **kfac_kw):
-    """sgd | precond | factors | inv: scanned train-step variants."""
+    """sgd | capture | precond | factors | inv: scanned train-step
+    variants ('capture' = interception-only, no K-FAC math)."""
     (jax, jnp, optax, B, model, kfac, variables, kstate, x, y) = _setup(
         model_name, batch, image, model_dtype=model_dtype, **kfac_kw)
     params = variables['params']
@@ -106,6 +107,26 @@ def phase_step_leg(model_name, batch, image, mode, n_iters,
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return (params, opt_state, {**extra, **updated}), l
+        carry0 = (params, opt_state, extra)
+    elif mode == 'capture':
+        # Interception-only leg: fwd/bwd through KFACCapture (sows +
+        # probes) with the SGD update — isolates the capture machinery
+        # from the K-FAC math (the every-iter breakdown's middle term).
+        def body(carry, _):
+            params, opt_state, extra = carry
+            l, _, grads, captures, updated = kfac.capture.loss_and_grads(
+                loss, params, x, extra_vars=extra,
+                mutable_cols=('batch_stats',))
+            # Consume every capture — every call of every layer — so
+            # none is dead-code-eliminated (weight-shared models have
+            # multiple calls per layer).
+            probe = sum(t.reshape(-1)[0].astype(jnp.float32)
+                        for c in captures.values()
+                        for which in ('a', 'g')
+                        for t in c[which])
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state, {**extra, **updated}), l + probe * 0
         carry0 = (params, opt_state, extra)
     else:
         flags = {'precond': (False, False),

@@ -52,6 +52,10 @@ class OptimConfig:
     # per-dim 'auto' dispatch (eigen below KFAC.auto_eigen_max_dim,
     # cholesky above — fast at every factor scale).
     inverse_method: str | None = None
+    # 'auto' dispatch knobs (KFAC defaults: 640 / 'cholesky' — the
+    # measured v5e crossover; see PERF.md round 4).
+    auto_eigen_max_dim: int = 640
+    auto_large_method: str = 'cholesky'
     # 'auto' (default): warm-start basis polish seeded from the state's
     # previous eigenbasis (the TPU fast path — see ops.linalg.eigh_polish);
     # 'xla' | 'jacobi' | 'warm' as in KFAC.
@@ -145,6 +149,8 @@ def get_optimizer(model, cfg: OptimConfig):
             lr=cfg.base_lr,
             use_eigen_decomp=cfg.use_eigen_decomp,
             inverse_method=cfg.inverse_method,
+            auto_eigen_max_dim=cfg.auto_eigen_max_dim,
+            auto_large_method=cfg.auto_large_method,
             eigh_method=cfg.eigh_method,
             eigh_polish_iters=cfg.eigh_polish_iters,
             factor_dtype=jnp.bfloat16 if cfg.bf16_factors else None,
