@@ -138,7 +138,12 @@ def config1_cifar_methods(args):
     for label, kw in (('eigen', {}),
                       ('eigen-xla', {'eigh_method': 'xla'}),
                       ('cholesky', {'inverse_method': 'cholesky'}),
-                      ('newton', {'inverse_method': 'newton'})):
+                      ('newton', {'inverse_method': 'newton'}),
+                      # Opt-in within-step factor thinning (the factor
+                      # phase is the dominant K-FAC overhead at CIFAR
+                      # scale and is HBM-bound in the batch dim —
+                      # PERF.md roofline). Default stays 1.0 (parity).
+                      ('frac0.25', {'factor_batch_fraction': 0.25})):
         bodies, carry, floor = build_cnn_bodies(model, x, y, kw,
                                                 inv_freq=10, floor=floor)
         run = scan_block_runner(bodies, carry, 10, n)

@@ -74,6 +74,7 @@ def run_one(use_kfac: bool, args, data):
         kfac_cov_update_freq=1, damping=args.damping,
         kl_clip=0.001, eigh_method=args.eigh_method,
         eigh_polish_iters=args.eigh_polish_iters,
+        factor_batch_fraction=args.factor_batch_fraction,
         damping_alpha=args.damping_alpha,
         damping_schedule=args.damping_decay,
         kfac_update_freq_alpha=args.kfac_freq_alpha,
@@ -243,6 +244,10 @@ def main(argv=None):
     p.add_argument('--kfac-freq-decay', type=int, nargs='+', default=[])
     p.add_argument('--eigh-method', default='auto')
     p.add_argument('--eigh-polish-iters', type=int, default=8)
+    p.add_argument('--factor-batch-fraction', type=float, default=1.0,
+                   help='thin the factor statistics to this fraction of '
+                        'the batch (convergence A/B for the opt-in '
+                        'factor_batch_fraction knob)')
     p.add_argument('--label-noise', type=float, default=0.0,
                    help='fraction of train labels flipped (fixed seed): '
                         'makes the synthetic task non-separable so the '

@@ -23,11 +23,13 @@ per call, the analogue of the reference's ``accumulate_data`` path
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Callable, Sequence
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 CAPTURE_COL = 'kfac_in'
 PROBE_COL = 'kfac_probes'
@@ -407,7 +409,8 @@ class KFACCapture:
 
     def loss_and_grads(self, loss_fn: Callable, params, *args,
                        probes=None, extra_vars=None, mutable_cols=(),
-                       has_aux=False, loss_scale=None, **kwargs):
+                       has_aux=False, loss_scale=None, intercept=True,
+                       **kwargs):
         """One backward pass yielding param grads AND per-layer captures.
 
         ``loss_fn`` receives the model output only — close over labels and
@@ -425,11 +428,42 @@ class KFACCapture:
         (e.g. ``{'batch_stats': ...}``); collections listed in
         ``mutable_cols`` are updated during the pass and returned.
 
+        ``intercept=False`` skips the capture machinery entirely — a plain
+        ``value_and_grad`` over ``model.apply``, returning ``captures={}``.
+        This is the static-cadence fast path for non-factor-update steps:
+        the reference's hooks are gated off exactly the same way on those
+        steps (``_periodic_hook``, kfac/preconditioner.py:684-699), and
+        measurement shows XLA does NOT dead-code-eliminate the probe/sow
+        machinery when captures go unused (+2.7 ms/iter on ResNet-50
+        @224px b64 — PERF.md round 4).
+
         Returns ``(loss, aux, grads, captures, updated_vars)`` where
         ``captures`` maps layer name -> {'a': (per-call activations...),
         'g': (per-call output grads...)} and ``updated_vars`` holds the
         new values of ``mutable_cols`` ({} if none).
         """
+        if not intercept:
+            extra = extra_vars or {}
+
+            def plain(params):
+                out, state = self.model.apply(
+                    {'params': params, **extra}, *args,
+                    mutable=list(mutable_cols), **kwargs)
+                res = loss_fn(out)
+                loss, aux = res if has_aux else (res, None)
+                if loss_scale is not None:
+                    loss = loss * loss_scale
+                updated = {c: state[c] for c in mutable_cols if c in state}
+                return loss, (aux, updated)
+
+            (loss, (aux, updated)), grads = jax.value_and_grad(
+                plain, has_aux=True)(params)
+            if loss_scale is not None:
+                inv = 1.0 / loss_scale
+                loss = loss * inv
+                grads = jax.tree.map(lambda g: g * inv, grads)
+            return loss, aux, grads, {}, updated
+
         if probes is None:
             probes = self.zero_probes(params, *args, extra_vars=extra_vars,
                                       mutable_cols=mutable_cols, **kwargs)
@@ -482,3 +516,44 @@ def _get_path(tree, path: tuple[str, ...]):
     for part in path:
         node = node[part]
     return node
+
+
+def subsample_captures(captures: dict, fraction: float) -> dict:
+    """Keep ``ceil(B * fraction)`` evenly-strided batch rows per capture.
+
+    Within-step thinning of the factor statistics: every covariance in
+    this package normalizes by its own row count (ops.factors.get_cov),
+    so a leading-dim subsample estimates the same expectations — the
+    same statistical axis as the reference's production cadence
+    (factors from one batch in 50, launch_node_torch_imagenet.sh:73-87),
+    applied within the batch instead of across steps. Rows are taken
+    *strided* across the whole batch (not a head slice) so pipelines
+    that order rows within a batch (class-grouped samplers,
+    length-bucketed LM batches) still contribute across the batch; the
+    estimator is unbiased when batch composition doesn't correlate with
+    position, which strided sampling preserves far more robustly than a
+    prefix. The factor phase's cost (patch materialization + covariance
+    contraction) scales with the kept rows. Slices are static (shapes
+    are Python ints under jit).
+
+    Not applied to gradients or preconditioning — only the A/G factor
+    statistics see the subset.
+    """
+    if fraction >= 1.0:
+        return captures
+
+    def keep(t):
+        b = t.shape[0]
+        k = max(1, int(math.ceil(b * fraction)))
+        if k >= b:
+            return t
+        # Evenly spread positions (i * b) // k cover the whole batch at
+        # every fraction; a `[::b//k][:k]` stride degenerates to a head
+        # slice whenever b // k == 1 (any fraction > 0.5) and always
+        # orphans the tail when b % k != 0. Static numpy index -> one
+        # constant gather under jit.
+        return t[np.arange(k) * b // k]
+
+    return {name: {'a': tuple(keep(t) for t in c['a']),
+                   'g': tuple(keep(t) for t in c['g'])}
+            for name, c in captures.items()}

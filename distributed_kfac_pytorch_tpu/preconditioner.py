@@ -40,7 +40,8 @@ import jax
 import jax.numpy as jnp
 
 from distributed_kfac_pytorch_tpu import layers as L
-from distributed_kfac_pytorch_tpu.capture import EMBEDDING, KFACCapture
+from distributed_kfac_pytorch_tpu.capture import (EMBEDDING, KFACCapture,
+                                                  subsample_captures)
 from distributed_kfac_pytorch_tpu.ops import factors as F
 from distributed_kfac_pytorch_tpu.ops import linalg
 from distributed_kfac_pytorch_tpu.ops import pallas_kernels
@@ -156,6 +157,20 @@ class KFAC:
         analogue of the reference's fp16 factor mode (``--fp16``,
         launch_node_torch_imagenet.sh:73-87) with better accumulation.
         See ops.factors.get_cov for the measured numbers.
+      factor_batch_fraction: fraction of the (per-device) batch used for
+        the A/G covariance statistics (default 1.0 = reference parity:
+        the whole batch). Values < 1 keep ``ceil(B * f)`` evenly-strided
+        rows of every capture before the factor contraction — an
+        estimator of the same expectations (every covariance here
+        normalizes by its own row count; strided, not a head slice, so
+        ordered batches still contribute across the batch), thinning
+        *within* the batch exactly as the reference's production cadence
+        thins
+        *across* steps (factors from one batch in 50,
+        launch_node_torch_imagenet.sh:73-87). The factor phase's cost
+        (patch materialization + contraction, the dominant K-FAC
+        overhead at CIFAR scale — PERF.md roofline) scales with f.
+        Gradients and preconditioning always see the full batch.
       capture_dtype: dtype for captured activations ('a'). Default
         'auto' = bf16 on TPU (what the covariance matmul keeps anyway;
         halves capture + im2col patch traffic — see KFACCapture), fp32
@@ -201,6 +216,7 @@ class KFAC:
                  newton_iters: int = 100,
                  factor_dtype: Any = None,
                  factor_compute_dtype: Any = None,
+                 factor_batch_fraction: float = 1.0,
                  capture_dtype: Any = 'auto',
                  inv_dtype: Any = jnp.float32,
                  skip_layers: str | Sequence[str] | None = None,
@@ -270,6 +286,10 @@ class KFAC:
         self.eigh_method = eigh_method
         self.eigh_polish_iters = eigh_polish_iters
         self.newton_iters = newton_iters
+        if not 0.0 < factor_batch_fraction <= 1.0:
+            raise ValueError(
+                f'{factor_batch_fraction=} must be in (0, 1]')
+        self.factor_batch_fraction = factor_batch_fraction
         self.factor_dtype = factor_dtype
         self.factor_compute_dtype = factor_compute_dtype
         self.inv_dtype = inv_dtype
@@ -287,7 +307,7 @@ class KFAC:
                   'inv_update_freq', 'kl_clip', 'lr', 'inverse_method',
                   'auto_eigen_max_dim', 'auto_large_method',
                   'eigh_method', 'eigh_polish_iters', 'newton_iters',
-                  'factor_dtype',
+                  'factor_batch_fraction', 'factor_dtype',
                   'factor_compute_dtype', 'inv_dtype', 'symmetry_aware_comm',
                   'assignment_strategy', 'comm_method',
                   'grad_worker_fraction')
@@ -428,6 +448,7 @@ class KFAC:
         """
         alpha = self.factor_decay if factor_decay is None else factor_decay
         cdt = self.factor_compute_dtype
+        captures = subsample_captures(captures, self.factor_batch_fraction)
         new_factors = {}
         for name, spec in self.specs.items():
             a_new = L.compute_a_factor(spec, captures[name]['a'],

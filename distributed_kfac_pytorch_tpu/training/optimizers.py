@@ -61,6 +61,10 @@ class OptimConfig:
     # 'xla' | 'jacobi' | 'warm' as in KFAC.
     eigh_method: str = 'auto'
     eigh_polish_iters: int = 8
+    # Fraction of the batch used for factor statistics (1.0 = reference
+    # parity; < 1 thins the covariance sample within the step — see
+    # KFAC.factor_batch_fraction).
+    factor_batch_fraction: float = 1.0
     # bf16 factor storage/averaging AND bf16 covariance-matmul inputs
     # (the matmuls accumulate fp32; the EWMA running averages are kept in
     # bf16) — the reference's --fp16 factor mode. For bf16 matmuls with
@@ -153,6 +157,7 @@ def get_optimizer(model, cfg: OptimConfig):
             auto_large_method=cfg.auto_large_method,
             eigh_method=cfg.eigh_method,
             eigh_polish_iters=cfg.eigh_polish_iters,
+            factor_batch_fraction=cfg.factor_batch_fraction,
             factor_dtype=jnp.bfloat16 if cfg.bf16_factors else None,
             factor_compute_dtype=(jnp.bfloat16 if cfg.bf16_factors
                                   else None),

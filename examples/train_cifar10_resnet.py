@@ -77,6 +77,10 @@ def parse_args(argv=None):
                    help='eigen-path decomposition backend; auto = '
                         'warm-start matmul-only basis polish (TPU '
                         'fast path)')
+    p.add_argument('--factor-batch-fraction', type=float, default=1.0,
+                   help='fraction of the batch used for factor '
+                        'statistics (1.0 = reference parity; <1 thins '
+                        'the covariance sample within the step)')
     p.add_argument('--eigh-polish-iters', type=int, default=8,
                    help='warm-polish iterations per eigh firing (8: ~1e-3 '
                         'tracking, the measured-equivalent fast default; 16: '
@@ -141,6 +145,7 @@ def main(argv=None):
         use_eigen_decomp=False if args.use_inv_kfac else None,
         eigh_method=args.eigh_method,
         eigh_polish_iters=args.eigh_polish_iters,
+        factor_batch_fraction=args.factor_batch_fraction,
         skip_layers=args.skip_layers, comm_method=args.comm_method,
         grad_worker_fraction=args.grad_worker_fraction,
         symmetry_aware_comm=args.symmetry_aware_comm,

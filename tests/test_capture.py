@@ -110,6 +110,59 @@ def test_param_grads_unchanged_by_probes():
         grads, plain)
 
 
+def test_intercept_false_matches_plain_autodiff():
+    """intercept=False (the static-cadence non-factor-step fast path)
+    must return identical loss/grads with empty captures — same
+    semantics as the reference gating its hooks off on non-factor steps
+    (_periodic_hook, kfac/preconditioner.py:684-699)."""
+    cap = KFACCapture(MLP())
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 6))
+    variables, _ = cap.init(jax.random.PRNGKey(0), x)
+    params = variables['params']
+    loss_fn = lambda out: jnp.mean(out ** 2)
+    loss_i, _, grads_i, caps_i, _ = cap.loss_and_grads(loss_fn, params, x)
+    loss_p, _, grads_p, caps_p, _ = cap.loss_and_grads(
+        loss_fn, params, x, intercept=False)
+    assert caps_p == {}
+    assert caps_i  # the capturing path really captured
+    np.testing.assert_allclose(loss_p, loss_i, rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+        grads_p, grads_i)
+
+
+def test_intercept_false_mutable_collections_and_loss_scale():
+    """The plain path must still thread mutable collections (BN stats)
+    and apply the loss-scale unscaling identically."""
+    class BNNet(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(8, name='d1')(x)
+            x = nn.BatchNorm(use_running_average=False, name='bn')(x)
+            return nn.Dense(3, name='d2')(x)
+
+    cap = KFACCapture(BNNet())
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 6))
+    variables, _ = cap.init(jax.random.PRNGKey(0), x)
+    params = variables['params']
+    extra = {'batch_stats': variables['batch_stats']}
+    loss_fn = lambda out: jnp.mean(out ** 2)
+    res_i = cap.loss_and_grads(loss_fn, params, x, extra_vars=extra,
+                               mutable_cols=('batch_stats',),
+                               loss_scale=256.0)
+    res_p = cap.loss_and_grads(loss_fn, params, x, extra_vars=extra,
+                               mutable_cols=('batch_stats',),
+                               loss_scale=256.0, intercept=False)
+    np.testing.assert_allclose(res_p[0], res_i[0], rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5),
+        res_p[2], res_i[2])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+        res_p[4], res_i[4])
+    assert res_p[4]  # batch_stats updated through the plain path too
+
+
 def test_capture_under_jit():
     cap = KFACCapture(TinyCNN())
     x = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 5, 2))

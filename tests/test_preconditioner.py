@@ -348,3 +348,90 @@ def test_kfac_accelerates_convergence():
         sgd_loss = np.inf  # SGD diverged at this lr; K-FAC must not
     assert np.isfinite(kfac_loss)
     assert kfac_loss < sgd_loss * 0.1, (kfac_loss, sgd_loss)
+
+
+class TestFactorBatchFraction:
+    """factor_batch_fraction: within-step thinning of factor statistics
+    (the covariances normalize by their own row count, so a leading-dim
+    slice is the same estimator over fewer samples)."""
+
+    def test_fraction_one_is_identity(self):
+        kfac_f, params, state, x = setup_mlp(factor_batch_fraction=1.0)
+        kfac_d, _, _, _ = setup_mlp()
+        _, _, grads, captures, _ = kfac_f.capture.loss_and_grads(
+            loss_fn, params, x)
+        f_full = kfac_d.update_factors(state, captures)
+        f_frac = kfac_f.update_factors(state, captures)
+        jax.tree.map(np.testing.assert_array_equal, f_full, f_frac)
+
+    def test_full_batch_coverage_at_any_fraction(self):
+        """The kept positions must span the whole batch — not a head
+        slice — at EVERY fraction (a `[::b//k]` stride degenerates to a
+        prefix for f > 0.5 and orphans the tail when b % k != 0; with
+        class-grouped samplers that biases the factors)."""
+        from distributed_kfac_pytorch_tpu.capture import subsample_captures
+        b = 64
+        t = jnp.arange(b, dtype=jnp.float32)[:, None]
+        for f in (0.75, 0.3, 0.25, 0.1):
+            out = subsample_captures({'l': {'a': (t,), 'g': (t,)}}, f)
+            rows = np.asarray(out['l']['a'][0])[:, 0]
+            k = int(np.ceil(b * f))
+            assert len(rows) == k
+            # Last kept row reaches within one stride of the batch end.
+            assert rows[-1] >= b - int(np.ceil(b / k)), (f, rows)
+            np.testing.assert_array_equal(
+                rows, (np.arange(k) * b // k).astype(np.float32))
+
+    def test_half_fraction_equals_manual_slice(self, batch=16):
+        kfac, params, state, x = setup_mlp(batch=batch,
+                                           factor_batch_fraction=0.5)
+        full_kfac, _, _, _ = setup_mlp(batch=batch)
+        _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+            loss_fn, params, x)
+        # Strided subsample (not a head slice): robust to batches whose
+        # rows are ordered (class-grouped / length-bucketed pipelines).
+        sliced = {name: {'a': tuple(t[::2][:batch // 2] for t in c['a']),
+                         'g': tuple(t[::2][:batch // 2] for t in c['g'])}
+                  for name, c in captures.items()}
+        want = full_kfac.update_factors(state, sliced)
+        got = kfac.update_factors(state, captures)
+        jax.tree.map(np.testing.assert_array_equal, want, got)
+
+    def test_fraction_factors_approximate_full(self):
+        """Statistical sanity on a large batch: the thinned estimate is
+        close to the full-batch one (same expectation, more variance)."""
+        kfac, params, state, x = setup_mlp(batch=512,
+                                           factor_batch_fraction=0.25)
+        full_kfac, _, _, _ = setup_mlp(batch=512)
+        _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+            loss_fn, params, x)
+        f_frac = kfac.update_factors(state, captures)
+        f_full = full_kfac.update_factors(state, captures)
+        for name in f_full:
+            for key in ('A', 'G'):
+                a, b = np.asarray(f_frac[name][key]), np.asarray(
+                    f_full[name][key])
+                denom = np.linalg.norm(b)
+                assert np.linalg.norm(a - b) / denom < 0.35, (name, key)
+
+    def test_gradients_unaffected(self):
+        """Only factor statistics are thinned — the preconditioned
+        gradient pipeline consumes full-batch grads either way, and with
+        identical factors the outputs agree exactly."""
+        kfac, params, state, x = setup_mlp(factor_batch_fraction=0.5)
+        _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+            loss_fn, params, x)
+        precond, _ = kfac.step(state, grads, captures, damping=0.01,
+                               factor_update=False, inv_update=False)
+        full_kfac, _, _, _ = setup_mlp()
+        precond_full, _ = full_kfac.step(state, grads, captures,
+                                         damping=0.01,
+                                         factor_update=False,
+                                         inv_update=False)
+        jax.tree.map(np.testing.assert_array_equal, precond, precond_full)
+
+    def test_invalid_fraction_raises(self):
+        with pytest.raises(ValueError):
+            KFAC(MLP(), factor_batch_fraction=0.0)
+        with pytest.raises(ValueError):
+            KFAC(MLP(), factor_batch_fraction=1.5)
