@@ -219,6 +219,8 @@ def run_phase(args):
               'factor_compute_dtype': jnp.bfloat16}
     if args.inverse_method:
         kw['inverse_method'] = args.inverse_method
+    if args.factor_batch_fraction is not None:
+        kw['factor_batch_fraction'] = args.factor_batch_fraction
     if args.phase == 'firing':
         ms = phase_firing(args.model, args.batch, args.image, args.iters,
                           **kw)
@@ -235,7 +237,8 @@ def run_phase(args):
 # ---------------------------------------------------------------------------
 
 def spawn_phase(phase, model, batch, image, iters, bf16=False,
-                inverse_method=None, model_dtype=None):
+                inverse_method=None, model_dtype=None,
+                factor_batch_fraction=None):
     cmd = [sys.executable, os.path.abspath(__file__), '--phase', phase,
            '--model', model, '--batch', str(batch), '--image', str(image),
            '--iters', str(iters)]
@@ -245,6 +248,8 @@ def spawn_phase(phase, model, batch, image, iters, bf16=False,
         cmd.append('--bf16-factors')
     if inverse_method:
         cmd += ['--inverse-method', inverse_method]
+    if factor_batch_fraction is not None:
+        cmd += ['--factor-batch-fraction', str(factor_batch_fraction)]
     try:
         out = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=2400, cwd=REPO)
@@ -275,7 +280,8 @@ def config2(args):
             continue
         rows[mode], mfus[mode] = spawn_phase(
             mode, args.model, args.batch, args.image, args.iters,
-            model_dtype=args.model_dtype)
+            model_dtype=args.model_dtype,
+            factor_batch_fraction=args.factor_batch_fraction)
         emit({'config': 2, 'phase': mode, 'batch': args.batch,
               'image': args.image, 'ms_per_iter': rows[mode],
               'mfu': mfus.get(mode)})
@@ -374,6 +380,9 @@ def main(argv=None):
                         'analogue (and what fits b128 @ 224px in HBM)')
     p.add_argument('--inverse-method', default=None,
                    choices=['auto', 'eigen', 'cholesky', 'newton'])
+    p.add_argument('--factor-batch-fraction', type=float, default=None,
+                   help='opt-in within-step factor-statistic thinning '
+                        'for the step legs (KFAC.factor_batch_fraction)')
     p.add_argument('--reuse-legs', default=None,
                    help="e.g. 'sgd=16.03,precond=19.54,factors=31.28' "
                         'from a prior recorded run')

@@ -442,6 +442,19 @@ class KFACCapture:
         'g': (per-call output grads...)} and ``updated_vars`` holds the
         new values of ``mutable_cols`` ({} if none).
         """
+        # Loss-scaling is shared by both paths: scale the loss before
+        # differentiation, unscale loss/grad outputs after (the
+        # reference's GradScaler hook semantics) — one definition so the
+        # intercepting and plain paths cannot drift.
+        def scale_loss(loss):
+            return loss if loss_scale is None else loss * loss_scale
+
+        def unscale(*trees):
+            if loss_scale is None:
+                return trees
+            inv = 1.0 / loss_scale
+            return tuple(jax.tree.map(lambda g: g * inv, t) for t in trees)
+
         if not intercept:
             extra = extra_vars or {}
 
@@ -451,17 +464,12 @@ class KFACCapture:
                     mutable=list(mutable_cols), **kwargs)
                 res = loss_fn(out)
                 loss, aux = res if has_aux else (res, None)
-                if loss_scale is not None:
-                    loss = loss * loss_scale
                 updated = {c: state[c] for c in mutable_cols if c in state}
-                return loss, (aux, updated)
+                return scale_loss(loss), (aux, updated)
 
             (loss, (aux, updated)), grads = jax.value_and_grad(
                 plain, has_aux=True)(params)
-            if loss_scale is not None:
-                inv = 1.0 / loss_scale
-                loss = loss * inv
-                grads = jax.tree.map(lambda g: g * inv, grads)
+            loss, grads = unscale(loss, grads)
             return loss, aux, grads, {}, updated
 
         if probes is None:
@@ -474,18 +482,12 @@ class KFACCapture:
                 mutable_cols=mutable_cols, **kwargs)
             res = loss_fn(out)
             loss, aux = res if has_aux else (res, None)
-            if loss_scale is not None:
-                loss = loss * loss_scale
-            return loss, (aux, acts, updated)
+            return scale_loss(loss), (aux, acts, updated)
 
         (loss, (aux, acts, updated)), (grads, probe_grads) = (
             jax.value_and_grad(wrapped, argnums=(0, 1), has_aux=True)(
                 params, probes))
-        if loss_scale is not None:
-            inv = 1.0 / loss_scale
-            loss = loss * inv
-            grads = jax.tree.map(lambda g: g * inv, grads)
-            probe_grads = jax.tree.map(lambda g: g * inv, probe_grads)
+        loss, grads, probe_grads = unscale(loss, grads, probe_grads)
         captures = self.collect(acts, probe_grads)
         return loss, aux, grads, captures, updated
 
