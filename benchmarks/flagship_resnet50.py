@@ -172,6 +172,109 @@ def phase_step_leg(model_name, batch, image, mode, n_iters,
     return ms, mfu
 
 
+def phase_accum_leg(model_name, batch, image, mode, n_iters, accum=2,
+                    model_dtype=None, **kfac_kw):
+    """b{batch*accum}-equivalent step via gradient accumulation:
+    ``accum`` micro-batches of ``batch`` per optimizer step — the
+    per-chip operating point at the saturating global batch (bf16
+    K-FAC at b128 @224px OOMs monolithically; b128 = 2 x b64 micro
+    steps, the library's ``build_train_step(grad_accum_steps=2)``
+    semantics: averaged grads, averaged factor contributions with the
+    micro-mean G rescale, capture only on factor steps).
+
+    modes: 'accum_nofactor' (plain micro autodiff + precond + clip) |
+    'accum_factors' (capture + factor EWMA on this step).
+    """
+    (jax, jnp, optax, B, model, kfac, variables, kstate, x, y) = _setup(
+        model_name, batch, image, model_dtype=model_dtype, **kfac_kw)
+    from distributed_kfac_pytorch_tpu.layers import base as L
+    params = variables['params']
+    extra = {k: v for k, v in variables.items() if k != 'params'}
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+    do_factors = mode == 'accum_factors'
+    xs = jnp.stack([x] * accum)
+    ys = jnp.stack([y] * accum)
+
+    def loss(out, yy):
+        return B.loss_fn(out, yy)
+
+    def contribs_of(captures):
+        from distributed_kfac_pytorch_tpu.capture import subsample_captures
+        cdt = kfac.factor_compute_dtype
+        # Mirror the library factor paths (update_factors /
+        # local_factor_contribs): thinning applies before contraction.
+        captures = subsample_captures(captures, kfac.factor_batch_fraction)
+        return {name: {'A': L.compute_a_factor(s, captures[name]['a'],
+                                               compute_dtype=cdt),
+                       'G': L.compute_g_factor(s, captures[name]['g'],
+                                               compute_dtype=cdt)}
+                for name, s in kfac.specs.items()}
+
+    def body(carry, _):
+        params, opt_state, kst, extra = carry
+
+        def micro(mcarry, mb):
+            extra_c, gsum, csum = mcarry
+            mx, my = mb
+            l, _, grads, captures, updated = kfac.capture.loss_and_grads(
+                lambda out: loss(out, my), params, mx, extra_vars=extra_c,
+                mutable_cols=('batch_stats',), intercept=do_factors)
+            if do_factors:
+                csum = jax.tree.map(jnp.add, csum, contribs_of(captures))
+            gsum = jax.tree.map(jnp.add, gsum, grads)
+            return ({**extra_c, **updated}, gsum, csum), l
+
+        gzero = jax.tree.map(jnp.zeros_like, params)
+        czero = None
+        if do_factors:
+            csh = jax.eval_shape(
+                lambda p: contribs_of(kfac.capture.loss_and_grads(
+                    lambda out: loss(out, y), p, x, extra_vars=extra,
+                    mutable_cols=('batch_stats',))[3]), params)
+            czero = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                                 csh)
+        (extra2, gsum, csum), ls = jax.lax.scan(
+            micro, (extra, gzero, czero), (xs, ys))
+        grads = jax.tree.map(lambda g: g / accum, gsum)
+        if do_factors:
+            # Micro-mean loss: g captures are accum x larger than the
+            # global-mean-loss g; G is quadratic in g (the library's
+            # g_fix in accum_fwd_bwd), plus the 1/accum contrib mean.
+            from distributed_kfac_pytorch_tpu.ops import factors as F
+            old = kst['factors']
+            factors = {
+                n: {'A': F.update_running_avg(
+                        (c['A'] / accum).astype(old[n]['A'].dtype),
+                        old[n]['A'], kfac.factor_decay),
+                    'G': F.update_running_avg(
+                        (c['G'] / accum ** 3).astype(old[n]['G'].dtype),
+                        old[n]['G'], kfac.factor_decay)}
+                for n, c in csum.items()}
+            kst = {**kst, 'factors': factors}
+        g, kst = kfac.step(kst, grads, {}, factor_update=False,
+                           inv_update=False)
+        updates, opt_state = tx.update(g, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state, kst, extra2), ls[-1]
+
+    @jax.jit
+    def run(carry):
+        carry, losses = jax.lax.scan(body, carry, None, length=n_iters)
+        return carry, losses[-1]
+
+    carry0 = (params, opt_state, kstate, extra)
+    floor = B.flops_floor_ms(kfac, variables, x, y,
+                             mutable_cols=('batch_stats',)) * accum
+    ms = B.time_chained(run, carry0, n_iters, floor_ms=floor, leg=mode)
+    peak, _ = B.detected_tpu_peak()
+    mfu = None
+    if peak:
+        flops = B.model_flops_per_step(kfac, params, x, y, extra) * accum
+        mfu = round(flops / (ms * 1e-3) / peak, 4)
+    return ms, mfu
+
+
 def phase_firing(model_name, batch, image, n_firings, **kfac_kw):
     """Warm inverse firing over the model's real factor set (its own
     compiled program — no model fwd/bwd in it).
@@ -225,6 +328,11 @@ def run_phase(args):
         ms = phase_firing(args.model, args.batch, args.image, args.iters,
                           **kw)
         emit({'phase_result': round(ms, 2)})
+    elif args.phase in ('accum_nofactor', 'accum_factors'):
+        ms, mfu = phase_accum_leg(args.model, args.batch, args.image,
+                                  args.phase, args.iters,
+                                  model_dtype=args.model_dtype, **kw)
+        emit({'phase_result': round(ms, 2), 'mfu': mfu})
     else:
         ms, mfu = phase_step_leg(args.model, args.batch, args.image,
                                  args.phase, args.iters,
