@@ -438,6 +438,11 @@ def test_distributed_step_with_fraction_trains():
         p_nf, _, _, _, m_nf = step(params, opt_state, kstate, {}, batch,
                                    hyper, factor_update=False,
                                    inv_update=False)
+        # Finiteness guards the equality check below: NaN == NaN passes
+        # assert_array_equal, so a NaN-ing gated path must fail HERE.
+        assert np.isfinite(float(m_nf['loss']))
+        assert all(np.isfinite(np.asarray(x)).all()
+                   for x in jax.tree.leaves(p_nf))
         # Then a factor+inverse step: thinned statistics flow through.
         p2, o2, k2, _, m2 = step(params, opt_state, kstate, {}, batch,
                                  hyper, factor_update=True,
