@@ -54,6 +54,9 @@ import jax.numpy as jnp
 import optax
 
 from distributed_kfac_pytorch_tpu import KFAC
+from distributed_kfac_pytorch_tpu.utils import enable_compilation_cache
+
+enable_compilation_cache()  # persistent compile cache (KFAC_COMPILE_CACHE=0 disables)
 from distributed_kfac_pytorch_tpu.models import cifar_resnet
 
 

@@ -42,6 +42,10 @@ import time
 
 import jax
 import jax.numpy as jnp
+
+from distributed_kfac_pytorch_tpu.utils import enable_compilation_cache
+
+enable_compilation_cache()  # persistent compile cache (KFAC_COMPILE_CACHE=0 disables)
 import numpy as np
 import optax
 

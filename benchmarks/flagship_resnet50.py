@@ -61,6 +61,8 @@ def _setup(model_name, batch, image, model_dtype=None, **kfac_kw):
     import jax.numpy as jnp
     import optax
 
+    # Importing bench also enables the persistent compilation cache
+    # for this worker process.
     import bench as B
     from distributed_kfac_pytorch_tpu import KFAC
     from distributed_kfac_pytorch_tpu.models import imagenet_resnet

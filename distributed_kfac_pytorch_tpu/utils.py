@@ -80,3 +80,54 @@ def tree_bytes(tree: Any) -> int:
     """Total bytes of all array leaves (for memory accounting)."""
     return sum(x.size * x.dtype.itemsize
                for x in jax.tree.leaves(tree) if hasattr(x, 'size'))
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Enable JAX's persistent compilation cache (measured: a repeat
+    process compiles an identical program in ~0.01 s vs the full
+    compile — on the tunneled dev chip that is minutes per flagship
+    program). No reference analogue (torch eager has no compile step);
+    this is TPU operational tooling.
+
+    ``cache_dir`` defaults to ``$KFAC_COMPILE_CACHE`` or
+    ``<package parent>/.jax_cache`` (the repo root when run from a
+    checkout). Set ``KFAC_COMPILE_CACHE=0`` to disable (e.g. when
+    measuring cold-compile behavior itself). Returns the cache dir in
+    effect, or None when disabled/unavailable. Safe for timing benches:
+    the cache affects compile time only, never the compiled program's
+    execution.
+
+    Deference rules: a cache dir already configured through JAX's own
+    knobs (``JAX_COMPILATION_CACHE_DIR`` or a prior ``jax.config``
+    update) wins — this helper then changes nothing and returns the
+    existing dir. An unwritable default location (e.g. an installed
+    package under a read-only site-packages) disables the cache
+    instead of crashing the entry script. This is deliberately a
+    per-entry-point call, NOT a library import side effect: the
+    library must never mutate global JAX config just by being
+    imported.
+    """
+    import os
+
+    env = os.environ.get('KFAC_COMPILE_CACHE')
+    if env == '0':
+        return None
+    existing = jax.config.jax_compilation_cache_dir
+    if os.environ.get('JAX_COMPILATION_CACHE_DIR'):
+        return os.environ['JAX_COMPILATION_CACHE_DIR']
+    if cache_dir is None and existing:
+        return existing
+    if cache_dir is None:
+        cache_dir = env or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            '.jax_cache')
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        return None
+    jax.config.update('jax_compilation_cache_dir', cache_dir)
+    # Cache everything: tiny helper jits recompile constantly in
+    # multi-process bench legs, and the default 1 s threshold skips
+    # them.
+    jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.0)
+    return cache_dir

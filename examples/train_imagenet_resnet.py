@@ -38,6 +38,10 @@ from distributed_kfac_pytorch_tpu.training import (
     utils,
 )
 
+from distributed_kfac_pytorch_tpu.utils import enable_compilation_cache
+
+enable_compilation_cache()  # persistent compile cache (KFAC_COMPILE_CACHE=0 disables)
+
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(

@@ -48,6 +48,10 @@ from distributed_kfac_pytorch_tpu.training import (
     optimizers,
 )
 
+from distributed_kfac_pytorch_tpu.utils import enable_compilation_cache
+
+enable_compilation_cache()  # persistent compile cache (KFAC_COMPILE_CACHE=0 disables)
+
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(

@@ -135,3 +135,43 @@ class TestLaunch:
             mesh, batch, P(D.KFAC_AXES))
         assert out['x'].shape == (16, 3)
         assert len(out['x'].sharding.device_set) == 8
+
+
+def test_enable_compilation_cache(tmp_path, monkeypatch):
+    import jax
+
+    from distributed_kfac_pytorch_tpu.utils import enable_compilation_cache
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    monkeypatch.delenv('JAX_COMPILATION_CACHE_DIR', raising=False)
+    try:
+        # Start from a clean slate so the explicit-dir path is exercised
+        # even if an earlier test (or the env) configured a cache.
+        jax.config.update('jax_compilation_cache_dir', None)
+        d = tmp_path / 'cache'
+        got = enable_compilation_cache(str(d))
+        assert got == str(d) and d.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(d)
+        # A dir already configured through JAX's own knob wins.
+        assert enable_compilation_cache() == str(d)
+        # JAX's own env var wins and is left untouched.
+        monkeypatch.setenv('JAX_COMPILATION_CACHE_DIR', '/shared/warm')
+        assert enable_compilation_cache() == '/shared/warm'
+        monkeypatch.delenv('JAX_COMPILATION_CACHE_DIR')
+        # Opt-out wins over everything.
+        monkeypatch.setenv('KFAC_COMPILE_CACHE', '0')
+        assert enable_compilation_cache(str(d)) is None
+        # KFAC env var supplies the default dir (no prior config).
+        jax.config.update('jax_compilation_cache_dir', None)
+        monkeypatch.setenv('KFAC_COMPILE_CACHE',
+                           str(tmp_path / 'env_cache'))
+        assert enable_compilation_cache() == str(tmp_path / 'env_cache')
+        # Unwritable location disables instead of crashing.
+        monkeypatch.delenv('KFAC_COMPILE_CACHE')
+        jax.config.update('jax_compilation_cache_dir', None)
+        assert enable_compilation_cache('/proc/nope/cache') is None
+    finally:
+        jax.config.update('jax_compilation_cache_dir', prev_dir)
+        jax.config.update('jax_persistent_cache_min_compile_time_secs',
+                          prev_min)
