@@ -29,6 +29,15 @@ assert jax.default_backend() == 'cpu', (
 assert jax.device_count() == 8, (
     f'expected 8 virtual CPU devices, got {jax.device_count()}')
 
+# Persistent compile cache: repeat suite runs skip recompilation of
+# unchanged test programs (KFAC_COMPILE_CACHE=0 opts out; the cache
+# changes compile time only, never compiled-program behavior).
+from distributed_kfac_pytorch_tpu.utils import (  # noqa: E402
+    enable_compilation_cache,
+)
+
+enable_compilation_cache()
+
 
 def pytest_configure(config):
     # Compile-heavy tests (the flagship ResNet-50 distributed step, the
