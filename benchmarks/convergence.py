@@ -42,8 +42,6 @@ from distributed_kfac_pytorch_tpu.training import (
 
 from distributed_kfac_pytorch_tpu.utils import enable_compilation_cache
 
-enable_compilation_cache()  # persistent compile cache (KFAC_COMPILE_CACHE=0 disables)
-
 
 class _MLP:
     """BN-free MLP classifier over flattened images — the workload
@@ -283,6 +281,11 @@ def main(argv=None):
         jax.config.update('jax_platforms', args.platform)
         if args.platform == 'cpu':
             jax.config.update('jax_num_cpu_devices', 8)
+    if args.platform != 'cpu':
+        # Persistent compile cache, AFTER platform resolution: warm
+        # reads segfault on the multi-device CPU backend (see
+        # utils.enable_compilation_cache), so CPU runs skip it.
+        enable_compilation_cache()
 
     data = datasets.get_cifar(args.data_dir,
                               synthetic_size=args.synthetic_size)

@@ -143,7 +143,6 @@ def test_enable_compilation_cache(tmp_path, monkeypatch):
     from distributed_kfac_pytorch_tpu.utils import enable_compilation_cache
 
     prev_dir = jax.config.jax_compilation_cache_dir
-    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
     monkeypatch.delenv('JAX_COMPILATION_CACHE_DIR', raising=False)
     try:
         # Start from a clean slate so the explicit-dir path is exercised
@@ -173,5 +172,3 @@ def test_enable_compilation_cache(tmp_path, monkeypatch):
         assert enable_compilation_cache('/proc/nope/cache') is None
     finally:
         jax.config.update('jax_compilation_cache_dir', prev_dir)
-        jax.config.update('jax_persistent_cache_min_compile_time_secs',
-                          prev_min)
