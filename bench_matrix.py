@@ -374,11 +374,10 @@ def main(argv=None):
         jax.config.update('jax_platforms', args.platform)
         if args.platform == 'cpu':
             jax.config.update('jax_num_cpu_devices', 8)
-    if args.platform != 'cpu':
-        # Persistent compile cache, AFTER platform resolution: warm
-        # reads segfault on the multi-device CPU backend (see
-        # utils.enable_compilation_cache), so CPU runs skip it.
-        enable_compilation_cache()
+    # Persistent compile cache, AFTER platform resolution (the helper
+    # itself refuses on a multi-device CPU configuration — the warm-read
+    # segfault workaround, see utils.enable_compilation_cache).
+    enable_compilation_cache()
 
     on_chip = jax.default_backend() == 'tpu'
     runners = {1: config1_cifar_methods, 2: config2_imagenet,

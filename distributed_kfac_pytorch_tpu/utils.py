@@ -111,13 +111,19 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     segfault on the multi-device CPU backend — the second full test
     suite run crashes at trace time inside a shard_map trace, while
     cold runs and all on-chip warm paths (CLIs, bench legs) are clean.
-    Do not enable for CPU-mesh suites (tests/conftest.py documents
-    this); ``KFAC_COMPILE_CACHE=0`` disables everywhere.
+    The DEFAULT path therefore refuses (and actively disables, env var
+    included) when the process is configured for a multi-device CPU
+    backend; an explicit ``cache_dir`` argument bypasses the guard
+    (caller takes responsibility — that is what the unit tests use).
+    ``KFAC_COMPILE_CACHE=0`` disables everywhere.
     """
     import os
 
     env = os.environ.get('KFAC_COMPILE_CACHE')
     if env == '0':
+        return None
+    if cache_dir is None and _multi_device_cpu_configured():
+        disable_compilation_cache()
         return None
     existing = jax.config.jax_compilation_cache_dir
     if os.environ.get('JAX_COMPILATION_CACHE_DIR'):
@@ -142,3 +148,36 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     # the prime suspect) — the big programs are where the minutes are
     # anyway.
     return cache_dir
+
+
+def disable_compilation_cache() -> None:
+    """Turn the persistent compilation cache off for this process —
+    including a cache inherited through JAX's own
+    ``JAX_COMPILATION_CACHE_DIR`` env var. The single point of truth
+    for the multi-device-CPU segfault workaround (see
+    :func:`enable_compilation_cache`); used by the CPU-mesh test
+    harness and the multichip dryrun.
+    """
+    import os
+
+    os.environ.pop('JAX_COMPILATION_CACHE_DIR', None)
+    jax.config.update('jax_compilation_cache_dir', None)
+
+
+def _multi_device_cpu_configured() -> bool:
+    """True when this process is set up for a multi-device CPU backend
+    (the configuration whose warm cache reads segfault) — decided from
+    config/env only, WITHOUT initializing the backend (entry points
+    still need jax.config.update('jax_platforms', ...) to work after
+    this check).
+    """
+    import os
+    import re
+
+    plats = jax.config.jax_platforms
+    first = plats.split(',')[0] if plats else None
+    if first == 'cpu' and jax.config.jax_num_cpu_devices > 1:
+        return True
+    m = re.search(r'xla_force_host_platform_device_count=(\d+)',
+                  os.environ.get('XLA_FLAGS', ''))
+    return bool(m and int(m.group(1)) > 1 and first in (None, 'cpu'))

@@ -29,15 +29,17 @@ assert jax.default_backend() == 'cpu', (
 assert jax.device_count() == 8, (
     f'expected 8 virtual CPU devices, got {jax.device_count()}')
 
-# The persistent compilation cache (utils.enable_compilation_cache) is
-# deliberately DISABLED here — including any cache inherited from the
-# environment (JAX's own JAX_COMPILATION_CACHE_DIR): warm cache reads
-# segfault reproducibly on this multi-device CPU backend (trace-time
-# crash inside a shard_map trace on the second suite run; cold runs are
-# green both times). The on-chip entry points keep the cache — their
-# warm paths are validated.
-os.environ.pop('JAX_COMPILATION_CACHE_DIR', None)
-jax.config.update('jax_compilation_cache_dir', None)
+# The persistent compilation cache is deliberately DISABLED here —
+# including any cache inherited from the environment (JAX's own
+# JAX_COMPILATION_CACHE_DIR): warm cache reads segfault reproducibly on
+# this multi-device CPU backend (trace-time crash inside a shard_map
+# trace on the second suite run; cold runs are green both times). The
+# on-chip entry points keep the cache — their warm paths are validated.
+from distributed_kfac_pytorch_tpu.utils import (  # noqa: E402
+    disable_compilation_cache,
+)
+
+disable_compilation_cache()
 
 
 def pytest_configure(config):

@@ -140,35 +140,47 @@ class TestLaunch:
 def test_enable_compilation_cache(tmp_path, monkeypatch):
     import jax
 
-    from distributed_kfac_pytorch_tpu.utils import enable_compilation_cache
+    from distributed_kfac_pytorch_tpu import utils as U
 
     prev_dir = jax.config.jax_compilation_cache_dir
     monkeypatch.delenv('JAX_COMPILATION_CACHE_DIR', raising=False)
+    monkeypatch.delenv('KFAC_COMPILE_CACHE', raising=False)
     try:
-        # Start from a clean slate so the explicit-dir path is exercised
-        # even if an earlier test (or the env) configured a cache.
+        # This test process IS a multi-device CPU configuration (the
+        # conftest mesh), i.e. the segfault surface: the DEFAULT path
+        # must refuse and actively disable, env var included.
+        assert U._multi_device_cpu_configured()
+        monkeypatch.setenv('JAX_COMPILATION_CACHE_DIR', '/shared/warm')
+        assert U.enable_compilation_cache() is None
+        assert 'JAX_COMPILATION_CACHE_DIR' not in __import__('os').environ
+        assert jax.config.jax_compilation_cache_dir is None
+        # An explicit dir bypasses the guard (caller responsibility).
         jax.config.update('jax_compilation_cache_dir', None)
         d = tmp_path / 'cache'
-        got = enable_compilation_cache(str(d))
+        got = U.enable_compilation_cache(str(d))
         assert got == str(d) and d.is_dir()
         assert jax.config.jax_compilation_cache_dir == str(d)
+        # The remaining default-path rules, with the guard stubbed out
+        # (they are what non-CPU entry points see):
+        monkeypatch.setattr(U, '_multi_device_cpu_configured',
+                            lambda: False)
         # A dir already configured through JAX's own knob wins.
-        assert enable_compilation_cache() == str(d)
+        assert U.enable_compilation_cache() == str(d)
         # JAX's own env var wins and is left untouched.
         monkeypatch.setenv('JAX_COMPILATION_CACHE_DIR', '/shared/warm')
-        assert enable_compilation_cache() == '/shared/warm'
+        assert U.enable_compilation_cache() == '/shared/warm'
         monkeypatch.delenv('JAX_COMPILATION_CACHE_DIR')
         # Opt-out wins over everything.
         monkeypatch.setenv('KFAC_COMPILE_CACHE', '0')
-        assert enable_compilation_cache(str(d)) is None
+        assert U.enable_compilation_cache(str(d)) is None
         # KFAC env var supplies the default dir (no prior config).
         jax.config.update('jax_compilation_cache_dir', None)
         monkeypatch.setenv('KFAC_COMPILE_CACHE',
                            str(tmp_path / 'env_cache'))
-        assert enable_compilation_cache() == str(tmp_path / 'env_cache')
+        assert U.enable_compilation_cache() == str(tmp_path / 'env_cache')
         # Unwritable location disables instead of crashing.
         monkeypatch.delenv('KFAC_COMPILE_CACHE')
         jax.config.update('jax_compilation_cache_dir', None)
-        assert enable_compilation_cache('/proc/nope/cache') is None
+        assert U.enable_compilation_cache('/proc/nope/cache') is None
     finally:
         jax.config.update('jax_compilation_cache_dir', prev_dir)
