@@ -427,7 +427,13 @@ def config2(args):
             rows.get('nofactor'), (int, float)) else rows['precond']
         factor_cost = max(rows['factors'] - base, 0.0)
         for fire_method, fire_ms in methods:
-            out = {'config': 2,
+            # row_schema 2 (round 4+): 'every_iter' is the capture-free
+            # nofactor leg (the old capturing value moved to
+            # 'every_iter_capturing') and 'factor_cost' was renamed
+            # 'factor_step_extra'. Schema-less rows are round-3
+            # (schema 1) semantics — cross-round comparisons must key
+            # on this field (ADVICE r4).
+            out = {'config': 2, 'row_schema': 2,
                    'workload': f'{args.model}_imagenet{args.image}'
                                f'_b{args.batch}',
                    'unit': 'ms/iter', 'sgd': rows['sgd'],

@@ -456,6 +456,12 @@ class KFACCapture:
             return tuple(jax.tree.map(lambda g: g * inv, t) for t in trees)
 
         if not intercept:
+            if probes is not None:
+                raise ValueError(
+                    'probes were passed with intercept=False — the capture '
+                    'machinery is skipped entirely on non-intercepting '
+                    'steps, so precomputed probes indicate caller '
+                    'confusion; drop probes or set intercept=True')
             extra = extra_vars or {}
 
             def plain(params):

@@ -111,20 +111,42 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     segfault on the multi-device CPU backend — the second full test
     suite run crashes at trace time inside a shard_map trace, while
     cold runs and all on-chip warm paths (CLIs, bench legs) are clean.
-    The DEFAULT path therefore refuses (and actively disables, env var
-    included) when the process is configured for a multi-device CPU
-    backend; an explicit ``cache_dir`` argument bypasses the guard
-    (caller takes responsibility — that is what the unit tests use).
-    ``KFAC_COMPILE_CACHE=0`` disables everywhere.
+    When the process *explicitly* names a multi-device CPU backend
+    (``jax_platforms`` starts with cpu) the DEFAULT path refuses and
+    actively disables, env var included. When the configuration is only
+    *implicit* (``jax_platforms`` unset but multi-device CPU knobs set —
+    the process may still resolve to an accelerator), the default path
+    refuses to enable anything itself but leaves the user's own
+    ``JAX_COMPILATION_CACHE_DIR`` untouched: destroying it in a process
+    that resolves to TPU would be wrong (ADVICE r4), at the cost of
+    residual segfault exposure if that process really is CPU-only AND
+    the user exported the env var themselves. An explicit ``cache_dir``
+    argument bypasses the guard (caller takes responsibility — that is
+    what the unit tests use). ``KFAC_COMPILE_CACHE=0`` disables
+    everywhere.
     """
     import os
 
     env = os.environ.get('KFAC_COMPILE_CACHE')
-    if env == '0':
+    if env is not None and env.strip().lower() in (
+            '0', 'false', 'off', 'no', ''):
         return None
-    if cache_dir is None and _multi_device_cpu_configured():
-        disable_compilation_cache()
-        return None
+    if env is not None and env.strip().lower() in ('1', 'true', 'on', 'yes'):
+        # Boolean-looking "enable" spellings mean "use the default dir",
+        # not "use a relative directory literally named '1'".
+        env = None
+    if cache_dir is None:
+        cpu_config = _multi_device_cpu_configured()
+        if cpu_config == 'explicit':
+            disable_compilation_cache()
+            return None
+        if cpu_config == 'implicit':
+            # jax_platforms is unset; XLA_FLAGS merely *allows* a
+            # multi-device CPU backend but the process may still resolve
+            # to an accelerator. Don't enable (the CPU case segfaults on
+            # warm reads) but don't destroy the user's own
+            # JAX_COMPILATION_CACHE_DIR either.
+            return None
     existing = jax.config.jax_compilation_cache_dir
     if os.environ.get('JAX_COMPILATION_CACHE_DIR'):
         return os.environ['JAX_COMPILATION_CACHE_DIR']
@@ -164,20 +186,30 @@ def disable_compilation_cache() -> None:
     jax.config.update('jax_compilation_cache_dir', None)
 
 
-def _multi_device_cpu_configured() -> bool:
-    """True when this process is set up for a multi-device CPU backend
-    (the configuration whose warm cache reads segfault) — decided from
+def _multi_device_cpu_configured() -> str | None:
+    """How this process is set up for a multi-device CPU backend (the
+    configuration whose warm cache reads segfault) — decided from
     config/env only, WITHOUT initializing the backend (entry points
     still need jax.config.update('jax_platforms', ...) to work after
     this check).
+
+    Returns ``'explicit'`` when ``jax_platforms`` names cpu first with
+    multiple devices configured, ``'implicit'`` when ``jax_platforms``
+    is unset but ``XLA_FLAGS`` forces >1 host-platform devices (the
+    process may still resolve to an accelerator backend), and ``None``
+    otherwise.
     """
     import os
     import re
 
     plats = jax.config.jax_platforms
     first = plats.split(',')[0] if plats else None
-    if first == 'cpu' and jax.config.jax_num_cpu_devices > 1:
-        return True
     m = re.search(r'xla_force_host_platform_device_count=(\d+)',
                   os.environ.get('XLA_FLAGS', ''))
-    return bool(m and int(m.group(1)) > 1 and first in (None, 'cpu'))
+    forced = bool(m and int(m.group(1)) > 1) or (
+        jax.config.jax_num_cpu_devices > 1)
+    if first == 'cpu' and forced:
+        return 'explicit'
+    if forced and first is None:
+        return 'implicit'
+    return None

@@ -131,6 +131,21 @@ def test_intercept_false_matches_plain_autodiff():
         grads_p, grads_i)
 
 
+def test_intercept_false_rejects_precomputed_probes():
+    """Passing precomputed probes alongside intercept=False is caller
+    confusion (the capture machinery is skipped, the probes would be
+    silently ignored) — must raise, not drop the signal (ADVICE r4)."""
+    cap = KFACCapture(MLP())
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 6))
+    variables, _ = cap.init(jax.random.PRNGKey(0), x)
+    params = variables['params']
+    probes = cap.zero_probes(params, x)
+    loss_fn = lambda out: jnp.mean(out ** 2)
+    with pytest.raises(ValueError, match='intercept=False'):
+        cap.loss_and_grads(loss_fn, params, x, probes=probes,
+                           intercept=False)
+
+
 def test_intercept_false_mutable_collections_and_loss_scale():
     """The plain path must still thread mutable collections (BN stats)
     and apply the loss-scale unscaling identically."""

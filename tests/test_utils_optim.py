@@ -146,14 +146,26 @@ def test_enable_compilation_cache(tmp_path, monkeypatch):
     monkeypatch.delenv('JAX_COMPILATION_CACHE_DIR', raising=False)
     monkeypatch.delenv('KFAC_COMPILE_CACHE', raising=False)
     try:
-        # This test process IS a multi-device CPU configuration (the
-        # conftest mesh), i.e. the segfault surface: the DEFAULT path
-        # must refuse and actively disable, env var included.
-        assert U._multi_device_cpu_configured()
+        # This test process IS an explicit multi-device CPU configuration
+        # (the conftest mesh), i.e. the segfault surface: the DEFAULT
+        # path must refuse and actively disable, env var included.
+        assert U._multi_device_cpu_configured() == 'explicit'
         monkeypatch.setenv('JAX_COMPILATION_CACHE_DIR', '/shared/warm')
         assert U.enable_compilation_cache() is None
         assert 'JAX_COMPILATION_CACHE_DIR' not in __import__('os').environ
         assert jax.config.jax_compilation_cache_dir is None
+        # An IMPLICIT configuration (jax_platforms unset; the process
+        # may still resolve to an accelerator) refuses without touching
+        # the user's env var (ADVICE r4).
+        monkeypatch.setattr(U, '_multi_device_cpu_configured',
+                            lambda: 'implicit')
+        monkeypatch.setenv('JAX_COMPILATION_CACHE_DIR', '/shared/warm')
+        assert U.enable_compilation_cache() is None
+        assert __import__('os').environ[
+            'JAX_COMPILATION_CACHE_DIR'] == '/shared/warm'
+        monkeypatch.delenv('JAX_COMPILATION_CACHE_DIR')
+        monkeypatch.setattr(U, '_multi_device_cpu_configured',
+                            lambda: 'explicit')
         # An explicit dir bypasses the guard (caller responsibility).
         jax.config.update('jax_compilation_cache_dir', None)
         d = tmp_path / 'cache'
@@ -170,9 +182,17 @@ def test_enable_compilation_cache(tmp_path, monkeypatch):
         monkeypatch.setenv('JAX_COMPILATION_CACHE_DIR', '/shared/warm')
         assert U.enable_compilation_cache() == '/shared/warm'
         monkeypatch.delenv('JAX_COMPILATION_CACHE_DIR')
-        # Opt-out wins over everything.
-        monkeypatch.setenv('KFAC_COMPILE_CACHE', '0')
-        assert U.enable_compilation_cache(str(d)) is None
+        # Opt-out wins over everything ('0' and friends).
+        for off in ('0', 'false', 'OFF', 'no'):
+            monkeypatch.setenv('KFAC_COMPILE_CACHE', off)
+            assert U.enable_compilation_cache(str(d)) is None
+        # Boolean-looking "enable" spellings mean the default dir, not a
+        # relative directory literally named '1' (ADVICE r4).
+        jax.config.update('jax_compilation_cache_dir', None)
+        monkeypatch.setenv('KFAC_COMPILE_CACHE', '1')
+        got = U.enable_compilation_cache()
+        assert got is not None and not got.endswith('/1')
+        assert not __import__('os').path.exists('1')
         # KFAC env var supplies the default dir (no prior config).
         jax.config.update('jax_compilation_cache_dir', None)
         monkeypatch.setenv('KFAC_COMPILE_CACHE',
