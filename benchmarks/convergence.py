@@ -67,7 +67,8 @@ class _MLP:
 def run_one(use_kfac: bool, args, data):
     (train_x, train_y), (val_x, val_y) = data
     model = (_MLP.build() if args.model == 'mlp'
-             else cifar_resnet.get_model(args.model))
+             else cifar_resnet.get_model(
+                 args.model, bn_momentum=args.bn_momentum))
     cfg = optimizers.OptimConfig(
         base_lr=args.base_lr, momentum=0.9, weight_decay=5e-4,
         warmup_epochs=args.warmup, lr_decay=args.lr_decay,
@@ -117,6 +118,8 @@ def run_one(use_kfac: bool, args, data):
 
     state = engine.TrainState(params=params, opt_state=opt_state,
                               kfac_state=kstate, extra_vars=extra)
+    bn_steps = (engine.make_precise_bn_steps(model, mesh)
+                if args.precise_bn > 0 and extra else None)
     curve = []
     t0 = time.perf_counter()
     for epoch in range(args.epochs):
@@ -128,10 +131,28 @@ def run_one(use_kfac: bool, args, data):
             train_x, train_y, args.batch_size, seed=args.seed,
             epoch=epoch, augment=True)
         tm = engine.train_epoch(step_fn, state, batches, hyper)
+        if bn_steps is not None:
+            # Precise-BN: re-estimate running stats at the current
+            # weights over a few forward-only training batches; used
+            # for EVAL ONLY (training keeps its own EWMA state so the
+            # optimization trajectory is untouched by the flag).
+            import itertools
+            recal = engine.precise_bn_recalibrate(
+                model, state.params, state.extra_vars,
+                itertools.islice(
+                    datasets.epoch_batches(
+                        train_x, train_y, args.batch_size,
+                        seed=args.seed, epoch=10_000 + epoch,
+                        augment=True),
+                    args.precise_bn),
+                mesh, steps=bn_steps)
+            train_extra, state.extra_vars = state.extra_vars, recal
         vm = engine.evaluate(
             eval_step, state,
             datasets.epoch_batches(val_x, val_y, args.batch_size,
                                    shuffle=False, augment=False))
+        if bn_steps is not None:
+            state.extra_vars = train_extra
         if kfac_sched:
             kfac_sched.step(epoch + 1)
         curve.append({'epoch': epoch,
@@ -164,20 +185,28 @@ def run_sweep(args, data):
     """
     sweep: dict[str, dict] = {'kfac': {}, 'sgd': {}}
     damp_grid = args.kfac_damping_grid or [args.damping]
+    bnm_grid = args.kfac_bn_momentum_grid or [args.bn_momentum]
     for use_kfac in (True, False):
         name = 'kfac' if use_kfac else 'sgd'
         for lr in args.lr_grid:
             for damping in (damp_grid if use_kfac else [args.damping]):
-                a = argparse.Namespace(**vars(args))
-                a.base_lr = lr
-                a.damping = damping
-                key = (f'lr={lr},damping={damping}' if use_kfac
-                       else f'lr={lr}')
-                print(f'=== {name} {key} ===', flush=True)
-                curve, wall = run_one(use_kfac, a, data)
-                sweep[name][key] = {
-                    'curve': curve, 'wall_s': round(wall, 1),
-                    'best_val_acc': max(r['val_acc'] for r in curve)}
+                for bnm in (bnm_grid if use_kfac
+                            else [args.bn_momentum]):
+                    a = argparse.Namespace(**vars(args))
+                    a.base_lr = lr
+                    a.damping = damping
+                    a.bn_momentum = bnm
+                    key = f'lr={lr}'
+                    if use_kfac:
+                        key += f',damping={damping}'
+                        if len(bnm_grid) > 1:
+                            key += f',bn_momentum={bnm}'
+                    print(f'=== {name} {key} ===', flush=True)
+                    curve, wall = run_one(use_kfac, a, data)
+                    sweep[name][key] = {
+                        'curve': curve, 'wall_s': round(wall, 1),
+                        'best_val_acc': max(r['val_acc']
+                                            for r in curve)}
 
     # Common target: the weaker optimizer's best achievable accuracy
     # (x0.995 tolerance) — both optimizers can reach it, so
@@ -211,6 +240,8 @@ def run_sweep(args, data):
         'label_noise': args.label_noise,
         'lr_grid': args.lr_grid,
         'kfac_damping_grid': damp_grid,
+        'kfac_bn_momentum_grid': bnm_grid,
+        'precise_bn': args.precise_bn,
         'sgd_damping_na': 'damping applies to K-FAC only',
         'target_val_acc': round(target, 4),
         'chosen': chosen,
@@ -244,6 +275,20 @@ def main(argv=None):
     p.add_argument('--damping-decay', type=int, nargs='+', default=[])
     p.add_argument('--kfac-freq-alpha', type=float, default=1.0)
     p.add_argument('--kfac-freq-decay', type=int, nargs='+', default=[])
+    p.add_argument('--precise-bn', type=int, default=0,
+                   help='re-estimate BN running statistics over this '
+                        'many forward-only train batches before each '
+                        'eval (precise-BN; 0 = off). Eval-only: the '
+                        'training EWMA state is untouched.')
+    p.add_argument('--bn-momentum', type=float, default=0.9,
+                   help='BatchNorm running-stat EWMA momentum (flax '
+                        'convention; 0.9 = torch momentum 0.1, the '
+                        'reference default)')
+    p.add_argument('--kfac-bn-momentum-grid', type=float, nargs='+',
+                   default=None,
+                   help='sweep mode: BN momentum values for the K-FAC '
+                        'leg (the stats-lag timescale is a K-FAC-'
+                        'specific knob; default: just --bn-momentum)')
     p.add_argument('--eigh-method', default='auto')
     p.add_argument('--eigh-polish-iters', type=int, default=8)
     p.add_argument('--factor-batch-fraction', type=float, default=1.0,
@@ -280,6 +325,9 @@ def main(argv=None):
     if args.platform:
         jax.config.update('jax_platforms', args.platform)
         if args.platform == 'cpu':
+            from distributed_kfac_pytorch_tpu.utils import (
+                raise_cpu_collective_timeouts)
+            raise_cpu_collective_timeouts()
             jax.config.update('jax_num_cpu_devices', 8)
     # Persistent compile cache, AFTER platform resolution (the helper
     # itself refuses on a multi-device CPU configuration — the warm-read

@@ -56,7 +56,8 @@ def emit(obj):
 # Single-phase workers (run in a fresh process via --phase)
 # ---------------------------------------------------------------------------
 
-def _setup(model_name, batch, image, model_dtype=None, **kfac_kw):
+def _setup(model_name, batch, image, model_dtype=None, remat=False,
+           **kfac_kw):
     import jax
     import jax.numpy as jnp
     import optax
@@ -73,7 +74,7 @@ def _setup(model_name, batch, image, model_dtype=None, **kfac_kw):
     # single v5e's 16 GB HBM (fp32 activations RESOURCE_EXHAUST there).
     dt = {None: jnp.float32, 'fp32': jnp.float32,
           'bf16': jnp.bfloat16}[model_dtype]
-    model = imagenet_resnet.get_model(model_name, dtype=dt)
+    model = imagenet_resnet.get_model(model_name, dtype=dt, remat=remat)
     x = jax.random.normal(jax.random.PRNGKey(1), (batch, image, image, 3))
     y = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 1000)
     kfac = KFAC(model, factor_update_freq=1, inv_update_freq=1,
@@ -83,11 +84,12 @@ def _setup(model_name, batch, image, model_dtype=None, **kfac_kw):
 
 
 def phase_step_leg(model_name, batch, image, mode, n_iters,
-                   model_dtype=None, **kfac_kw):
+                   model_dtype=None, remat=False, **kfac_kw):
     """sgd | capture | precond | factors | inv: scanned train-step
     variants ('capture' = interception-only, no K-FAC math)."""
     (jax, jnp, optax, B, model, kfac, variables, kstate, x, y) = _setup(
-        model_name, batch, image, model_dtype=model_dtype, **kfac_kw)
+        model_name, batch, image, model_dtype=model_dtype, remat=remat,
+        **kfac_kw)
     params = variables['params']
     extra = {k: v for k, v in variables.items() if k != 'params'}
     tx = optax.sgd(0.1, momentum=0.9)
@@ -175,7 +177,7 @@ def phase_step_leg(model_name, batch, image, mode, n_iters,
 
 
 def phase_accum_leg(model_name, batch, image, mode, n_iters, accum=2,
-                    model_dtype=None, **kfac_kw):
+                    model_dtype=None, remat=False, **kfac_kw):
     """b{batch*accum}-equivalent step via gradient accumulation:
     ``accum`` micro-batches of ``batch`` per optimizer step — the
     per-chip operating point at the saturating global batch (bf16
@@ -188,7 +190,8 @@ def phase_accum_leg(model_name, batch, image, mode, n_iters, accum=2,
     'accum_factors' (capture + factor EWMA on this step).
     """
     (jax, jnp, optax, B, model, kfac, variables, kstate, x, y) = _setup(
-        model_name, batch, image, model_dtype=model_dtype, **kfac_kw)
+        model_name, batch, image, model_dtype=model_dtype, remat=remat,
+        **kfac_kw)
     from distributed_kfac_pytorch_tpu.layers import base as L
     params = variables['params']
     extra = {k: v for k, v in variables.items() if k != 'params'}
@@ -333,12 +336,14 @@ def run_phase(args):
     elif args.phase in ('accum_nofactor', 'accum_factors'):
         ms, mfu = phase_accum_leg(args.model, args.batch, args.image,
                                   args.phase, args.iters,
-                                  model_dtype=args.model_dtype, **kw)
+                                  model_dtype=args.model_dtype,
+                                  remat=args.remat, **kw)
         emit({'phase_result': round(ms, 2), 'mfu': mfu})
     else:
         ms, mfu = phase_step_leg(args.model, args.batch, args.image,
                                  args.phase, args.iters,
-                                 model_dtype=args.model_dtype, **kw)
+                                 model_dtype=args.model_dtype,
+                                 remat=args.remat, **kw)
         emit({'phase_result': round(ms, 2), 'mfu': mfu})
 
 
@@ -348,12 +353,14 @@ def run_phase(args):
 
 def spawn_phase(phase, model, batch, image, iters, bf16=False,
                 inverse_method=None, model_dtype=None,
-                factor_batch_fraction=None):
+                factor_batch_fraction=None, remat=False):
     cmd = [sys.executable, os.path.abspath(__file__), '--phase', phase,
            '--model', model, '--batch', str(batch), '--image', str(image),
            '--iters', str(iters)]
     if model_dtype:
         cmd += ['--model-dtype', model_dtype]
+    if remat:
+        cmd.append('--remat')
     if bf16:
         cmd.append('--bf16-factors')
     if inverse_method:
@@ -391,10 +398,11 @@ def config2(args):
         rows[mode], mfus[mode] = spawn_phase(
             mode, args.model, args.batch, args.image, args.iters,
             model_dtype=args.model_dtype,
-            factor_batch_fraction=args.factor_batch_fraction)
+            factor_batch_fraction=args.factor_batch_fraction,
+            remat=args.remat)
         emit({'config': 2, 'phase': mode, 'batch': args.batch,
-              'image': args.image, 'ms_per_iter': rows[mode],
-              'mfu': mfus.get(mode)})
+              'image': args.image, 'remat': args.remat,
+              'ms_per_iter': rows[mode], 'mfu': mfus.get(mode)})
     # The monolithic capture+factors+inverse program exceeds the compile
     # limit (tried each round; poisons the session) — the firing is
     # measured standalone instead, which IS the production execution
@@ -434,8 +442,9 @@ def config2(args):
             # (schema 1) semantics — cross-round comparisons must key
             # on this field (ADVICE r4).
             out = {'config': 2, 'row_schema': 2,
-                   'workload': f'{args.model}_imagenet{args.image}'
-                               f'_b{args.batch}',
+                   'workload': (f'{args.model}_imagenet{args.image}'
+                                f'_b{args.batch}'
+                                + ('_remat' if args.remat else '')),
                    'unit': 'ms/iter', 'sgd': rows['sgd'],
                    'mfu_sgd': mfus.get('sgd'),
                    'every_iter': base,
@@ -489,6 +498,10 @@ def main(argv=None):
     p.add_argument('--phase', default=None,
                    help='internal: run a single measurement leg')
     p.add_argument('--bf16-factors', action='store_true')
+    p.add_argument('--remat', action='store_true',
+                   help='block-level gradient checkpointing on the '
+                        'model (fits monolithic b128+ @224 bf16 with '
+                        'K-FAC capture; round-5 study)')
     p.add_argument('--model-dtype', default=None,
                    choices=['fp32', 'bf16'],
                    help='model compute dtype for the step legs; bf16 = '

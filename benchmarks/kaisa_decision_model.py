@@ -1,0 +1,261 @@
+"""KAISA comm-strategy decision model: which comm_method at which scale?
+
+The reference exposes COMM_OPT / MEM_OPT / HYBRID_OPT and leaves the
+choice to the user (kfac/preconditioner.py:235-259); the KAISA paper
+frames it as a memory/communication tradeoff but publishes no decision
+rule. SURVEY.md §7 flags the open question for TPU: on fast ICI, does
+sharding inverse state (MEM/HYBRID) ever *pay*, or does the gather /
+psum traffic cost more than the memory it saves?
+
+This model answers it quantitatively from this framework's OWN
+communication structure (parallel/distributed.py), calibrated with
+on-chip measured leg times (FLAGSHIP_r04/r05) and parameterized by the
+interconnect. Volumes per device per step, for world W split as
+R inverse groups x C grad workers (COMM_OPT: R=1, C=W; MEM_OPT: R=W,
+C=1; HYBRID f=C/W):
+
+  data-parallel grad pmean    2 * (W-1)/W * B_params      every step
+  factor pmean                2 * (W-1)/W * B_factors     every 1/Tf
+  inverse all_gather (gw)     (C-1)/C * B_inv / R         every 1/Ti
+  precond-grad psum (ig)      2 * (R-1)/R * B_grads       every step
+
+(ring-collective per-device wire bytes; B_inv/R because each inverse
+group's stack holds only its own layers' inverses — layers are
+LPT-balanced over rows, assign_work()). Compute per device per step:
+
+  fwd/bwd + every-iter K-FAC   measured leg (cadence-composed)
+  decompositions               T_fire / (R*C) / Ti   (the bucket stack
+                               is row- AND column-sharded: every device
+                               decomposes slots_per_col slots)
+  precondition matmuls         T_precond / R          (row-sharded,
+                               shard_precond_compute=True)
+
+So in THIS design the decomposition FLOPs shard over the full mesh for
+every strategy — the strategies differ only in wire bytes and in
+inverse-state memory per device (COMM_OPT replicates all inverse
+stacks within a row of size W; MEM_OPT stores 1/W per device). That is
+exactly the KAISA tradeoff, with the reference's "grad worker
+fraction" reinterpreted for SPMD.
+
+Usage:
+    python benchmarks/kaisa_decision_model.py \
+        [--ici-gbps 40] [--dcn-gbps 3] [--out KAISA_DECISION.json]
+
+The bandwidth defaults are PARAMETERS, not measurements (one real chip
+here — no ICI to measure): 40 GB/s effective per-device allreduce
+bandwidth is a conservative public v4-class ICI figure; 3 GB/s is a
+DCN-class figure consistent with the COMM_MULTIHOST.json gloo ordering.
+Re-run with your pod's measured numbers to recompute the verdict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+
+def factor_set(which: str):
+    """Per-layer (a_dim, g_dim) + param bytes for a tracked workload.
+
+    Dims derive from kernel shapes only (spatial-independent), so the
+    registration trace runs at a small image / short sequence.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_kfac_pytorch_tpu import KFAC
+
+    if which == 'resnet50':
+        from distributed_kfac_pytorch_tpu.models import imagenet_resnet
+        model = imagenet_resnet.get_model('resnet50')
+        kfac = KFAC(model)
+        variables, _ = kfac.init(jax.random.PRNGKey(0),
+                                 jnp.zeros((2, 64, 64, 3)))
+    elif which == 'lm':
+        from distributed_kfac_pytorch_tpu.models import transformer_lm
+        model = transformer_lm.get_model(vocab_size=32768, size='base',
+                                         max_len=1024)
+        kfac = KFAC(model)
+        variables, _ = kfac.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((2, 64), jnp.int32), train=False)
+    else:
+        raise ValueError(which)
+    import distributed_kfac_pytorch_tpu.layers.base as L
+
+    shapes = {}
+    for name, spec in kfac.specs.items():
+        node = variables['params']
+        for part in spec.path:
+            node = node[part]
+        shapes[name] = (L.factor_shapes(spec, node), spec.kind)
+    n_params = sum(x.size for x in jax.tree.leaves(variables['params']))
+    return kfac, shapes, n_params
+
+
+def volumes(kfac, shapes, n_params, *, fdt_bytes=4, idt_bytes=4):
+    """Static byte/flop totals the strategy costs scale from."""
+    B_params = n_params * 4
+    B_factors = B_grads = 0
+    B_inv = 0
+    decomp_flops = 0
+    precond_flops_per_item = 0
+    for name, ((a, g), kind) in shapes.items():
+        if kind == 'embedding':
+            # Diagonal A (vector factor + elementwise inverse); G is a
+            # dense g x g factor with a full decomposed inverse like any
+            # other layer (preconditioner.init_state).
+            B_factors += (a + g * g) * fdt_bytes
+            B_inv += a * idt_bytes
+            dims = (g,)
+            B_grads += a * g * 4
+            precond_flops_per_item += 2 * (g * g * a + a * g)
+        else:
+            B_factors += (a * a + g * g) * fdt_bytes
+            B_grads += a * g * 4
+            dims = (a, g)
+            # Precondition: G_side @ grad @ A_side twice-ish.
+            precond_flops_per_item += 2 * (g * g * a + a * a * g)
+        for d in dims:
+            method = kfac.method_for_dim(d)
+            if method == 'eigen':
+                # Q + eigenvalues.
+                B_inv += (d * d + d) * idt_bytes
+                decomp_flops += 8 * d ** 3  # polish-iter matmul budget
+            else:
+                B_inv += d * d * idt_bytes
+                decomp_flops += d ** 3 / 3  # Cholesky
+    return {'B_params': B_params, 'B_factors': B_factors,
+            'B_inv': B_inv, 'B_grads': B_grads,
+            'decomp_flops': decomp_flops,
+            'precond_flops': precond_flops_per_item}
+
+
+def strategy_cost(vol, W, C, Tf, Ti, *, gbps, base_ms, factor_extra_ms,
+                  fire_ms_1dev, precond_ms_1dev):
+    """Predicted ms/step/device for a (W, C) layout at cadence (Tf, Ti).
+
+    base_ms: measured single-chip non-factor K-FAC step (fwd/bwd +
+    precondition replicated + KL clip). The replicated precondition in
+    that leg is swapped for the row-sharded share.
+    """
+    R = W // C
+    bw = gbps * 1e9
+    comm_s = 2 * (W - 1) / W * vol['B_params'] / bw
+    comm_s += 2 * (W - 1) / W * vol['B_factors'] / bw / Tf
+    if C > 1:
+        comm_s += (C - 1) / C * vol['B_inv'] / R / bw / Ti
+    if R > 1:
+        comm_s += 2 * (R - 1) / R * vol['B_grads'] / bw
+    # Compute: measured legs, resharded.
+    fire_ms = fire_ms_1dev / (R * C) / Ti
+    # precond leg was measured replicated (R=1 equivalent): sharing
+    # over R rows saves (1 - 1/R) of it.
+    precond_ms = precond_ms_1dev * (1 / R - 1)
+    total = (base_ms + factor_extra_ms / Tf + fire_ms + precond_ms
+             + comm_s * 1e3)
+    return {'ms_per_step': round(total, 3),
+            'comm_ms': round(comm_s * 1e3, 3),
+            'fire_ms_amortized': round(fire_ms, 3),
+            'inv_bytes_per_dev': int(vol['B_inv'] / R)}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--ici-gbps', type=float, default=40.0,
+                   help='effective per-device allreduce bandwidth '
+                        '(PARAMETER, not a measurement)')
+    p.add_argument('--dcn-gbps', type=float, default=3.0)
+    p.add_argument('--workload', default='resnet50',
+                   choices=['resnet50', 'lm'])
+    # Measured single-chip legs (defaults: FLAGSHIP_r04 224px b64 bf16
+    # session 'r4-gated-capture'; override with a newer session's).
+    p.add_argument('--base-ms', type=float, default=31.31,
+                   help='measured non-factor K-FAC step ms (nofactor '
+                        'leg)')
+    p.add_argument('--factor-extra-ms', type=float, default=23.84,
+                   help='measured factor-step premium over the '
+                        'non-factor step')
+    p.add_argument('--fire-ms', type=float, default=136.9,
+                   help="measured single-chip 'auto' inverse firing ms")
+    p.add_argument('--precond-ms', type=float, default=2.0,
+                   help='measured precondition+clip premium (the '
+                        'replicated share a row-sharded layout divides)')
+    p.add_argument('--out', default='KAISA_DECISION.json')
+    args = p.parse_args(argv)
+
+    kfac, shapes, n_params = factor_set(args.workload)
+    vol = volumes(kfac, shapes, n_params)
+
+    cadences = {'imagenet_default_f10_i100': (10, 100),
+                'production_f50_i500': (50, 500)}
+    worlds = [8, 16, 32, 64, 256]
+    rows = []
+    for W in worlds:
+        for label, gbps in (('ici', args.ici_gbps),
+                            ('dcn', args.dcn_gbps)):
+            for cad_name, (Tf, Ti) in cadences.items():
+                per = {}
+                layouts = {'comm_opt(C=W)': W, 'hybrid(C=W/2)': W // 2,
+                           'hybrid(C=W/4)': max(W // 4, 1),
+                           'mem_opt(C=1)': 1}
+                for sname, C in layouts.items():
+                    if C < 1 or W % C:
+                        continue
+                    per[sname] = strategy_cost(
+                        vol, W, C, Tf, Ti, gbps=gbps,
+                        base_ms=args.base_ms,
+                        factor_extra_ms=args.factor_extra_ms,
+                        fire_ms_1dev=args.fire_ms,
+                        precond_ms_1dev=args.precond_ms)
+                best = min(per, key=lambda k: per[k]['ms_per_step'])
+                rows.append({'world': W, 'link': label, 'gbps': gbps,
+                             'cadence': cad_name, 'best': best,
+                             'strategies': per})
+
+    result = {
+        'workload': args.workload,
+        'n_layers': len(shapes),
+        'n_params': n_params,
+        'volumes_bytes': {k: int(v) for k, v in vol.items()
+                          if k.startswith('B_')},
+        'measured_leg_inputs': {
+            'base_ms': args.base_ms,
+            'factor_extra_ms': args.factor_extra_ms,
+            'fire_ms_1dev': args.fire_ms,
+            'precond_ms_1dev': args.precond_ms},
+        'bandwidth_parameters_note':
+            'ici/dcn GB/s are PARAMETERS (no multi-chip interconnect '
+            'exists in this environment); re-run with measured pod '
+            'numbers to recompute',
+        'model': 'see benchmarks/kaisa_decision_model.py docstring',
+        'rows': rows,
+    }
+    with open(args.out, 'w') as f:
+        json.dump(result, f, indent=1)
+
+    # Human-readable verdict table.
+    print(f'workload={args.workload} layers={len(shapes)} '
+          f'params={n_params/1e6:.1f}M')
+    print(f"bytes: factors={vol['B_factors']/1e6:.1f}MB "
+          f"inv={vol['B_inv']/1e6:.1f}MB grads={vol['B_grads']/1e6:.1f}MB "
+          f"params={vol['B_params']/1e6:.1f}MB")
+    for r in rows:
+        if r['cadence'].startswith('production') and r['link'] == 'ici':
+            per = {k: v['ms_per_step'] for k, v in r['strategies'].items()}
+            print(f"W={r['world']:>3} {r['link']} {r['cadence']}: "
+                  f"best={r['best']}  " +
+                  ' '.join(f'{k}={v}' for k, v in sorted(per.items())))
+    v64 = [r for r in rows if r['world'] == 64 and r['link'] == 'ici'
+           and r['cadence'].startswith('production')][0]
+    print(json.dumps({'verdict_v64_ici': v64['best'],
+                      'strategies': {k: v['ms_per_step'] for k, v in
+                                     v64['strategies'].items()}}))
+
+
+if __name__ == '__main__':
+    main()

@@ -20,7 +20,8 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 
-def _norm_layer(norm: str, train: bool, dtype, name: str):
+def _norm_layer(norm: str, train: bool, dtype, name: str,
+                bn_momentum: float = 0.9):
     """BatchNorm (reference parity) or GroupNorm (stateless control).
 
     The 'group' variant exists for the convergence methodology: BN's
@@ -29,11 +30,17 @@ def _norm_layer(norm: str, train: bool, dtype, name: str):
     negative); GroupNorm has no cross-step state, so a GN run isolates
     whether BN statistics — not the preconditioner — drive the
     oscillation. 8 groups (standard; >= 2 channels/group at planes=16).
+
+    ``bn_momentum`` is the running-statistics EWMA coefficient (flax
+    convention: new = m*old + (1-m)*batch; 0.9 here matches the torch
+    reference's momentum=0.1 default). Tunable because under K-FAC's
+    large preconditioned steps the stats-lag timescale 1/(1-m) is a
+    convergence knob (round-5 BN study).
     """
     if norm == 'group':
         return nn.GroupNorm(num_groups=8, dtype=dtype, name=name)
-    return nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                        dtype=dtype, name=name)
+    return nn.BatchNorm(use_running_average=not train,
+                        momentum=bn_momentum, dtype=dtype, name=name)
 
 
 class BasicBlock(nn.Module):
@@ -47,6 +54,7 @@ class BasicBlock(nn.Module):
     stride: int = 1
     dtype: jnp.dtype = jnp.float32
     norm: str = 'batch'
+    bn_momentum: float = 0.9
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -55,13 +63,15 @@ class BasicBlock(nn.Module):
                     padding=1, use_bias=False, dtype=self.dtype,
                     kernel_init=nn.initializers.kaiming_normal(),
                     name='conv1')(x)
-        y = _norm_layer(self.norm, train, self.dtype, 'bn1')(y)
+        y = _norm_layer(self.norm, train, self.dtype, 'bn1',
+                        self.bn_momentum)(y)
         y = nn.relu(y)
         y = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False,
                     dtype=self.dtype,
                     kernel_init=nn.initializers.kaiming_normal(),
                     name='conv2')(y)
-        y = _norm_layer(self.norm, train, self.dtype, 'bn2')(y)
+        y = _norm_layer(self.norm, train, self.dtype, 'bn2',
+                        self.bn_momentum)(y)
         if self.stride != 1 or in_planes != self.planes:
             # Option A: subsample spatially, zero-pad channels (NHWC).
             sc = x[:, ::2, ::2, :]
@@ -82,19 +92,22 @@ class CifarResNet(nn.Module):
     num_classes: int = 10
     dtype: jnp.dtype = jnp.float32
     norm: str = 'batch'
+    bn_momentum: float = 0.9
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         y = nn.Conv(16, (3, 3), padding=1, use_bias=False, dtype=self.dtype,
                     kernel_init=nn.initializers.kaiming_normal(),
                     name='conv1')(x)
-        y = _norm_layer(self.norm, train, self.dtype, 'bn1')(y)
+        y = _norm_layer(self.norm, train, self.dtype, 'bn1',
+                        self.bn_momentum)(y)
         y = nn.relu(y)
         for stage, (planes, stride) in enumerate(
                 zip((16, 32, 64), (1, 2, 2)), start=1):
             for i in range(self.num_blocks[stage - 1]):
                 y = BasicBlock(planes, stride if i == 0 else 1,
                                dtype=self.dtype, norm=self.norm,
+                               bn_momentum=self.bn_momentum,
                                name=f'layer{stage}_block{i}')(y, train=train)
         y = jnp.mean(y, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=self.dtype,
@@ -108,17 +121,19 @@ _DEPTHS = {20: (3, 3, 3), 32: (5, 5, 5), 44: (7, 7, 7), 56: (9, 9, 9),
 
 def resnet(depth: int, num_classes: int = 10,
            dtype: jnp.dtype = jnp.float32,
-           norm: str = 'batch') -> CifarResNet:
+           norm: str = 'batch',
+           bn_momentum: float = 0.9) -> CifarResNet:
     """CIFAR ResNet by depth (20/32/44/56/110/1202)."""
     if depth not in _DEPTHS:
         raise ValueError(f'unsupported CIFAR ResNet depth {depth}; '
                          f'choose from {sorted(_DEPTHS)}')
     return CifarResNet(num_blocks=_DEPTHS[depth], num_classes=num_classes,
-                       dtype=dtype, norm=norm)
+                       dtype=dtype, norm=norm, bn_momentum=bn_momentum)
 
 
 def get_model(name: str, num_classes: int = 10,
-              dtype: jnp.dtype = jnp.float32) -> CifarResNet:
+              dtype: jnp.dtype = jnp.float32,
+              bn_momentum: float = 0.9) -> CifarResNet:
     """Model by name, e.g. 'resnet32' (reference cifar_resnet.py:40-51);
     a 'gn' suffix ('resnet20gn') swaps BatchNorm for GroupNorm (the
     stateless-normalization control used by the convergence study)."""
@@ -128,4 +143,5 @@ def get_model(name: str, num_classes: int = 10,
     norm = 'batch'
     if name.endswith('gn'):
         norm, name = 'group', name[:-2]
-    return resnet(int(name[len('resnet'):]), num_classes, dtype, norm)
+    return resnet(int(name[len('resnet'):]), num_classes, dtype, norm,
+                  bn_momentum)

@@ -21,8 +21,8 @@ import jax.numpy as jnp
 _KAIMING = nn.initializers.kaiming_normal()
 
 
-def _bn(train: bool, dtype, name: str):
-    return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+def _bn(train: bool, dtype, name: str, momentum: float = 0.9):
+    return nn.BatchNorm(use_running_average=not train, momentum=momentum,
                         epsilon=1e-5, dtype=dtype, name=name)
 
 
@@ -32,6 +32,7 @@ class BasicBlockV1(nn.Module):
     planes: int
     stride: int = 1
     dtype: jnp.dtype = jnp.float32
+    bn_momentum: float = 0.9
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -39,16 +40,17 @@ class BasicBlockV1(nn.Module):
         y = nn.Conv(self.planes, (3, 3), (self.stride, self.stride),
                     padding=1, use_bias=False, dtype=self.dtype,
                     kernel_init=_KAIMING, name='conv1')(x)
-        y = _bn(train, self.dtype, 'bn1')(y)
+        y = _bn(train, self.dtype, 'bn1', self.bn_momentum)(y)
         y = nn.relu(y)
         y = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False,
                     dtype=self.dtype, kernel_init=_KAIMING, name='conv2')(y)
-        y = _bn(train, self.dtype, 'bn2')(y)
+        y = _bn(train, self.dtype, 'bn2', self.bn_momentum)(y)
         if self.stride != 1 or x.shape[-1] != self.planes:
             sc = nn.Conv(self.planes, (1, 1), (self.stride, self.stride),
                          use_bias=False, dtype=self.dtype,
                          kernel_init=_KAIMING, name='downsample_conv')(x)
-            sc = _bn(train, self.dtype, 'downsample_bn')(sc)
+            sc = _bn(train, self.dtype, 'downsample_bn',
+                     self.bn_momentum)(sc)
         return nn.relu(y + sc)
 
 
@@ -59,6 +61,7 @@ class Bottleneck(nn.Module):
     stride: int = 1
     dtype: jnp.dtype = jnp.float32
     expansion: int = 4
+    bn_momentum: float = 0.9
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -66,19 +69,20 @@ class Bottleneck(nn.Module):
         sc = x
         y = nn.Conv(self.planes, (1, 1), use_bias=False, dtype=self.dtype,
                     kernel_init=_KAIMING, name='conv1')(x)
-        y = nn.relu(_bn(train, self.dtype, 'bn1')(y))
+        y = nn.relu(_bn(train, self.dtype, 'bn1', self.bn_momentum)(y))
         y = nn.Conv(self.planes, (3, 3), (self.stride, self.stride),
                     padding=1, use_bias=False, dtype=self.dtype,
                     kernel_init=_KAIMING, name='conv2')(y)
-        y = nn.relu(_bn(train, self.dtype, 'bn2')(y))
+        y = nn.relu(_bn(train, self.dtype, 'bn2', self.bn_momentum)(y))
         y = nn.Conv(out_planes, (1, 1), use_bias=False, dtype=self.dtype,
                     kernel_init=_KAIMING, name='conv3')(y)
-        y = _bn(train, self.dtype, 'bn3')(y)
+        y = _bn(train, self.dtype, 'bn3', self.bn_momentum)(y)
         if self.stride != 1 or x.shape[-1] != out_planes:
             sc = nn.Conv(out_planes, (1, 1), (self.stride, self.stride),
                          use_bias=False, dtype=self.dtype,
                          kernel_init=_KAIMING, name='downsample_conv')(x)
-            sc = _bn(train, self.dtype, 'downsample_bn')(sc)
+            sc = _bn(train, self.dtype, 'downsample_bn',
+                     self.bn_momentum)(sc)
         return nn.relu(y + sc)
 
 
@@ -95,20 +99,32 @@ class ImageNetResNet(nn.Module):
     # single-core-compilable program sizes (tests/test_flagship.py's
     # narrow variant).
     width: int = 64
+    bn_momentum: float = 0.9
+    # Block-granularity gradient checkpointing: each residual block's
+    # activations are rematerialized in the backward pass, trading
+    # ~1/3 extra forward FLOPs for O(depth) activation memory — the
+    # standard TPU recipe for fitting larger monolithic batches (the
+    # bf16 K-FAC capture path OOMs at b128@224 without it; round 5).
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         y = nn.Conv(self.width, (7, 7), (2, 2), padding=3, use_bias=False,
                     dtype=self.dtype, kernel_init=_KAIMING, name='conv1')(x)
-        y = nn.relu(_bn(train, self.dtype, 'bn1')(y))
+        y = nn.relu(_bn(train, self.dtype, 'bn1', self.bn_momentum)(y))
         y = nn.max_pool(y, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         block = Bottleneck if self.bottleneck else BasicBlockV1
+        if self.remat:
+            # static_argnums: `train` is a Python bool, not a tracer
+            # (flax counts the module itself as arg 0, x as 1, train 2).
+            block = nn.remat(block, static_argnums=(2,))
         for stage, n_blocks in enumerate(self.stage_sizes, start=1):
             planes = self.width * 2 ** (stage - 1)
             for i in range(n_blocks):
                 stride = 2 if (stage > 1 and i == 0) else 1
                 y = block(planes, stride, dtype=self.dtype,
-                          name=f'layer{stage}_block{i}')(y, train=train)
+                          bn_momentum=self.bn_momentum,
+                          name=f'layer{stage}_block{i}')(y, train)
         y = jnp.mean(y, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=self.dtype,
                         kernel_init=_KAIMING, name='fc')(y)
@@ -124,20 +140,26 @@ _CONFIGS = {
 
 
 def resnet(depth: int, num_classes: int = 1000,
-           dtype: jnp.dtype = jnp.float32) -> ImageNetResNet:
+           dtype: jnp.dtype = jnp.float32,
+           bn_momentum: float = 0.9,
+           remat: bool = False) -> ImageNetResNet:
     """ImageNet ResNet by depth (18/34/50/101/152)."""
     if depth not in _CONFIGS:
         raise ValueError(f'unsupported ImageNet ResNet depth {depth}; '
                          f'choose from {sorted(_CONFIGS)}')
     sizes, bottleneck = _CONFIGS[depth]
     return ImageNetResNet(stage_sizes=sizes, bottleneck=bottleneck,
-                          num_classes=num_classes, dtype=dtype)
+                          num_classes=num_classes, dtype=dtype,
+                          bn_momentum=bn_momentum, remat=remat)
 
 
 def get_model(name: str, num_classes: int = 1000,
-              dtype: jnp.dtype = jnp.float32) -> ImageNetResNet:
+              dtype: jnp.dtype = jnp.float32,
+              bn_momentum: float = 0.9,
+              remat: bool = False) -> ImageNetResNet:
     """Model by name, e.g. 'resnet50' (reference uses torchvision names)."""
     name = name.lower()
     if not name.startswith('resnet'):
         raise ValueError(f'unknown ImageNet model {name!r}')
-    return resnet(int(name[len('resnet'):]), num_classes, dtype)
+    return resnet(int(name[len('resnet'):]), num_classes, dtype,
+                  bn_momentum, remat)

@@ -130,6 +130,11 @@ def get_model(vocab_size: int, size: str = 'small',
         'tiny': dict(d_model=128, num_layers=2, num_heads=4),
         'small': dict(d_model=512, num_layers=6, num_heads=8),
         'base': dict(d_model=768, num_layers=12, num_heads=12),
+        # Transformer-XL large shape (d1024, 18 layers, FFN 4096 —
+        # BASELINE config 4's "Transformer-XL-style"): the factor set
+        # straddles the 640 eigen/cholesky dispatch cutoff (q/k/v/o
+        # A factors 1025, MLP A factors 1025/4097, G 1024/4096).
+        'xl': dict(d_model=1024, num_layers=18, num_heads=16),
     }
     if size not in configs:
         raise ValueError(f'unknown size {size!r}; have {sorted(configs)}')

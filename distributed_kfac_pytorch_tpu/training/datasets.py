@@ -263,7 +263,9 @@ def epoch_batches(x: np.ndarray, y: np.ndarray, batch_size: int, *,
 
     Replaces the reference's DistributedSampler (datasets.py:57-63): under
     GSPMD there is one logical batch per step; per-epoch reshuffling is
-    seeded like ``sampler.set_epoch`` for reproducibility.
+    seeded like ``sampler.set_epoch`` for reproducibility. Truncate with
+    ``itertools.islice`` when only a few batches are needed (e.g. the
+    precise-BN recalibration pass).
     """
     n = x.shape[0]
     rng = np.random.default_rng(np.random.SeedSequence([seed, epoch]))

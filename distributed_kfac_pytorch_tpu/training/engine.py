@@ -318,6 +318,119 @@ def make_eval_step(model, loss_fn, mesh=None, *,
     return jax.jit(step)
 
 
+def make_precise_bn_steps(model, mesh=None, *, model_args_fn=None,
+                          stats_col: str = 'batch_stats'):
+    """Jitted helpers for precise-BN recalibration (see
+    :func:`precise_bn_recalibrate`); build once, reuse every epoch.
+
+    Returns ``(momentum_fn, stat_fn)``:
+
+    - ``momentum_fn(params, others, batch)`` extracts each BatchNorm
+      leaf's EWMA momentum from the model itself by running the stats
+      update from all-zeros and all-ones starting points (flax
+      semantics: ``new = m*old + (1-m)*batch_stat`` is affine in
+      ``old``, so ``u1 - u0 == m`` exactly, elementwise). This avoids
+      requiring the caller to know every BN layer's momentum — any
+      flax model with standard BatchNorm semantics works.
+    - ``stat_fn(params, others, batch, m)`` returns that batch's raw
+      statistics ``u0 / (1 - m)`` (mesh: ``pmean`` over the K-FAC data
+      axes, i.e. the average of per-shard batch statistics).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if model_args_fn is None:
+        model_args_fn = lambda batch: (batch[0],)
+
+    def updated(params, others, stats0, batch):
+        _, upd = model.apply({'params': params, **others,
+                              stats_col: stats0},
+                             *model_args_fn(batch), mutable=[stats_col])
+        return upd[stats_col]
+
+    def momentum(params, others, batch, zeros, ones):
+        u0 = updated(params, others, zeros, batch)
+        u1 = updated(params, others, ones, batch)
+        return jax.tree.map(
+            lambda a, b: jnp.clip(b - a, 0.0, 1.0 - 1e-6), u0, u1)
+
+    def stat(params, others, batch, m, zeros):
+        u0 = updated(params, others, zeros, batch)
+        s = jax.tree.map(lambda u, mm: u / (1.0 - mm), u0, m)
+        if mesh is not None:
+            s = jax.lax.pmean(s, KFAC_AXES)
+        return s
+
+    def wrap(fn, n_batch_arg):
+        if mesh is None:
+            return jax.jit(fn)
+
+        def sharded(*args):
+            in_specs = tuple(
+                jax.tree.map(lambda _: P(KFAC_AXES), a)
+                if i == n_batch_arg else _replicated_specs(a)
+                for i, a in enumerate(args))
+            # Both fns return a stats-shaped tree (arg 3's structure);
+            # eval_shape can't trace fn here (the pmean needs the mesh).
+            return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=_replicated_specs(args[3]),
+                                 check_vma=False)(*args)
+
+        return jax.jit(sharded)
+
+    return wrap(momentum, 2), wrap(stat, 2)
+
+
+def precise_bn_recalibrate(model, params, extra_vars: dict,
+                           batches: Iterable, mesh=None, *,
+                           model_args_fn=None,
+                           stats_col: str = 'batch_stats',
+                           steps=None) -> dict:
+    """Re-estimate BatchNorm running statistics as the plain average of
+    per-batch statistics over ``batches`` ("precise BN").
+
+    Why: under K-FAC's large preconditioned steps the EWMA running
+    statistics lag the weights, so eval-time normalization is stale —
+    the round-3/4 convergence studies isolated exactly this interaction
+    as the BN conv-net instability (GroupNorm control wins decisively;
+    CONVERGENCE_CONV_{BN,GN}.json). A handful of forward-only batches
+    re-estimates the statistics at the *current* weights, which is
+    cheap (no backward pass) and touches nothing else: training state,
+    params and optimizer are unchanged. The reference has no analogue —
+    its eval loop consumes whatever running stats training left behind
+    (examples/cnn_utils/engine.py:96-125).
+
+    Models without a ``stats_col`` collection (GroupNorm nets) pass
+    through unchanged. Returns a new ``extra_vars``; callers decide
+    whether to use it for eval only or adopt it into training state.
+    ``steps`` accepts the pair from :func:`make_precise_bn_steps` to
+    reuse compiled programs across epochs.
+    """
+    stats = extra_vars.get(stats_col)
+    if not stats:
+        return extra_vars
+    # Only dict-shaped entries are flax variable collections the model
+    # can consume; framework state riding in extra_vars (e.g. the fp16
+    # loss-scale pytree) is not passed to apply.
+    others = {k: v for k, v in extra_vars.items()
+              if k != stats_col and isinstance(v, dict)}
+    momentum_fn, stat_fn = steps or make_precise_bn_steps(
+        model, mesh, model_args_fn=model_args_fn, stats_col=stats_col)
+    zeros = jax.tree.map(jnp.zeros_like, stats)
+    ones = jax.tree.map(jnp.ones_like, stats)
+    m = None
+    total, n = None, 0
+    for batch in batches:
+        if m is None:
+            m = momentum_fn(params, others, batch, zeros, ones)
+        s = stat_fn(params, others, batch, m, zeros)
+        total = s if total is None else jax.tree.map(jnp.add, total, s)
+        n += 1
+    if n == 0:
+        raise ValueError('precise_bn_recalibrate: zero batches provided')
+    new_stats = jax.tree.map(lambda t: t / n, total)
+    return {**extra_vars, stats_col: new_stats}
+
+
 def evaluate(eval_step, state: TrainState, batches: Iterable, *,
              log_writer=None, verbose: bool = False) -> dict[str, float]:
     """Run the eval loop; returns averaged metrics."""

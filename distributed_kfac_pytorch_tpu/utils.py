@@ -186,6 +186,33 @@ def disable_compilation_cache() -> None:
     jax.config.update('jax_compilation_cache_dir', None)
 
 
+def raise_cpu_collective_timeouts(terminate_s: int = 600,
+                                  warn_s: int = 120) -> None:
+    """Raise XLA-CPU collective rendezvous timeouts via XLA_FLAGS.
+
+    The virtual multi-device CPU mesh runs one thread per device on
+    however few cores the host has; under compile load a device thread
+    can be starved past XLA's default 40 s rendezvous termination
+    timeout, which kills the process with a Fatal check ("Expected N
+    threads to join the rendezvous...") — observed on the 1-core CI
+    host between epoch-boundary program variants. Must run BEFORE the
+    CPU backend initializes (XLA_FLAGS is read at backend init);
+    existing user-provided values for these flags win.
+    """
+    import os
+
+    flags = os.environ.get('XLA_FLAGS', '')
+    add = []
+    if '--xla_cpu_collective_call_terminate_timeout_seconds' not in flags:
+        add.append('--xla_cpu_collective_call_terminate_timeout_seconds'
+                   f'={terminate_s}')
+    if '--xla_cpu_collective_call_warn_stuck_timeout_seconds' not in flags:
+        add.append('--xla_cpu_collective_call_warn_stuck_timeout_seconds'
+                   f'={warn_s}')
+    if add:
+        os.environ['XLA_FLAGS'] = (flags + ' ' + ' '.join(add)).strip()
+
+
 def _multi_device_cpu_configured() -> str | None:
     """How this process is set up for a multi-device CPU backend (the
     configuration whose warm cache reads segfault) — decided from

@@ -65,6 +65,16 @@ def parse_args(argv=None):
     p.add_argument('--momentum', type=float, default=0.9)
     p.add_argument('--wd', type=float, default=5e-4)
     p.add_argument('--label-smoothing', type=float, default=0.0)
+    p.add_argument('--precise-bn-batches', type=int, default=0,
+                   help='re-estimate BN running statistics over this '
+                        'many forward-only train batches before each '
+                        'eval (precise-BN — the round-5 mitigation for '
+                        'BN stats lagging large preconditioned steps; '
+                        '0 = off). Eval-only: training EWMA state is '
+                        'untouched.')
+    p.add_argument('--bn-momentum', type=float, default=0.9,
+                   help='BatchNorm running-stat EWMA momentum (flax '
+                        'convention; 0.9 = torch momentum 0.1)')
     p.add_argument('--seed', type=int, default=42)
     p.add_argument('--no-resume', action='store_true')
     # K-FAC hyperparameters (reference torch_cifar10_resnet.py:67-97).
@@ -134,7 +144,8 @@ def main(argv=None):
     (train_x, train_y), (test_x, test_y) = datasets.get_cifar(args.data_dir)
     model = cifar_resnet.get_model(
         args.model,
-        dtype=jnp.float16 if args.fp16 else jnp.float32)
+        dtype=jnp.float16 if args.fp16 else jnp.float32,
+        bn_momentum=args.bn_momentum)
 
     cfg = optimizers.OptimConfig(
         base_lr=args.base_lr, momentum=args.momentum,
@@ -254,6 +265,8 @@ def main(argv=None):
     # rank-0 writer (reference engine.py:89-93); checkpoint saves stay
     # collective (orbax coordinates all hosts' shard writes).
     writer = engine.TensorBoardWriter(args.log_dir) if is_main else None
+    bn_steps = (engine.make_precise_bn_steps(model, mesh)
+                if args.precise_bn_batches > 0 else None)
     t_start = time.perf_counter()
     for epoch in range(start_epoch, args.epochs):
         lr = lr_schedule(epoch)
@@ -268,8 +281,24 @@ def main(argv=None):
         val_batches = launch.global_batches(mesh, datasets.epoch_batches(
             test_x, test_y, args.val_batch_size, shuffle=False,
             augment=False))
+        if args.precise_bn_batches > 0:
+            # Precise-BN: eval with stats re-estimated at the current
+            # weights; the training EWMA state is restored afterwards.
+            import itertools
+            recal = engine.precise_bn_recalibrate(
+                model, state.params, state.extra_vars,
+                launch.global_batches(mesh, itertools.islice(
+                    datasets.epoch_batches(
+                        train_x, train_y, args.batch_size,
+                        seed=args.seed, epoch=10_000 + epoch,
+                        augment=True),
+                    args.precise_bn_batches)),
+                mesh, steps=bn_steps)
+            train_extra, state.extra_vars = state.extra_vars, recal
         engine.evaluate(eval_step, state, val_batches,
                         log_writer=writer, verbose=is_main)
+        if args.precise_bn_batches > 0:
+            state.extra_vars = train_extra
         if kfac_sched:
             kfac_sched.step(epoch + 1)
         if (epoch + 1) % args.checkpoint_freq == 0 or \

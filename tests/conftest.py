@@ -18,6 +18,15 @@ if '--xla_force_host_platform_device_count' not in xla_flags:
     os.environ['XLA_FLAGS'] = (
         xla_flags + ' --xla_force_host_platform_device_count=8').strip()
 
+# 8 device threads on a 1-core host starve past XLA's default 40 s
+# collective rendezvous termination under compile load (fatal check in
+# rendezvous.cc) — raise the timeouts before backend init.
+from distributed_kfac_pytorch_tpu.utils import (  # noqa: E402
+    raise_cpu_collective_timeouts,
+)
+
+raise_cpu_collective_timeouts()
+
 import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')

@@ -8,6 +8,7 @@ counts (resnet50 25.56M).
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 import distributed_kfac_pytorch_tpu as kfac
@@ -106,3 +107,42 @@ def test_cifar_groupnorm_variant():
     out = gn.apply(v_gn, x, train=True)
     assert out.shape == (2, 10)
     assert bool(jnp.isfinite(out).all())
+
+
+def test_bn_momentum_and_remat_knobs():
+    """Round-5 knobs: `bn_momentum` must reach every BatchNorm (checked
+    via the running-stat update magnitude) and `remat=True` must leave
+    outputs and gradients identical to the plain model (block-level
+    rematerialization changes scheduling, not math)."""
+    x = jax.random.normal(jax.random.key(1), (4, 32, 32, 3))
+    # bn_momentum: after one train-mode apply from zero-initialized
+    # running means, new_mean = (1 - m) * batch_mean — so the stem BN's
+    # update magnitude scales exactly with (1 - m).
+    stats = {}
+    for m in (0.9, 0.5):
+        model = cifar_resnet.get_model('resnet20', bn_momentum=m)
+        v = model.init(jax.random.key(0), x)
+        _, upd = model.apply(v, x, mutable=['batch_stats'])
+        stats[m] = np.asarray(upd['batch_stats']['bn1']['mean'])
+    np.testing.assert_allclose(stats[0.5], stats[0.9] * (0.5 / 0.1),
+                               rtol=1e-5)
+
+    outs = {}
+    for remat in (False, True):
+        model = imagenet_resnet.ImageNetResNet(
+            stage_sizes=(1, 1, 1, 1), bottleneck=True, num_classes=10,
+            width=8, remat=remat)
+        v = model.init(jax.random.key(0), x)
+
+        def loss(p):
+            out, _ = model.apply(
+                {'params': p, 'batch_stats': v['batch_stats']}, x,
+                mutable=['batch_stats'])
+            return jnp.sum(out ** 2)
+
+        l, g = jax.value_and_grad(loss)(v['params'])
+        outs[remat] = (float(l), jax.tree.map(np.asarray, g))
+    assert np.isclose(outs[False][0], outs[True][0], rtol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                         atol=1e-7),
+                 outs[False][1], outs[True][1])
