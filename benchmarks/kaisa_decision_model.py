@@ -77,8 +77,8 @@ def factor_set(which: str):
                                  jnp.zeros((2, 64, 64, 3)))
     elif which == 'lm':
         from distributed_kfac_pytorch_tpu.models import transformer_lm
-        model = transformer_lm.get_model(vocab_size=32768, size='base',
-                                         max_len=1024)
+        model = transformer_lm.get_model(vocab_size=32768, size='xl',
+                                         max_len=1024, dropout=0.0)
         kfac = KFAC(model)
         variables, _ = kfac.init(
             jax.random.PRNGKey(0),

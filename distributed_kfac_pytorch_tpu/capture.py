@@ -40,6 +40,11 @@ PROBE_COL = 'kfac_probes'
 LINEAR = 'linear'
 CONV2D = 'conv2d'
 EMBEDDING = 'embedding'
+# Grouped/depthwise conv: per-group block-diagonal Fisher (round 5 —
+# BEYOND the reference, whose registry has no conv variant at all for
+# feature_group_count != 1, kfac/layers/__init__.py:13-36; this
+# framework preconditions MobileNet/EfficientNet-class models).
+CONV2D_GROUPED = 'conv2d_grouped'
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,13 +57,14 @@ class LayerSpec:
     2-D ``(out_dim, in_dim[+1])`` matrix form.
     """
     path: tuple[str, ...]          # module path == params subtree path
-    kind: str                      # LINEAR | CONV2D | EMBEDDING
+    kind: str                      # LINEAR | CONV2D | CONV2D_GROUPED | EMBEDDING
     has_bias: bool
     num_calls: int = 1             # calls per training step (e.g. timesteps)
-    # conv2d only:
+    # conv2d / conv2d_grouped only:
     kernel_size: tuple[int, ...] | None = None
     strides: tuple[int, ...] | None = None
     padding: Any = None
+    feature_group_count: int = 1   # conv2d_grouped: number of groups
     # embedding only:
     vocab_size: int | None = None
 
@@ -85,11 +91,9 @@ def _conv_decline_reason(mod: nn.Conv) -> str | None:
     reference's registry simply has no layer class for them either,
     kfac/layers/__init__.py:13-36 — but it *errors* on the module kinds
     it refuses, :31-33, where silence here would hide a partially
-    preconditioned model).
+    preconditioned model). Grouped/depthwise convs are SUPPORTED since
+    round 5 (per-group block-diagonal factors, kind CONV2D_GROUPED).
     """
-    if mod.feature_group_count != 1:
-        return (f'grouped/depthwise conv (feature_group_count='
-                f'{mod.feature_group_count})')
     dilation = mod.kernel_dilation
     if dilation is not None and any(
             d != 1 for d in (dilation if isinstance(dilation, Sequence)
@@ -154,11 +158,15 @@ def _spec_for_module(mod: nn.Module, path: tuple[str, ...],
             strides = (strides, strides)
         else:
             strides = tuple(strides)
-        return LayerSpec(path=path, kind=CONV2D, has_bias=mod.use_bias,
+        groups = mod.feature_group_count
+        return LayerSpec(path=path,
+                         kind=CONV2D if groups == 1 else CONV2D_GROUPED,
+                         has_bias=mod.use_bias,
                          num_calls=num_calls,
                          kernel_size=tuple(mod.kernel_size),
                          strides=strides,
-                         padding=_canonical_padding(mod.padding, 2))
+                         padding=_canonical_padding(mod.padding, 2),
+                         feature_group_count=groups)
     if isinstance(mod, nn.Embed):
         return LayerSpec(path=path, kind=EMBEDDING, has_bias=False,
                          num_calls=num_calls, vocab_size=mod.num_embeddings)

@@ -23,13 +23,14 @@ import jax.numpy as jnp
 
 from distributed_kfac_pytorch_tpu.capture import (
     CONV2D,
+    CONV2D_GROUPED,
     EMBEDDING,
     LINEAR,
     LayerSpec,
 )
 from distributed_kfac_pytorch_tpu.ops import factors as F
 
-KNOWN_KINDS = (LINEAR, CONV2D, EMBEDDING)
+KNOWN_KINDS = (LINEAR, CONV2D, CONV2D_GROUPED, EMBEDDING)
 
 
 def compute_a_factor(spec: LayerSpec, a_calls: Sequence[jax.Array],
@@ -52,6 +53,15 @@ def compute_a_factor(spec: LayerSpec, a_calls: Sequence[jax.Array],
             cur = F.conv2d_a_factor(a, spec.kernel_size, spec.strides,
                                     spec.padding, spec.has_bias,
                                     compute_dtype=compute_dtype)
+            out = cur if out is None else out + cur
+        return out
+    if spec.kind == CONV2D_GROUPED:
+        out = None
+        for a in a_calls:
+            cur = F.conv2d_grouped_a_factor(
+                a, spec.kernel_size, spec.strides, spec.padding,
+                spec.feature_group_count, spec.has_bias,
+                compute_dtype=compute_dtype)
             out = cur if out is None else out + cur
         return out
     if spec.kind == EMBEDDING:
@@ -78,6 +88,13 @@ def compute_g_factor(spec: LayerSpec, g_calls: Sequence[jax.Array],
             cur = F.conv2d_g_factor(g, compute_dtype=compute_dtype)
             out = cur if out is None else out + cur
         return out
+    if spec.kind == CONV2D_GROUPED:
+        out = None
+        for g in g_calls:
+            cur = F.conv2d_grouped_g_factor(
+                g, spec.feature_group_count, compute_dtype=compute_dtype)
+            out = cur if out is None else out + cur
+        return out
     raise ValueError(f'unknown layer kind {spec.kind!r}')
 
 
@@ -99,6 +116,18 @@ def grads_to_matrix(spec: LayerSpec, grads: dict) -> jax.Array:
         mat = k.reshape(-1, k.shape[-1]).T  # (cout, kh*kw*cin)
         if spec.has_bias:
             mat = jnp.concatenate([mat, grads['bias'][:, None]], axis=1)
+        return mat
+    if spec.kind == CONV2D_GROUPED:
+        # (kh, kw, cpg, cout) -> (G, cout/G, kh*kw*cpg [+1]): output
+        # channels are contiguous per group (XLA grouped-conv layout).
+        k = grads['kernel']
+        groups = spec.feature_group_count
+        d = k.shape[0] * k.shape[1] * k.shape[2]
+        cout = k.shape[-1]
+        mat = k.reshape(d, groups, cout // groups).transpose(1, 2, 0)
+        if spec.has_bias:
+            b = grads['bias'].reshape(groups, cout // groups, 1)
+            mat = jnp.concatenate([mat, b], axis=-1)
         return mat
     if spec.kind == EMBEDDING:
         # (vocab, dim): A is diagonal over vocab, G is (dim, dim).
@@ -122,6 +151,14 @@ def matrix_to_grads(spec: LayerSpec, mat: jax.Array,
             mat = mat[:, :-1]
         out['kernel'] = mat.T.reshape(like['kernel'].shape)
         return out
+    if spec.kind == CONV2D_GROUPED:
+        if spec.has_bias:
+            out['bias'] = mat[..., -1].reshape(like['bias'].shape)
+            mat = mat[..., :-1]
+        # (G, cout/G, d) -> (d, G, cout/G) -> (kh, kw, cpg, cout)
+        out['kernel'] = mat.transpose(2, 0, 1).reshape(
+            like['kernel'].shape)
+        return out
     if spec.kind == EMBEDDING:
         out['embedding'] = mat.reshape(like['embedding'].shape)
         return out
@@ -142,6 +179,12 @@ def factor_shapes(spec: LayerSpec, params: dict) -> tuple[int, int]:
     if spec.kind == CONV2D:
         kh, kw, cin, cout = params['kernel'].shape
         return kh * kw * cin + int(spec.has_bias), cout
+    if spec.kind == CONV2D_GROUPED:
+        # PER-GROUP dims; the layer carries feature_group_count stacked
+        # (da, da)/(dg, dg) blocks rather than one dense factor pair.
+        kh, kw, cpg, cout = params['kernel'].shape
+        return (kh * kw * cpg + int(spec.has_bias),
+                cout // spec.feature_group_count)
     if spec.kind == EMBEDDING:
         vocab, dim = params['embedding'].shape
         return vocab, dim  # A is diagonal (vector of length vocab)

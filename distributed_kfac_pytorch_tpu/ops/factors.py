@@ -591,6 +591,93 @@ def conv2d_a_factor(a: jax.Array, kernel_size, strides, padding,
     return _assemble_bias_factor(cov, bias_col, 1.0 / (spatial * spatial))
 
 
+def conv2d_grouped_a_factor(a: jax.Array, kernel_size, strides, padding,
+                            groups: int, has_bias: bool,
+                            compute_dtype=None) -> jax.Array:
+    """Per-group A factors for a grouped/depthwise conv: (G, da, da).
+
+    Grouped convolution's Fisher block is block-diagonal over groups
+    (group g's outputs see only its ``cin/G`` input channels), so the
+    K-FAC approximation factorizes per group: ``A_g`` is the patch
+    covariance restricted to group g's channels, with the same
+    normalization as :func:`conv2d_a_factor` (cov over ``B*OH*OW`` rows
+    of patches pre-divided by the spatial size). ``da = kh*kw*(cin/G)
+    [+1]``. For depthwise convs (G = cin) each block is a tiny
+    ``(kh*kw [+1])``-dim matrix — the standard K-FAC depthwise
+    treatment, batched into one stacked einsum + (downstream) one
+    batched damped inverse.
+
+    No reference analogue: the reference's layer registry has no conv
+    variant for ``feature_group_count != 1``
+    (kfac/layers/__init__.py:13-36).
+    """
+    kh, kw = kernel_size
+    c = a.shape[-1]
+    if c % groups:
+        raise ValueError(f'{c=} channels not divisible by {groups=}')
+    cpg = c // groups
+    if (compute_dtype is None and a.dtype == jnp.float32
+            and jax.default_backend() == 'tpu'):
+        a = a.astype(jnp.bfloat16)  # same contract as conv2d_a_factor
+    patches = extract_conv2d_patches_slices(a, kernel_size, strides,
+                                            padding)
+    b, oh, ow, d = patches.shape
+    spatial = oh * ow
+    rows = b * spatial
+    # (rows, kh*kw, G, cpg) -> (G, rows, kh*kw, cpg): per-group feature
+    # order (kh, kw, cpg) matches the flattened flax kernel slice.
+    p = patches.reshape(rows, kh * kw, groups, cpg)
+    p = p.transpose(2, 0, 1, 3).reshape(groups, rows, kh * kw * cpg)
+    precision = None
+    if compute_dtype is not None:
+        p = p.astype(compute_dtype)
+        if jnp.dtype(compute_dtype) == jnp.float32:
+            precision = jax.lax.Precision.HIGHEST
+    cov = jnp.einsum('gri,grj->gij', p, p,
+                     preferred_element_type=jnp.float32,
+                     precision=precision)
+    cov = (cov + cov.transpose(0, 2, 1)) * (
+        0.5 / (rows * spatial * spatial))
+    if not has_bias:
+        return cov
+    ones = jnp.ones((1, rows), jnp.float32)
+    bias_cols = jnp.matmul(
+        ones[None], p.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST)[:, 0, :] / (
+        rows * spatial * spatial)
+    corner = 1.0 / (spatial * spatial)
+    return jax.vmap(
+        lambda cv, bc: _assemble_bias_factor(cv, bc, corner))(
+        cov, bias_cols.astype(cov.dtype))
+
+
+def conv2d_grouped_g_factor(g: jax.Array, groups: int,
+                            compute_dtype=None) -> jax.Array:
+    """Per-group G factors from NHWC output grads: (G, dg, dg).
+
+    Output channels of a grouped conv are contiguous per group (XLA
+    grouped-convolution layout), so group g's G factor is the covariance
+    of its ``cout/G`` channel block, normalized like
+    :func:`conv2d_g_factor`.
+    """
+    cout = g.shape[-1]
+    if cout % groups:
+        raise ValueError(f'{cout=} outputs not divisible by {groups=}')
+    spatial = g.shape[1] * g.shape[2]
+    g2 = g.reshape(-1, groups, cout // groups)
+    rows = g2.shape[0]
+    precision = None
+    if compute_dtype is not None:
+        g2 = g2.astype(compute_dtype)
+        if jnp.dtype(compute_dtype) == jnp.float32:
+            precision = jax.lax.Precision.HIGHEST
+    cov = jnp.einsum('rgi,rgj->gij', g2, g2,
+                     preferred_element_type=jnp.float32,
+                     precision=precision)
+    return (cov + cov.transpose(0, 2, 1)) * (
+        0.5 / (rows * spatial * spatial))
+
+
 def conv2d_g_factor(g: jax.Array, compute_dtype=None) -> jax.Array:
     """G factor for conv2d from NHWC output grads.
 

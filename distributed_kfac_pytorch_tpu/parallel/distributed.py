@@ -53,7 +53,8 @@ from jax.sharding import PartitionSpec as P
 
 from distributed_kfac_pytorch_tpu import fp16 as fp16_ops
 from distributed_kfac_pytorch_tpu import layers as L
-from distributed_kfac_pytorch_tpu.capture import (EMBEDDING,
+from distributed_kfac_pytorch_tpu.capture import (CONV2D_GROUPED,
+                                                  EMBEDDING,
                                                   subsample_captures)
 from distributed_kfac_pytorch_tpu.ops import factors as F
 from distributed_kfac_pytorch_tpu.ops import linalg
@@ -194,6 +195,9 @@ class WorkAssignment:
     layer_row: dict[str, int]
     buckets: dict[int, BucketPlan]
     diag_layers: tuple[str, ...]
+    # Grouped/depthwise convs: per-group block stacks, computed
+    # replicated (tiny blocks) and preconditioned by their owning row.
+    grouped_layers: tuple[str, ...] = ()
 
 
 def assign_work(kfac: KFAC, params, n_rows: int, n_cols: int, *,
@@ -216,15 +220,25 @@ def assign_work(kfac: KFAC, params, n_rows: int, n_cols: int, *,
     names = list(kfac.specs)
     shapes = {}
     diag_layers = []
+    grouped_layers = []
     for name in names:
         spec = kfac.specs[name]
         a_dim, g_dim = L.factor_shapes(spec, _get(params, spec.path))
         shapes[name] = (a_dim, g_dim)
         if spec.kind == EMBEDDING:
             diag_layers.append(name)
+        elif spec.kind == CONV2D_GROUPED:
+            grouped_layers.append(name)
 
     def factor_entries(name):
-        """[(key, dim, cost)] for the dense (eigh-requiring) factors."""
+        """[(key, dim, cost)] for the dense (eigh-requiring) factors.
+
+        Grouped convs contribute none: their per-group block stacks run
+        replicated (outside the bucket layout) — they still get a row
+        for precondition ownership via ``layer_cost`` below.
+        """
+        if name in grouped_layers:
+            return []
         a_dim, g_dim = shapes[name]
         out = []
         if name not in diag_layers:
@@ -233,6 +247,10 @@ def assign_work(kfac: KFAC, params, n_rows: int, n_cols: int, *,
         return out
 
     layer_cost = {n: sum(c for _, _, c in factor_entries(n)) for n in names}
+    for n in grouped_layers:
+        ng = kfac.specs[n].feature_group_count
+        a_dim, g_dim = shapes[n]
+        layer_cost[n] = ng * (a_dim ** exp + g_dim ** exp)
     row_of = dict(zip(names, load_balance(
         n_rows, [layer_cost[n] for n in names])))
 
@@ -246,7 +264,10 @@ def assign_work(kfac: KFAC, params, n_rows: int, n_cols: int, *,
         if distribute_layer_factors:
             items = [e for n in row_names for e in factor_entries(n)]
         else:
-            items = [((n, '*'), 0, layer_cost[n]) for n in row_names]
+            items = [((n, '*'), 0, layer_cost[n])
+                     for n in row_names if factor_entries(n)]
+        if not items:
+            continue  # row holds only grouped/diag layers (no buckets)
         cols = load_balance(n_cols, [c for _, _, c in items])
         for (key, dim, _), col in zip(items, cols):
             if key[1] == '*':
@@ -268,7 +289,8 @@ def assign_work(kfac: KFAC, params, n_rows: int, n_cols: int, *,
         buckets[dim] = BucketPlan(dim=dim, slots_per_col=s, n_cols=n_cols,
                                   slot=slot)
     return WorkAssignment(n_rows=n_rows, n_cols=n_cols, layer_row=row_of,
-                          buckets=buckets, diag_layers=tuple(diag_layers))
+                          buckets=buckets, diag_layers=tuple(diag_layers),
+                          grouped_layers=tuple(grouped_layers))
 
 
 # ---------------------------------------------------------------------------
@@ -332,7 +354,7 @@ class DistributedKFAC:
     def _layer_is_mixed(self, name: str) -> bool:
         """Dense layer with exactly one eigen side ('auto' straddle)."""
         spec = self.kfac.specs[name]
-        if spec.kind == EMBEDDING:
+        if spec.kind in (EMBEDDING, CONV2D_GROUPED):
             return False
         a_dim, g_dim = self._factor_dims[name]
         return ((self.kfac.method_for_dim(a_dim) == 'eigen')
@@ -355,8 +377,8 @@ class DistributedKFAC:
         """
         by_shape: dict[tuple[int, int], dict[int, list[str]]] = {}
         for name, spec in self.kfac.specs.items():
-            if spec.kind == EMBEDDING:
-                continue  # diagonal A: stays on the per-layer path
+            if spec.kind in (EMBEDDING, CONV2D_GROUPED):
+                continue  # diagonal A / block stacks: per-layer path
             a_dim, g_dim = self._factor_dims[name]
             rows = by_shape.setdefault((g_dim, a_dim), {})
             rows.setdefault(self.assignment.layer_row[name],
@@ -420,8 +442,13 @@ class DistributedKFAC:
         for name in self.assignment.diag_layers:
             a_dim = base['factors'][name]['A'].shape[0]
             diag_inv[name] = jnp.zeros((a_dim,), idt)
+        # Grouped convs: replicated per-group block-inverse stacks (the
+        # single-chip init already builds the right zero shapes).
+        grouped_inv = {name: base['inverses'][name]
+                       for name in self.assignment.grouped_layers}
         return {'step': base['step'], 'factors': base['factors'],
-                'inv_stacks': stacks, 'diag_inv': diag_inv}
+                'inv_stacks': stacks, 'diag_inv': diag_inv,
+                'grouped_inv': grouped_inv}
 
     def state_pspecs(self, state: dict) -> dict:
         """PartitionSpecs for a state pytree: stacks row-sharded, rest
@@ -589,7 +616,20 @@ class DistributedKFAC:
             diag_inv[name] = linalg.get_elementwise_inverse(
                 factors[name]['A'].astype(jnp.float32),
                 damping=damping).astype(kfac.inv_dtype)
-        return stacks, diag_inv
+        grouped_inv = {}
+        for name in self.assignment.grouped_layers:
+            # Replicated batched damped Cholesky over the per-group
+            # block stacks (dims are tiny — e.g. kh*kw+1 for depthwise —
+            # so replicating beats any sharding bookkeeping).
+            f = factors[name]
+            grouped_inv[name] = {
+                'A_inv': pallas_kernels.damped_inverse_stack(
+                    f['A'].astype(jnp.float32), damping,
+                    'cholesky').astype(kfac.inv_dtype),
+                'G_inv': pallas_kernels.damped_inverse_stack(
+                    f['G'].astype(jnp.float32), damping,
+                    'cholesky').astype(kfac.inv_dtype)}
+        return stacks, diag_inv, grouped_inv
 
     def _layer_inverses(self, inv_stacks, name: str) -> dict:
         """This device's (row-local) inverse views for one layer.
@@ -696,7 +736,8 @@ class DistributedKFAC:
                 out[name] = vs[gslot % s] * mask
         return out
 
-    def _spmd_precondition(self, inv_stacks, diag_inv, grads, damping, lr):
+    def _spmd_precondition(self, inv_stacks, diag_inv, grouped_inv,
+                           grads, damping, lr):
         """Row-masked preconditioning + one ``psum`` gradient broadcast.
 
         Every member of a layer's inverse group computes its preconditioned
@@ -719,7 +760,14 @@ class DistributedKFAC:
         for name, spec in kfac.specs.items():
             if name in precond_mats:
                 continue  # computed by the row-sharded path
-            inv = self._layer_inverses(inv_stacks, name)
+            if spec.kind == CONV2D_GROUPED:
+                # Replicated block-stack inverses; batched
+                # G_inv @ grad @ A_inv broadcasts over the group dim.
+                # Masked to the owning row like every per-layer path so
+                # the delivery psum stays a sum of one contribution.
+                inv = grouped_inv[name]
+            else:
+                inv = self._layer_inverses(inv_stacks, name)
             # Same four-way per-side dispatch as the single-chip path
             # (linalg.precondition_dispatch) so 'auto' mixed-method
             # layers cannot drift between the two.
@@ -804,16 +852,18 @@ class DistributedKFAC:
 
         factors = cadence_gate(factor_update, step, f_freq, do_factors,
                                lambda: state['factors'])
-        inv_stacks, diag_inv = cadence_gate(
+        inv_stacks, diag_inv, grouped_inv = cadence_gate(
             inv_update, step, i_freq,
             lambda: self._spmd_update_inverses(
                 factors, damping, prev_stacks=state['inv_stacks']),
-            lambda: (state['inv_stacks'], state['diag_inv']))
+            lambda: (state['inv_stacks'], state['diag_inv'],
+                     state.get('grouped_inv', {})))
 
-        precond = self._spmd_precondition(inv_stacks, diag_inv, grads,
-                                          damping, lr)
+        precond = self._spmd_precondition(inv_stacks, diag_inv,
+                                          grouped_inv, grads, damping, lr)
         new_state = {'step': step + 1, 'factors': factors,
-                     'inv_stacks': inv_stacks, 'diag_inv': diag_inv}
+                     'inv_stacks': inv_stacks, 'diag_inv': diag_inv,
+                     'grouped_inv': grouped_inv}
         return precond, new_state
 
     # -- checkpointing --------------------------------------------------
@@ -834,6 +884,7 @@ class DistributedKFAC:
         if include_inverses:
             out['inv_stacks'] = state['inv_stacks']
             out['diag_inv'] = state['diag_inv']
+            out['grouped_inv'] = state.get('grouped_inv', {})
         return out
 
     def load_state_dict(self, sd: dict, params, *,
@@ -859,7 +910,9 @@ class DistributedKFAC:
             for k in state['inv_stacks'])
         if compatible and not self._degenerate_stacks(sd['inv_stacks']):
             state = {**state, 'inv_stacks': sd['inv_stacks'],
-                     'diag_inv': sd['diag_inv']}
+                     'diag_inv': sd['diag_inv'],
+                     'grouped_inv': sd.get('grouped_inv',
+                                           state['grouped_inv'])}
         else:
             state = self.recompute_inverses(state, damping=damping)
         return state
@@ -890,13 +943,16 @@ class DistributedKFAC:
         def compute(factors):
             return self._spmd_update_inverses(factors, damping)
 
-        stacks, diag = jax.jit(jax.shard_map(
+        stacks, diag, grouped = jax.jit(jax.shard_map(
             compute, mesh=self.mesh,
             in_specs=(jax.tree.map(lambda _: P(), state['factors']),),
             out_specs=(kspecs['inv_stacks'],
-                       jax.tree.map(lambda _: P(), state['diag_inv'])),
+                       jax.tree.map(lambda _: P(), state['diag_inv']),
+                       jax.tree.map(lambda _: P(),
+                                    state.get('grouped_inv', {}))),
             check_vma=False))(state['factors'])
-        return {**state, 'inv_stacks': stacks, 'diag_inv': diag}
+        return {**state, 'inv_stacks': stacks, 'diag_inv': diag,
+                'grouped_inv': grouped}
 
     # -- full train step builder ---------------------------------------
 

@@ -40,7 +40,8 @@ import jax
 import jax.numpy as jnp
 
 from distributed_kfac_pytorch_tpu import layers as L
-from distributed_kfac_pytorch_tpu.capture import (EMBEDDING, KFACCapture,
+from distributed_kfac_pytorch_tpu.capture import (CONV2D_GROUPED, EMBEDDING,
+                                                  KFACCapture,
                                                   subsample_captures)
 from distributed_kfac_pytorch_tpu.ops import factors as F
 from distributed_kfac_pytorch_tpu.ops import linalg
@@ -337,8 +338,14 @@ class KFAC:
         return self.inverse_method
 
     def _side_methods(self, spec, a_dim: int, g_dim: int
-                      ) -> tuple[str | None, str]:
-        """(A-side, G-side) methods for one layer; diagonal A -> None."""
+                      ) -> tuple[str | None, str | None]:
+        """(A-side, G-side) methods for one layer; diagonal A -> None;
+        grouped convs -> (None, None) (their per-group block stacks run
+        a batched damped Cholesky, outside the dense per-dim dispatch —
+        the blocks are tiny, so eigen warm-start bookkeeping would cost
+        more than it saves)."""
+        if spec.kind == CONV2D_GROUPED:
+            return None, None
         ma = (None if spec.kind == EMBEDDING
               else self.method_for_dim(a_dim))
         return ma, self.method_for_dim(g_dim)
@@ -403,6 +410,17 @@ class KFAC:
             mixed = (spec.kind != EMBEDDING
                      and (ma == 'eigen') != (mg == 'eigen'))
             entry: dict[str, Any] = {}
+            if spec.kind == CONV2D_GROUPED:
+                ng = spec.feature_group_count
+                factors[name] = {
+                    'A': jnp.broadcast_to(jnp.eye(a_dim, dtype=fdt),
+                                          (ng, a_dim, a_dim)),
+                    'G': jnp.broadcast_to(jnp.eye(g_dim, dtype=fdt),
+                                          (ng, g_dim, g_dim))}
+                inverses[name] = {
+                    'A_inv': jnp.zeros((ng, a_dim, a_dim), idt),
+                    'G_inv': jnp.zeros((ng, g_dim, g_dim), idt)}
+                continue
             if spec.kind == EMBEDDING:
                 factors[name] = {'A': jnp.ones((a_dim,), fdt),
                                  'G': jnp.eye(g_dim, dtype=fdt)}
@@ -550,6 +568,18 @@ class KFAC:
 
         new_inv = {}
         for name, spec in self.specs.items():
+            if spec.kind == CONV2D_GROUPED:
+                # Batched damped Cholesky over the per-group block
+                # stacks (both sides; tiny dims, one vmapped kernel).
+                f = state['factors'][name]
+                new_inv[name] = {
+                    'A_inv': pallas_kernels.damped_inverse_stack(
+                        f['A'].astype(jnp.float32), damping,
+                        'cholesky').astype(self.inv_dtype),
+                    'G_inv': pallas_kernels.damped_inverse_stack(
+                        f['G'].astype(jnp.float32), damping,
+                        'cholesky').astype(self.inv_dtype)}
+                continue
             ma, mg = sides[name]
             # A dense layer with exactly one eigen side is *mixed*: its
             # eigen side is additionally baked into a dense damped
