@@ -74,7 +74,10 @@ def main(argv=None):
     p.add_argument('--seeds', type=int, nargs='+',
                    default=[0, 1, 2, 3, 4])
     p.add_argument('--fractions', type=float, nargs='+',
-                   default=[1.0, 0.5, 0.25])
+                   default=[1.0, 0.5, 0.25],
+                   choices=sorted(DAMPING),
+                   help='fractions with a tuned damping entry '
+                        '(extend DAMPING for new values)')
     p.add_argument('--epochs', type=int, default=30)
     p.add_argument('--out', default='FRAC_PROMOTION.json')
     args = p.parse_args(argv)

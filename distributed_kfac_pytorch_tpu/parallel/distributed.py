@@ -65,6 +65,7 @@ from distributed_kfac_pytorch_tpu.preconditioner import (
     KFAC,
     CommMethod,
     cadence_gate,
+    grouped_block_inverses,
     q_stack_degenerate,
     resolve_eigh_method,
 )
@@ -616,19 +617,13 @@ class DistributedKFAC:
             diag_inv[name] = linalg.get_elementwise_inverse(
                 factors[name]['A'].astype(jnp.float32),
                 damping=damping).astype(kfac.inv_dtype)
-        grouped_inv = {}
-        for name in self.assignment.grouped_layers:
-            # Replicated batched damped Cholesky over the per-group
-            # block stacks (dims are tiny — e.g. kh*kw+1 for depthwise —
-            # so replicating beats any sharding bookkeeping).
-            f = factors[name]
-            grouped_inv[name] = {
-                'A_inv': pallas_kernels.damped_inverse_stack(
-                    f['A'].astype(jnp.float32), damping,
-                    'cholesky').astype(kfac.inv_dtype),
-                'G_inv': pallas_kernels.damped_inverse_stack(
-                    f['G'].astype(jnp.float32), damping,
-                    'cholesky').astype(kfac.inv_dtype)}
+        # Replicated per-group block inverses (tiny blocks — replicating
+        # beats any sharding bookkeeping); shared helper with the
+        # single-chip path so the two cannot drift.
+        grouped_inv = {
+            name: grouped_block_inverses(factors[name], damping,
+                                         kfac.inv_dtype)
+            for name in self.assignment.grouped_layers}
         return stacks, diag_inv, grouped_inv
 
     def _layer_inverses(self, inv_stacks, name: str) -> dict:

@@ -569,16 +569,8 @@ class KFAC:
         new_inv = {}
         for name, spec in self.specs.items():
             if spec.kind == CONV2D_GROUPED:
-                # Batched damped Cholesky over the per-group block
-                # stacks (both sides; tiny dims, one vmapped kernel).
-                f = state['factors'][name]
-                new_inv[name] = {
-                    'A_inv': pallas_kernels.damped_inverse_stack(
-                        f['A'].astype(jnp.float32), damping,
-                        'cholesky').astype(self.inv_dtype),
-                    'G_inv': pallas_kernels.damped_inverse_stack(
-                        f['G'].astype(jnp.float32), damping,
-                        'cholesky').astype(self.inv_dtype)}
+                new_inv[name] = grouped_block_inverses(
+                    state['factors'][name], damping, self.inv_dtype)
                 continue
             ma, mg = sides[name]
             # A dense layer with exactly one eigen side is *mixed*: its
@@ -771,6 +763,22 @@ class KFAC:
                      'inverses': self.update_inverses(state, self.damping,
                                                       warm=False)}
         return state
+
+
+def grouped_block_inverses(factors: dict, damping, inv_dtype) -> dict:
+    """Per-group damped block inverses for a grouped-conv layer.
+
+    One batched damped Cholesky per side over the ``(G, d, d)`` factor
+    stacks (blocks are tiny — e.g. ``kh*kw+1`` per depthwise group, so
+    eigen warm-start bookkeeping would cost more than it saves). Single
+    point of truth for the single-chip and SPMD inverse updates.
+    """
+    return {'A_inv': pallas_kernels.damped_inverse_stack(
+                factors['A'].astype(jnp.float32), damping,
+                'cholesky').astype(inv_dtype),
+            'G_inv': pallas_kernels.damped_inverse_stack(
+                factors['G'].astype(jnp.float32), damping,
+                'cholesky').astype(inv_dtype)}
 
 
 def resolve_eigh_method(method: str) -> str:
