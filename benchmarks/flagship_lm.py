@@ -67,7 +67,7 @@ def lm_flops_per_step(d_model, num_layers, mlp_ratio, batch, seq, vocab):
 # Single-phase worker (fresh process via --phase)
 # ---------------------------------------------------------------------------
 
-def _setup(args):
+def _setup(args, with_kfac=True):
     import jax
     import jax.numpy as jnp
     import optax
@@ -85,9 +85,24 @@ def _setup(args):
                              (args.batch, args.seq), 0, args.vocab)
     tgt = jax.random.randint(jax.random.PRNGKey(2),
                              (args.batch, args.seq), 0, args.vocab)
+    if not with_kfac:
+        # The SGD leg must not carry the multi-GB factor/inverse state
+        # (at xl scale it alone RESOURCE_EXHAUSTs a 16 GB chip).
+        variables = model.init(jax.random.PRNGKey(0), ids, train=False)
+        return jax, jnp, optax, model, None, variables, None, ids, tgt
     kw = {}
     if args.inverse_method:
         kw['inverse_method'] = args.inverse_method
+    if args.bf16_factors:
+        kw['factor_dtype'] = jnp.bfloat16
+        kw['factor_compute_dtype'] = jnp.bfloat16
+    if args.bf16_inverses:
+        # Reference-legitimate storage policy (it computes inverses in
+        # fp32 and stores in inv_dtype, which may be half precision —
+        # kfac/layers/base.py:435,439 + preconditioner.py:149); at xl
+        # scale fp32 inverse stacks alone are 3.2 GB and the scan
+        # carry double-buffers.
+        kw['inv_dtype'] = jnp.bfloat16
     kfac = KFAC(model, factor_update_freq=1, inv_update_freq=1,
                 damping=0.003, lr=0.1, **kw)
     variables, kstate = kfac.init(jax.random.PRNGKey(0), ids, train=False)
@@ -96,7 +111,8 @@ def _setup(args):
 
 def run_phase(args):
     import bench as B
-    jax, jnp, optax, model, kfac, variables, kstate, ids, tgt = _setup(args)
+    jax, jnp, optax, model, kfac, variables, kstate, ids, tgt = _setup(
+        args, with_kfac=args.phase != 'sgd')
     params = variables['params']
     tx = optax.sgd(0.1, momentum=0.9)
     opt_state = tx.init(params)
@@ -109,30 +125,98 @@ def run_phase(args):
     mode = args.phase
     if mode == 'firing':
         # One real factor update so decomposed matrices are
-        # covariance-shaped; then time the firing as its own program.
+        # covariance-shaped; factor shapes are batch/seq-independent,
+        # so this shaping pass runs on TINY inputs (the full-size
+        # forward + captures + full state RESOURCE_EXHAUSTs at xl).
+        tiny = ids[:1, :128]
+        tiny_tgt = tgt[:1, :128]
+
+        def tiny_loss(out):
+            logits = out[0] if isinstance(out, tuple) else out
+            import optax as _o
+            return _o.softmax_cross_entropy_with_integer_labels(
+                logits, tiny_tgt).mean()
+
         _, _, _, captures, _ = jax.jit(
             lambda p: kfac.capture.loss_and_grads(
-                loss_fn, p, ids, train=False))(params)
-        kstate = {**kstate,
-                  'factors': jax.jit(kfac.update_factors)(kstate, captures)}
+                tiny_loss, p, tiny, train=False))(params)
+        factors = jax.jit(kfac.update_factors)(kstate, captures)
+        del kstate, captures
 
-        def body(state, _):
-            new_inv = kfac.update_inverses(state, 0.003)
-            factors = jax.tree.map(lambda f: f * (1.0 + 1e-5),
-                                   state['factors'])
-            state = {**state, 'factors': factors, 'inverses': new_inv}
-            probe = jax.tree.leaves(new_inv)[0].reshape(-1)[0]
-            return state, probe
+        # The monolithic all-bucket firing program peaks at ~21 GB at
+        # xl scale (fp32 stacks + Cholesky workspace + state double
+        # buffer). The firing is embarrassingly separable by factor
+        # dim, so each bucket is timed as its own chained program and
+        # the per-firing cost is the sum — same methodology class as
+        # the phase decomposition itself.
+        import collections
+        import functools
 
+        by_dim = collections.defaultdict(list)
+        for name, spec in kfac.specs.items():
+            f = factors[name]
+            for which in ('A', 'G'):
+                m = f[which]
+                if m.ndim != 2 or m.shape[0] != m.shape[-1]:
+                    continue  # diagonal embedding A
+                by_dim[m.shape[-1]].append(m)
+        del factors
+        # Free everything the bucket programs don't need: params,
+        # momentum and the rest add ~3 GB that pushed the 4096/4097
+        # bucket compiles over HBM.
+        del params, opt_state, variables
         n = min(args.iters, 3)
+        total_ms = 0.0
+        parts = {}
+        for dim in sorted(by_dim):
+            stack = jnp.stack([m.astype(jnp.float32)
+                               for m in by_dim[dim]])
+            del by_dim[dim]
+            method = kfac.method_for_dim(dim)
+            if args.inverse_method == 'eigen':
+                method = 'eigen'
 
-        @jax.jit
-        def run(state):
-            state, probes = jax.lax.scan(body, state, None, length=n)
-            return state, probes[-1]
+            # Large-dim stacks (18 x 4096^2 fp32 = 1.2 GB) push the
+            # batched Cholesky's workspace past HBM inside the scan —
+            # lax.map over sub-chunks sequences the workspace (peak =
+            # one chunk) without changing the work measured.
+            k = stack.shape[0]
+            chunks = 1
+            if dim > 2048:
+                chunks = next(c for c in range(1, k + 1)
+                              if k % c == 0 and k // c <= 3)
 
-        ms = B.time_chained(run, kstate, n, repeats=2, max_attempts=2)
-        emit({'phase_result': round(ms, 2)})
+            def body(s, _):
+                from distributed_kfac_pytorch_tpu.ops import (
+                    linalg, pallas_kernels)
+                if method == 'eigen':
+                    q, d = linalg.batched_eigh(s, 'xla')
+                    probe = q.reshape(-1)[0] + d.reshape(-1)[0]
+                elif chunks > 1:
+                    cs = s.reshape(chunks, s.shape[0] // chunks,
+                                   *s.shape[1:])
+                    inv = jax.lax.map(
+                        lambda c: pallas_kernels.damped_inverse_stack(
+                            c, 0.003, method), cs)
+                    probe = inv.reshape(-1)[0]
+                else:
+                    inv = pallas_kernels.damped_inverse_stack(
+                        s, 0.003, method)
+                    probe = inv.reshape(-1)[0]
+                return s * (1.0 + 1e-5), probe
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def run(s):
+                s, probes = jax.lax.scan(body, s, None, length=n)
+                return s, probes[-1]
+
+            ms = B.time_chained(run, stack, n, repeats=2,
+                                max_attempts=2)
+            parts[f'{dim}x{k}_{method}'] = round(ms, 2)
+            total_ms += ms
+            del stack
+        emit({'phase_result': round(total_ms, 2),
+              'bucket_parts': parts})
         return
 
     if mode == 'sgd':
@@ -162,7 +246,12 @@ def run_phase(args):
             params = optax.apply_updates(params, updates)
             return (params, opt_state, kst), l
 
-    @jax.jit
+    # Donated carry: time_chained feeds each call the previous call's
+    # output, so the multi-GB state is single-buffered (without this
+    # the xl nofactor leg's carry alone double-buffers past 16 GB).
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def run(carry):
         carry, losses = jax.lax.scan(body, carry, None,
                                      length=args.iters)
@@ -189,6 +278,10 @@ def spawn_phase(args, phase, inverse_method=None):
            '--iters', str(args.iters)]
     if args.model_dtype:
         cmd += ['--model-dtype', args.model_dtype]
+    if args.bf16_factors:
+        cmd.append('--bf16-factors')
+    if args.bf16_inverses:
+        cmd.append('--bf16-inverses')
     if inverse_method:
         cmd += ['--inverse-method', inverse_method]
     try:
@@ -216,7 +309,21 @@ def main(argv=None):
     p.add_argument('--iters', type=int, default=10)
     p.add_argument('--model-dtype', default='bf16',
                    choices=['fp32', 'bf16'])
+    p.add_argument('--bf16-factors', action='store_true',
+                   help='bf16 factor storage (halves the multi-GB '
+                        'factor state at xl scale; decompositions stay '
+                        'fp32 — the config-5 policy)')
+    p.add_argument('--bf16-inverses', action='store_true',
+                   help='bf16 inverse storage (inv_dtype; the '
+                        'reference supports half-precision inverse '
+                        'storage too — preconditioner.py:149)')
     p.add_argument('--inverse-method', default=None)
+    p.add_argument('--firing-methods', nargs='+',
+                   default=['auto', 'cholesky', 'eigen'],
+                   help='inverse methods to measure standalone firings '
+                        'for (drop eigen at xl dims: the fp32-HIGHEST '
+                        'polish at 4096+ is the recorded CNN-flagship '
+                        'negative, seconds per firing)')
     p.add_argument('--phase', default=None,
                    help='internal: run one phase in this process')
     args = p.parse_args(argv)
@@ -232,7 +339,7 @@ def main(argv=None):
               'model_dtype': args.model_dtype,
               'ms_per_iter': rows[mode], 'mfu': mfus.get(mode)})
     firings = {}
-    for method in ('auto', 'cholesky', 'eigen'):
+    for method in args.firing_methods:
         firings[method], _ = spawn_phase(args, 'firing',
                                          inverse_method=method)
         emit({'config': 4,

@@ -1,0 +1,139 @@
+"""Mid-dim eigen extension experiment (VERDICT r4 #7).
+
+The 'auto' inverse dispatch sends factor dims > `auto_eigen_max_dim`
+(640) to damped Cholesky because the fp32-HIGHEST warm-polish matmuls
+blow up at flagship dims (measured 41x at 4609, PERF.md round 3).
+Between 640 and ~2304 the *eigen semantics* (joint damping read at
+precondition time) are lost to the split operator. This bench measures
+whether a CHEAPER polish — HIGH-precision (bf16 3-pass) matmuls and/or
+fewer iterations — makes eigen competitive with Cholesky at 1024/2304,
+and what it costs in basis accuracy (preconditioning relative error
+vs the exact eigh oracle).
+
+Per (dim, config): a stack of `n_mats` trained-like SPD factors
+(log-uniform spectra, like eigh_methods.py), one firing =
+`eigh_polish` of a mildly-rotated exact basis (the steady-state of
+eigh_method='auto' tracking). Cholesky row = `damped_inverse_stack`.
+
+    python benchmarks/middim_eigen.py [--dims 1024 2304] [--repeats 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+from distributed_kfac_pytorch_tpu.ops import linalg, pallas_kernels
+from distributed_kfac_pytorch_tpu.utils import enable_compilation_cache
+
+
+def trained_like_stack(dim, n_mats, seed=0):
+    rng = np.random.default_rng(seed)
+    mats = []
+    for _ in range(n_mats):
+        q, _ = np.linalg.qr(rng.normal(size=(dim, dim)))
+        d = np.exp(rng.uniform(np.log(1e-4), np.log(10.0), dim))
+        mats.append((q * d) @ q.T)
+    return jnp.asarray(np.stack(mats), jnp.float32)
+
+
+def perturbed_basis(stack, angle=3e-2, seed=1):
+    """Exact bases, rotated slightly — the between-firings drift."""
+    _, qs = jnp.linalg.eigh(stack)
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(stack.shape[0]):
+        s = rng.normal(size=stack.shape[1:])
+        skew = jnp.asarray((s - s.T) / 2 * angle, jnp.float32)
+        g, _ = jnp.linalg.qr(jnp.eye(stack.shape[1]) + skew)
+        out.append(qs[i] @ g)
+    return jnp.stack(out)
+
+
+def precond_err(a, q, d, damping=1e-3):
+    """Relative error of (A+λ)^-1 applied via (Q, d) vs exact eigh."""
+    w, v = jnp.linalg.eigh(a)
+    x = jnp.eye(a.shape[-1], dtype=jnp.float32)[:, :8]
+    exact = v @ ((v.T @ x) / (w + damping)[:, None])
+    approx = q @ ((q.T @ x) / (d + damping)[:, None])
+    return float(jnp.linalg.norm(approx - exact)
+                 / jnp.linalg.norm(exact))
+
+
+def time_fn(fn, *args, repeats=3):
+    out = jax.block_until_ready(fn(*args))  # compile
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return min(times), out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--dims', type=int, nargs='+', default=[1024, 2304])
+    p.add_argument('--n-mats', type=int, default=4)
+    p.add_argument('--repeats', type=int, default=3)
+    p.add_argument('--out', default='MIDDIM_EIGEN.json')
+    args = p.parse_args(argv)
+    enable_compilation_cache()
+
+    rows = []
+    for dim in args.dims:
+        stack = trained_like_stack(dim, args.n_mats)
+        q_prev = perturbed_basis(stack)
+        configs = [
+            ('polish_fp32HIGHEST_8', None, 8),
+            ('polish_HIGH_8', jax.lax.Precision.HIGH, 8),
+            ('polish_HIGH_4', jax.lax.Precision.HIGH, 4),
+        ]
+        for label, precision, iters in configs:
+            fn = jax.jit(jax.vmap(functools.partial(
+                linalg.eigh_polish, iters=iters, precision=precision)))
+            sec, (qs, ds) = time_fn(fn, stack, q_prev,
+                                    repeats=args.repeats)
+            errs = [precond_err(stack[i], qs[i], ds[i])
+                    for i in range(args.n_mats)]
+            rows.append({'dim': dim, 'method': label,
+                         'ms_per_firing': round(sec * 1e3, 2),
+                         'worst_precond_rel_err':
+                             float(np.max(errs))})
+            print(json.dumps(rows[-1]), flush=True)
+        fn = jax.jit(lambda s: pallas_kernels.damped_inverse_stack(
+            s, 1e-3, 'cholesky'))
+        sec, _ = time_fn(fn, stack, repeats=args.repeats)
+        rows.append({'dim': dim, 'method': 'cholesky',
+                     'ms_per_firing': round(sec * 1e3, 2),
+                     'worst_precond_rel_err': None})
+        print(json.dumps(rows[-1]), flush=True)
+        fn = jax.jit(jax.vmap(jnp.linalg.eigh))
+        sec, _ = time_fn(fn, stack, repeats=args.repeats)
+        rows.append({'dim': dim, 'method': 'xla_eigh_cold',
+                     'ms_per_firing': round(sec * 1e3, 2),
+                     'worst_precond_rel_err': 0.0})
+        print(json.dumps(rows[-1]), flush=True)
+
+    with open(args.out, 'w') as f:
+        json.dump({'n_mats_per_dim': args.n_mats,
+                   'backend': jax.default_backend(),
+                   'note': 'per-firing decomposition cost of a '
+                           f'{args.n_mats}-matrix stack at each dim; '
+                           'polish rows = eigh_method auto steady '
+                           'state; decide auto_eigen_max_dim',
+                   'rows': rows}, f, indent=1)
+    print(json.dumps({'rows': rows}))
+
+
+if __name__ == '__main__':
+    main()
