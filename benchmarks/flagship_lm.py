@@ -186,31 +186,63 @@ def run_phase(args):
                 chunks = next(c for c in range(1, k + 1)
                               if k % c == 0 and k // c <= 3)
 
-            def body(s, _):
-                from distributed_kfac_pytorch_tpu.ops import (
-                    linalg, pallas_kernels)
-                if method == 'eigen':
-                    q, d = linalg.batched_eigh(s, 'xla')
-                    probe = q.reshape(-1)[0] + d.reshape(-1)[0]
-                elif chunks > 1:
-                    cs = s.reshape(chunks, s.shape[0] // chunks,
-                                   *s.shape[1:])
-                    inv = jax.lax.map(
-                        lambda c: pallas_kernels.damped_inverse_stack(
-                            c, 0.003, method), cs)
+            def chunked(fn, s):
+                if chunks == 1:
+                    return fn(s)
+                cs = s.reshape(chunks, s.shape[0] // chunks,
+                               *s.shape[1:])
+                return jax.lax.map(fn, cs)
+
+            if method == 'eigen':
+                # The PRODUCTION eigen firing is the warm-start polish
+                # (eigh_method 'auto' steady state), not a cold XLA
+                # eigh — carry the basis through the chain like the
+                # training path does.
+                def body(carry, _):
+                    from distributed_kfac_pytorch_tpu.ops import linalg
+                    s, q = carry
+
+                    def one(args_):
+                        si, qi = args_
+                        return linalg.batched_eigh(
+                            si, 'auto', q_prev=qi,
+                            polish_iters=kfac.eigh_polish_iters)
+
+                    if chunks > 1:
+                        cs = s.reshape(chunks, -1, *s.shape[1:])
+                        cq = q.reshape(chunks, -1, *q.shape[1:])
+                        qs, ds = jax.lax.map(one, (cs, cq))
+                        qs = qs.reshape(q.shape)
+                        ds = ds.reshape(s.shape[:2])
+                    else:
+                        qs, ds = one((s, q))
+                    probe = qs.reshape(-1)[0] + ds.reshape(-1)[0]
+                    return (s * (1.0 + 1e-5), qs), probe
+
+                _, qs0 = jnp.linalg.eigh(stack)
+                carry0 = (stack, qs0)
+            else:
+                def body(carry, _):
+                    from distributed_kfac_pytorch_tpu.ops import (
+                        pallas_kernels)
+                    s = carry
+
+                    def one(c):
+                        return pallas_kernels.damped_inverse_stack(
+                            c, 0.003, method)
+
+                    inv = chunked(one, s)
                     probe = inv.reshape(-1)[0]
-                else:
-                    inv = pallas_kernels.damped_inverse_stack(
-                        s, 0.003, method)
-                    probe = inv.reshape(-1)[0]
-                return s * (1.0 + 1e-5), probe
+                    return s * (1.0 + 1e-5), probe
+
+                carry0 = stack
 
             @functools.partial(jax.jit, donate_argnums=(0,))
-            def run(s):
-                s, probes = jax.lax.scan(body, s, None, length=n)
-                return s, probes[-1]
+            def run(c):
+                c, probes = jax.lax.scan(body, c, None, length=n)
+                return c, probes[-1]
 
-            ms = B.time_chained(run, stack, n, repeats=2,
+            ms = B.time_chained(run, carry0, n, repeats=2,
                                 max_attempts=2)
             parts[f'{dim}x{k}_{method}'] = round(ms, 2)
             total_ms += ms
@@ -292,12 +324,14 @@ def spawn_phase(args, phase, inverse_method=None):
     for line in reversed(out.stdout.strip().splitlines()):
         try:
             obj = json.loads(line)
-            return obj['phase_result'], obj.get('mfu')
+            extras = {k: v for k, v in obj.items()
+                      if k not in ('phase_result', 'mfu')}
+            return obj['phase_result'], obj.get('mfu'), extras
         except Exception:
             continue
     err = (out.stderr or '').strip().splitlines()
     return ('failed: ' + (err[-1][:120] if err else f'rc={out.returncode}'),
-            None)
+            None, {})
 
 
 def main(argv=None):
@@ -333,18 +367,18 @@ def main(argv=None):
 
     rows, mfus = {}, {}
     for mode in ('sgd', 'nofactor', 'factors'):
-        rows[mode], mfus[mode] = spawn_phase(args, mode)
+        rows[mode], mfus[mode], _ = spawn_phase(args, mode)
         emit({'config': 4, 'phase': mode, 'size': args.size,
               'seq': args.seq, 'batch': args.batch, 'vocab': args.vocab,
               'model_dtype': args.model_dtype,
               'ms_per_iter': rows[mode], 'mfu': mfus.get(mode)})
     firings = {}
     for method in args.firing_methods:
-        firings[method], _ = spawn_phase(args, 'firing',
-                                         inverse_method=method)
+        firings[method], _, extras = spawn_phase(args, 'firing',
+                                                 inverse_method=method)
         emit({'config': 4,
               'phase': f'inverse_firing_standalone_{method}',
-              'ms_per_firing': firings[method]})
+              'ms_per_firing': firings[method], **extras})
 
     methods = [(m, v) for m, v in firings.items()
                if isinstance(v, (int, float))]
