@@ -72,17 +72,21 @@ def rand_rotation(rng, n, angle):
     return np.real((v * np.exp(-1j * w)) @ v.conj().T)
 
 
-def precond_rel_err(a, q, d, lam=1e-3, rng=None):
+def precond_rel_err(a, q, d, lam=1e-3, rng=None, exact_wv=None):
     """Relative error of applying ``(A + lam I)^-1`` via (q, d) vs exact.
 
     The metric K-FAC consumes: basis mixing inside eigenvalue clusters
     cancels here (the damping quotient is ~flat across a cluster), while
     genuine basis/eigenvalue error shows up directly. Canonical helper
-    shared with tests/test_warm_eigh.py.
+    shared with tests/test_warm_eigh.py and benchmarks/middim_eigen.py.
+
+    ``exact_wv``: optional precomputed ``(w, v) = np.linalg.eigh(a)``
+    oracle — pass it when cold eighs at the bench's dims are exactly the
+    expensive thing under study (middim_eigen); ``a`` is ignored then.
     """
     rng = rng or np.random.default_rng(7)
-    dr, qr = np.linalg.eigh(a)
-    g = rng.standard_normal((a.shape[0], 3))
+    dr, qr = exact_wv if exact_wv is not None else np.linalg.eigh(a)
+    g = rng.standard_normal((qr.shape[0], 3))
     out = q @ ((q.T @ g) / (np.maximum(d, 0)[:, None] + lam))
     ref = qr @ ((qr.T @ g) / (np.maximum(dr, 0)[:, None] + lam))
     return float(np.linalg.norm(out - ref) / np.linalg.norm(ref))

@@ -410,8 +410,23 @@ def config2(args):
     # dispatch is the out-of-the-box default (round 4), so the headline
     # composed row is the default config's; eigen/cholesky record the
     # endpoints the dispatch interpolates between.
+    reused = {}
+    if args.reuse_firing:
+        reused = {k: float(v) for k, v in
+                  (kv.split('=') for kv in args.reuse_firing.split(','))}
+        bad = set(reused) - {'auto', 'cholesky', 'eigen'}
+        if bad:
+            raise SystemExit(f'--reuse-firing unknown method(s): {bad}')
+        emit({'config': 2, 'reused_firings': reused})
+    # Iteration below is canonical-order regardless of flag/reuse
+    # spelling, preserving the auto-first invariant above.
     firings = {}
     for method in ('auto', 'cholesky', 'eigen'):
+        if method in reused:
+            firings[method] = reused[method]
+            continue
+        if method not in args.firing_methods:
+            continue
         firings[method], _ = spawn_phase('firing', args.model, 8,
                                          args.image, args.iters,
                                          inverse_method=method)
@@ -515,6 +530,17 @@ def main(argv=None):
     p.add_argument('--reuse-legs', default=None,
                    help="e.g. 'sgd=16.03,precond=19.54,factors=31.28' "
                         'from a prior recorded run')
+    p.add_argument('--firing-methods', nargs='+',
+                   default=['auto', 'cholesky', 'eigen'],
+                   choices=['auto', 'cholesky', 'eigen'],
+                   help='inverse-firing legs to measure; the firing is '
+                        'remat/batch-independent, so sessions that vary '
+                        'only those can pass just "auto" (~10 min '
+                        'compile saved per skipped method)')
+    p.add_argument('--reuse-firing', default=None,
+                   help="e.g. 'auto=131.9' ms from a prior recorded "
+                        'run of the SAME factor set — composition rows '
+                        'use it without re-measuring')
     args = p.parse_args(argv)
     if args.phase:
         run_phase(args)

@@ -1,0 +1,86 @@
+"""MobileNetV1 (Howard et al. 2017), flax NHWC — the depthwise workload.
+
+BEYOND the reference: its layer registry has no conv variant for
+``feature_group_count != 1`` (``kfac/layers/__init__.py:13-36``), so
+on MobileNet-class models it silently loses preconditioning on every
+depthwise layer (13 of the 27 weight layers here). This framework's
+``conv2d_grouped`` kind (per-group block-diagonal factors, see
+``layers/base.py`` / ``ops/factors.py``) preconditions all of them,
+making MobileNetV1 the natural measured workload for that path
+(``benchmarks/depthwise_bench.py``).
+
+Architecture: 3x3/2 stem conv, then 13 depthwise-separable blocks
+(3x3 depthwise + 1x1 pointwise, each BN+ReLU), global average pool,
+Dense head — widths scaled by ``width_mult`` as in the paper. All
+weight layers are `nn.Conv`/`nn.Dense`, so K-FAC registers everything;
+bf16 activations via ``dtype`` with fp32 BatchNorm statistics.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_KAIMING = nn.initializers.kaiming_normal()
+
+# (pointwise out-planes, depthwise stride) per separable block — the
+# paper's 13-block body (Table 1): 64, 128x2, 256x2, 512x6, 1024x2.
+_BODY = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+         (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+         (1024, 1)]
+
+
+def _bn(train: bool, dtype, name: str, momentum: float = 0.9):
+    return nn.BatchNorm(use_running_average=not train, momentum=momentum,
+                        epsilon=1e-5, dtype=dtype, name=name)
+
+
+class SeparableBlock(nn.Module):
+    """3x3 depthwise conv + 1x1 pointwise conv, each BN+ReLU."""
+
+    planes: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+    bn_momentum: float = 0.9
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        in_ch = x.shape[-1]
+        y = nn.Conv(in_ch, (3, 3), (self.stride, self.stride), padding=1,
+                    feature_group_count=in_ch, use_bias=False,
+                    dtype=self.dtype, kernel_init=_KAIMING, name='dw')(x)
+        y = nn.relu(_bn(train, self.dtype, 'bn_dw', self.bn_momentum)(y))
+        y = nn.Conv(self.planes, (1, 1), use_bias=False, dtype=self.dtype,
+                    kernel_init=_KAIMING, name='pw')(y)
+        return nn.relu(_bn(train, self.dtype, 'bn_pw', self.bn_momentum)(y))
+
+
+class MobileNetV1(nn.Module):
+    """Stem + 13 separable blocks + pooled Dense head."""
+
+    num_classes: int = 1000
+    width_mult: float = 1.0
+    dtype: jnp.dtype = jnp.float32
+    bn_momentum: float = 0.9
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        def w(planes):
+            return max(8, int(planes * self.width_mult))
+
+        y = nn.Conv(w(32), (3, 3), (2, 2), padding=1, use_bias=False,
+                    dtype=self.dtype, kernel_init=_KAIMING, name='conv1')(x)
+        y = nn.relu(_bn(train, self.dtype, 'bn1', self.bn_momentum)(y))
+        for i, (planes, stride) in enumerate(_BODY):
+            y = SeparableBlock(w(planes), stride, dtype=self.dtype,
+                               bn_momentum=self.bn_momentum,
+                               name=f'block{i}')(y, train)
+        y = jnp.mean(y, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        kernel_init=_KAIMING, name='fc')(y)
+
+
+def get_model(num_classes: int = 1000, width_mult: float = 1.0,
+              dtype=jnp.float32, bn_momentum: float = 0.9) -> MobileNetV1:
+    return MobileNetV1(num_classes=num_classes, width_mult=width_mult,
+                       dtype=dtype, bn_momentum=bn_momentum)

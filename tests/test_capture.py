@@ -335,8 +335,30 @@ class TestSkippedReporting:
     hard-errors on module kinds it refuses (kfac/layers/__init__.py:31-33);
     here declined convs warn and everything unpreconditioned is listed."""
 
-    def test_depthwise_conv_warns_and_reported(self):
+    def test_depthwise_conv_registered_as_grouped(self):
+        """Round 5: depthwise/grouped convs are PRECONDITIONED (kind
+        conv2d_grouped, per-group block factors) instead of declined —
+        the round-2..4 decline behavior this test originally pinned.
+        Dilated convs remain the loud-decline example below."""
         cap = KFACCapture(_DepthwiseNet())
+        variables, specs = cap.init(jax.random.PRNGKey(0),
+                                    jnp.zeros((2, 8, 8, 3)))
+        assert 'Conv_0' in specs and 'Dense_0' in specs
+        assert specs['Conv_1'].kind == 'conv2d_grouped'
+        assert specs['Conv_1'].feature_group_count == 8
+        assert 'Conv_1' not in cap.skipped_modules
+
+    def test_dilated_conv_warns_and_reported(self):
+        class DilatedNet(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.Conv(8, (3, 3))(x)
+                x = nn.relu(x)
+                x = nn.Conv(8, (3, 3), kernel_dilation=(2, 2))(x)
+                x = x.reshape(x.shape[0], -1)
+                return nn.Dense(4)(x)
+
+        cap = KFACCapture(DilatedNet())
         with pytest.warns(UserWarning, match='cannot precondition'):
             variables, specs = cap.init(jax.random.PRNGKey(0),
                                         jnp.zeros((2, 8, 8, 3)))
@@ -344,7 +366,7 @@ class TestSkippedReporting:
         assert 'Conv_1' not in specs
         skipped = cap.skipped_modules
         assert 'Conv_1' in skipped
-        assert 'feature_group_count' in skipped['Conv_1']
+        assert 'dilated' in skipped['Conv_1']
         # The declined conv still trains (plain grads) — its params exist.
         assert 'Conv_1' in variables['params']
 
@@ -406,9 +428,11 @@ class TestSkippedReporting:
                    for k, v in skipped.items() if 'BatchNorm' in k)
 
     def test_skip_layers_recorded(self):
+        # skip_layers matches are recorded but NOT warned (they are a
+        # user request, unlike declined convs); round 5's grouped-conv
+        # support means _DepthwiseNet registers cleanly otherwise.
         cap = KFACCapture(_DepthwiseNet(), skip_layers=['dense'])
-        with pytest.warns(UserWarning):
-            cap.init(jax.random.PRNGKey(0), jnp.zeros((2, 8, 8, 3)))
+        cap.init(jax.random.PRNGKey(0), jnp.zeros((2, 8, 8, 3)))
         assert cap.skipped_modules.get('Dense_0') == 'skip_layers match'
 
 

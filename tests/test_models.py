@@ -146,3 +146,39 @@ def test_bn_momentum_and_remat_knobs():
     jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
                                                          atol=1e-7),
                  outs[False][1], outs[True][1])
+
+
+def test_mobilenet_param_count_and_registration():
+    """MobileNetV1 1.0x @ 1000 classes is 4.23M params (Howard et al.
+    Table 1 reports 4.2M); every weight layer must register — the 13
+    depthwise convs as conv2d_grouped (the reference's registry cannot
+    precondition these at all, kfac/layers/__init__.py:13-36)."""
+    from distributed_kfac_pytorch_tpu.models import mobilenet
+    model = mobilenet.get_model()
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 224, 224, 3)), train=False)
+    count = n_params(variables['params'])
+    assert abs(count / 1e6 - 4.23) < 0.03, count
+
+    k = kfac.KFAC(model)
+    x = jnp.zeros((2, 64, 64, 3))
+    k.init(jax.random.PRNGKey(0), x)
+    kinds = {n: s.kind for n, s in k.specs.items()}
+    dw = [n for n, kind in kinds.items() if kind == 'conv2d_grouped']
+    assert len(dw) == 13, kinds
+    # stem + 13 pointwise convs + head register on the dense conv path
+    assert sum(kind == 'conv2d' for kind in kinds.values()) == 14
+    assert kinds['fc'] == 'linear'
+    # Only BatchNorms (plain-gradient params) may be unregistered — no
+    # conv may be declined.
+    assert all('bn' in name for name in k.capture.skipped_modules)
+
+
+def test_mobilenet_width_mult_forward():
+    from distributed_kfac_pytorch_tpu.models import mobilenet
+    model = mobilenet.get_model(num_classes=10, width_mult=0.25)
+    x = jax.random.normal(jax.random.key(1), (2, 64, 64, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+    assert bool(jnp.isfinite(out).all())
