@@ -26,6 +26,8 @@ import json
 import os
 import sys
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -106,11 +108,18 @@ def build(kfac, variables, kstate, model, x, y, inv_freq, n_iters, mode):
     else:
         raise ValueError(mode)
 
-    @jax.jit
+    # Donated carry — mirror of flagship_resnet50.phase_step_leg
+    # (time_chained chains carry = run(carry); the old carry is dead).
+    # Unlike the flagship (one subprocess per leg), every mode here
+    # shares one process and one (variables, kstate), so donate a
+    # fresh device COPY — donating the originals would delete them
+    # for the next mode's leg.
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def run(carry):
         carry, losses = jax.lax.scan(body, carry, None, length=n_iters)
         return carry, losses[-1]
-    return run, (params, opt_state, kstate, extra)
+    carry0 = jax.tree.map(jnp.copy, (params, opt_state, kstate, extra))
+    return run, carry0
 
 
 def main(argv=None):

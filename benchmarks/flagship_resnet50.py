@@ -39,6 +39,7 @@ verdict critique of bench_matrix's resnet18 fallback).
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
 import subprocess
@@ -158,7 +159,12 @@ def phase_step_leg(model_name, batch, image, mode, n_iters,
             return (params, opt_state, kst, {**extra, **updated}), l
         carry0 = (params, opt_state, kstate, extra)
 
-    @jax.jit
+    # Donated carry: time_chained chains carry = run(carry), so the
+    # previous carry is dead at each call — donation halves the
+    # resident (params, opt_state, kstate) footprint, the difference
+    # between fitting and OOMing the monolithic b128 remat legs (the
+    # LM flagship's memory lesson, benchmarks/flagship_lm.py:240).
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def run(carry):
         carry, losses = jax.lax.scan(body, carry, None, length=n_iters)
         return carry, losses[-1]
@@ -325,6 +331,13 @@ def run_phase(args):
         import jax.numpy as jnp
         kw = {'factor_dtype': jnp.bfloat16,
               'factor_compute_dtype': jnp.bfloat16}
+    if args.bf16_inverses:
+        import jax.numpy as jnp
+        # Decompositions stay fp32 (the reference computes in fp32 and
+        # stores in inv_dtype, which may be half precision — base.py:
+        # 435-441); storage halves so the monolithic b128 remat capture
+        # path fits HBM (the LM flagship's recipe at xl scale).
+        kw['inv_dtype'] = jnp.bfloat16
     if args.inverse_method:
         kw['inverse_method'] = args.inverse_method
     if args.factor_batch_fraction is not None:
@@ -353,7 +366,7 @@ def run_phase(args):
 
 def spawn_phase(phase, model, batch, image, iters, bf16=False,
                 inverse_method=None, model_dtype=None,
-                factor_batch_fraction=None, remat=False):
+                factor_batch_fraction=None, remat=False, bf16_inv=False):
     cmd = [sys.executable, os.path.abspath(__file__), '--phase', phase,
            '--model', model, '--batch', str(batch), '--image', str(image),
            '--iters', str(iters)]
@@ -363,6 +376,8 @@ def spawn_phase(phase, model, batch, image, iters, bf16=False,
         cmd.append('--remat')
     if bf16:
         cmd.append('--bf16-factors')
+    if bf16_inv:
+        cmd.append('--bf16-inverses')
     if inverse_method:
         cmd += ['--inverse-method', inverse_method]
     if factor_batch_fraction is not None:
@@ -399,9 +414,12 @@ def config2(args):
             mode, args.model, args.batch, args.image, args.iters,
             model_dtype=args.model_dtype,
             factor_batch_fraction=args.factor_batch_fraction,
-            remat=args.remat)
+            remat=args.remat, bf16=args.bf16_factors,
+            bf16_inv=args.bf16_inverses)
         emit({'config': 2, 'phase': mode, 'batch': args.batch,
               'image': args.image, 'remat': args.remat,
+              'bf16_factors': args.bf16_factors,
+              'bf16_inverses': args.bf16_inverses,
               'ms_per_iter': rows[mode], 'mfu': mfus.get(mode)})
     # The monolithic capture+factors+inverse program exceeds the compile
     # limit (tried each round; poisons the session) — the firing is
@@ -429,7 +447,9 @@ def config2(args):
             continue
         firings[method], _ = spawn_phase('firing', args.model, 8,
                                          args.image, args.iters,
-                                         inverse_method=method)
+                                         inverse_method=method,
+                                         bf16=args.bf16_factors,
+                                         bf16_inv=args.bf16_inverses)
         emit({'config': 2,
               'phase': f'inverse_firing_standalone_{method}',
               'ms_per_firing': firings[method]})
@@ -459,7 +479,11 @@ def config2(args):
             out = {'config': 2, 'row_schema': 2,
                    'workload': (f'{args.model}_imagenet{args.image}'
                                 f'_b{args.batch}'
-                                + ('_remat' if args.remat else '')),
+                                + ('_remat' if args.remat else '')
+                                + ('_bf16state' if args.bf16_factors
+                                   or args.bf16_inverses else '')),
+                   'bf16_factors': args.bf16_factors,
+                   'bf16_inverses': args.bf16_inverses,
                    'unit': 'ms/iter', 'sgd': rows['sgd'],
                    'mfu_sgd': mfus.get('sgd'),
                    'every_iter': base,
@@ -513,6 +537,10 @@ def main(argv=None):
     p.add_argument('--phase', default=None,
                    help='internal: run a single measurement leg')
     p.add_argument('--bf16-factors', action='store_true')
+    p.add_argument('--bf16-inverses', action='store_true',
+                   help='bf16 inverse storage (inv_dtype; decompositions '
+                        'stay fp32) — halves K-FAC state so the '
+                        'monolithic b128 remat capture path fits HBM')
     p.add_argument('--remat', action='store_true',
                    help='block-level gradient checkpointing on the '
                         'model (fits monolithic b128+ @224 bf16 with '

@@ -196,3 +196,44 @@ def test_kfac_step_warm_matches_dense_oracle():
     got = np.asarray(L.grads_to_matrix(spec, precond['Dense_0']))
     rel = np.linalg.norm(got - oracle) / np.linalg.norm(oracle)
     assert rel < 1e-3, rel
+
+
+def test_subspace_rotation_properties():
+    """middim_eigen.subspace_rotation: orthogonal, spectral angle =
+    requested, identity outside the rank-k subspace — the cheap
+    warm-basis perturbation the mid-dim bench uses in place of the
+    full-space `rand_rotation` (whose complex n x n eigh is minutes per
+    matrix at 2304 on this host)."""
+    from benchmarks.middim_eigen import subspace_rotation
+    rng = np.random.default_rng(0)
+    n, k, angle = 96, 16, 0.1
+    q = subspace_rotation(rng, n, angle, k=k)
+    np.testing.assert_allclose(q @ q.T, np.eye(n), atol=1e-10)
+    # Rotation angles = phases of the unitary's eigenvalues: max must
+    # be the requested spectral angle (rand_rotation normalizes to it),
+    # and exactly n - 2k of them must be zero (identity complement).
+    phases = np.abs(np.angle(np.linalg.eigvals(q)))
+    assert abs(phases.max() - angle) < 1e-8
+    assert (phases < 1e-10).sum() >= n - 2 * k
+    # k >= n clamps instead of crashing.
+    q_small = subspace_rotation(rng, 8, angle, k=16)
+    np.testing.assert_allclose(q_small @ q_small.T, np.eye(8),
+                               atol=1e-10)
+
+
+def test_polish_recovers_subspace_rotated_basis():
+    """The mid-dim bench's steady-state model must be inside polish's
+    capture range: a subspace-rotated exact basis polishes back to
+    ~exact preconditioning accuracy (this is the property the first cut
+    of the bench violated with an angle ~sqrt(dim) entry-scaled skew)."""
+    from benchmarks.middim_eigen import subspace_rotation
+    rng = np.random.default_rng(1)
+    n = 64
+    spec = np.geomspace(1e-4, 1.0, n)
+    qe, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    a = (qe * spec) @ qe.T
+    _, v = np.linalg.eigh(a)
+    warm = jnp.asarray(v @ subspace_rotation(rng, n, 0.1), jnp.float32)
+    q, d = linalg.eigh_polish(jnp.asarray(a, jnp.float32), warm, iters=8)
+    err = _precond_rel_err(a, np.asarray(q), np.asarray(d))
+    assert err < 5e-3, err
