@@ -269,7 +269,8 @@ def phase_accum_leg(model_name, batch, image, mode, n_iters, accum=2,
         params = optax.apply_updates(params, updates)
         return (params, opt_state, kst, extra2), ls[-1]
 
-    @jax.jit
+    # Donated carry — same rationale as phase_step_leg above.
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def run(carry):
         carry, losses = jax.lax.scan(body, carry, None, length=n_iters)
         return carry, losses[-1]
@@ -349,6 +350,7 @@ def run_phase(args):
     elif args.phase in ('accum_nofactor', 'accum_factors'):
         ms, mfu = phase_accum_leg(args.model, args.batch, args.image,
                                   args.phase, args.iters,
+                                  accum=args.accum,
                                   model_dtype=args.model_dtype,
                                   remat=args.remat, **kw)
         emit({'phase_result': round(ms, 2), 'mfu': mfu})
@@ -536,6 +538,10 @@ def main(argv=None):
     p.add_argument('--configs', type=int, nargs='+', default=[2, 5])
     p.add_argument('--phase', default=None,
                    help='internal: run a single measurement leg')
+    p.add_argument('--accum', type=int, default=2,
+                   help='micro-batches per optimizer step for the '
+                        'accum_* phases (batch is the MICRO batch; '
+                        'the leg is b{batch*accum}-equivalent)')
     p.add_argument('--bf16-factors', action='store_true')
     p.add_argument('--bf16-inverses', action='store_true',
                    help='bf16 inverse storage (inv_dtype; decompositions '
