@@ -70,6 +70,12 @@ class OptimConfig:
     # bf16) — the reference's --fp16 factor mode. For bf16 matmuls with
     # fp32 running averages, pass factor_compute_dtype to KFAC directly.
     bf16_factors: bool = False
+    # bf16 INVERSE storage (KFAC inv_dtype; decompositions stay fp32 —
+    # the reference's configurable inv_dtype, base.py:435-441). Halves
+    # K-FAC state; with bf16_factors it is what fits the monolithic
+    # b256 ResNet-50 capture-free step on a 16 GB chip and speeds the
+    # 'auto' firing 1.5x (PERF.md round 5).
+    bf16_inverses: bool = False
     skip_layers: Sequence[str] = ()
     symmetry_aware_comm: bool = False
     comm_method: str = 'comm-opt'
@@ -161,6 +167,8 @@ def get_optimizer(model, cfg: OptimConfig):
             factor_dtype=jnp.bfloat16 if cfg.bf16_factors else None,
             factor_compute_dtype=(jnp.bfloat16 if cfg.bf16_factors
                                   else None),
+            inv_dtype=(jnp.bfloat16 if cfg.bf16_inverses
+                       else jnp.float32),
             skip_layers=list(cfg.skip_layers) or None,
             symmetry_aware_comm=cfg.symmetry_aware_comm,
             comm_method=COMM_METHODS[cfg.comm_method.lower()],

@@ -128,6 +128,11 @@ def parse_args(argv=None):
                    help='bf16 factor storage/averaging + bf16 covariance '
                         'matmul inputs (matmuls accumulate fp32); the '
                         'reference fp16 factor mode')
+    p.add_argument('--bf16-inverses', action='store_true',
+                   help='bf16 inverse storage (decompositions stay '
+                        'fp32); with --bf16-factors this is the '
+                        'measured b256 production config on 16 GB '
+                        'chips (PERF.md round 5)')
     p.add_argument('--fp16', action='store_true',
                    help='fp16 model compute with dynamic loss scaling + '
                         'overflow-skip (GradScaler parity — the '
@@ -207,7 +212,8 @@ def main(argv=None):
         damping_schedule=args.damping_decay,
         kfac_update_freq_alpha=args.kfac_update_freq_alpha,
         kfac_update_freq_schedule=args.kfac_update_freq_decay,
-        bf16_factors=args.bf16_factors)
+        bf16_factors=args.bf16_factors,
+        bf16_inverses=args.bf16_inverses)
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(model, cfg)
 
     x0 = jnp.zeros((2, args.image_size, args.image_size, 3), jnp.float32)

@@ -165,6 +165,17 @@ class TestOptimizers:
         assert kfac.comm_method is CommMethod.HYBRID_OPT
         assert lr_sched(0) == pytest.approx(cfg.base_lr)
 
+    def test_bf16_inverses_wired(self):
+        import jax.numpy as jnp
+        model = cifar_resnet.get_model('resnet20')
+        cfg = optimizers.OptimConfig(kfac_inv_update_freq=10,
+                                     bf16_inverses=True)
+        _, _, kfac, _ = optimizers.get_optimizer(model, cfg)
+        assert kfac.inv_dtype == jnp.bfloat16
+        cfg = optimizers.OptimConfig(kfac_inv_update_freq=10)
+        _, _, kfac, _ = optimizers.get_optimizer(model, cfg)
+        assert kfac.inv_dtype == jnp.float32
+
     def test_kfac_disabled_when_freq_zero(self):
         model = cifar_resnet.get_model('resnet20')
         cfg = optimizers.OptimConfig(kfac_inv_update_freq=0)
