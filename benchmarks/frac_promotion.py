@@ -30,9 +30,22 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# Per-fraction tuned damping (round-4 A/B, PERF.md): thinning the
-# covariance sample raises estimator noise; damping is its control.
-DAMPING = {1.0: 0.003, 0.5: 0.003, 0.25: 0.03}
+# Per-(workload, fraction) tuned hypers. Base points are the round-4
+# both-tuned configs (CONVERGENCE_CONV_GN.json: conv lr 0.1 / damping
+# 0.003 + alpha-0.5 decay; CONVERGENCE.json MLP study: lr 0.01 /
+# damping 0.1, no damping schedule — the first cut of this study ran
+# the MLP at the conv protocol and collapsed every seed, which is a
+# protocol bug, not a fraction result). Thinned fractions take the
+# round-4 A/B's 10x damping bump (thinner covariance sample -> more
+# estimator noise -> more damping).
+DAMPING = {
+    'resnet20gn': {1.0: 0.003, 0.5: 0.003, 0.25: 0.03},
+    'mlp': {1.0: 0.1, 0.5: 0.1, 0.25: 0.3},
+}
+BASE_LR = {'resnet20gn': 0.1, 'mlp': 0.01}
+DAMPING_SCHED = {'resnet20gn': ['--damping-alpha', '0.5',
+                                '--damping-decay', '10', '20'],
+                 'mlp': []}
 
 # Fixed common targets: the recorded both-tuned targets of the round-4
 # studies (CONVERGENCE_CONV_GN.json / CONVERGENCE.json MLP study), so
@@ -46,8 +59,9 @@ def run_one(workload, seed, frac, args):
            '--model', workload, '--epochs', str(args.epochs),
            '--batch-size', '256', '--label-noise', '0.2',
            '--only', 'kfac', '--seed', str(seed),
-           '--base-lr', '0.1', '--damping', str(DAMPING[frac]),
-           '--damping-alpha', '0.5', '--damping-decay', '10', '20',
+           '--base-lr', str(BASE_LR[workload]),
+           '--damping', str(DAMPING[workload][frac]),
+           *DAMPING_SCHED[workload],
            '--factor-batch-fraction', str(frac),
            '--out', out]
     r = subprocess.run(cmd, capture_output=True, text=True,
@@ -75,7 +89,7 @@ def main(argv=None):
                    default=[0, 1, 2, 3, 4])
     p.add_argument('--fractions', type=float, nargs='+',
                    default=[1.0, 0.5, 0.25],
-                   choices=sorted(DAMPING),
+                   choices=[1.0, 0.5, 0.25],
                    help='fractions with a tuned damping entry '
                         '(extend DAMPING for new values)')
     p.add_argument('--epochs', type=int, default=30)
@@ -110,7 +124,7 @@ def main(argv=None):
             'best_val_mean': round(statistics.mean(bests), 4),
             'best_val_std': (round(statistics.stdev(bests), 4)
                              if len(bests) > 1 else 0.0),
-            'damping': DAMPING[frac],
+            'damping': DAMPING[args.workload][frac],
         }
 
     result = {'study': 'factor_batch_fraction_promotion',
