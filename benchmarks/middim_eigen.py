@@ -96,15 +96,27 @@ def make_variants(dim, n_mats, n_variants, angle=0.1, seed=0):
     return variants, oracle
 
 
+def _fetch_scalar(out):
+    """Host-fetch one element of the output — a hard data dependency
+    that closes the timing window. Per-call ``block_until_ready`` is
+    NOT a reliable completion barrier through the tunneled backend
+    (bench.py's documented failure mode: calls acknowledged, not
+    executed — this bench's first cut recorded a 0.04 ms '2304 eigh'
+    exactly that way)."""
+    leaf = jax.tree.leaves(out)[0]
+    return float(leaf.reshape(-1)[0].real)
+
+
 def time_variants(fn, variants, repeats):
     """Compile on variant 0, then time one call per distinct variant;
     returns (best seconds, variant-0 output)."""
-    out0 = jax.block_until_ready(fn(*variants[0]))  # compile
+    out0 = fn(*variants[0])  # compile
+    _fetch_scalar(out0)
     best = float('inf')
     for i in range(1, min(repeats + 1, len(variants))):
         args = variants[i]
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        _fetch_scalar(fn(*args))
         best = min(best, time.perf_counter() - t0)
     return best, out0
 
