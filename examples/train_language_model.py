@@ -121,6 +121,10 @@ def parse_args(argv=None):
     p.add_argument('--grad-worker-fraction', type=float, default=0.25)
     p.add_argument('--symmetry-aware-comm', action='store_true',
                    help='triu-packed factor allreduce (halved bytes)')
+    p.add_argument('--bf16-inverses', action='store_true',
+                   help='bf16 inverse storage (decompositions stay fp32) '
+                        '— at Transformer-XL scale the fp32 inverse '
+                        'stacks alone are ~3.2 GB (PERF.md round 5)')
     p.add_argument('--bf16-factors', action='store_true',
                    help='bf16 factor storage/averaging + bf16 covariance '
                         'matmul inputs (matmuls accumulate fp32); the '
@@ -191,7 +195,8 @@ def main(argv=None):
         skip_layers=args.skip_layers, comm_method=args.comm_method,
         grad_worker_fraction=args.grad_worker_fraction,
         symmetry_aware_comm=args.symmetry_aware_comm,
-        bf16_factors=args.bf16_factors)
+        bf16_factors=args.bf16_factors,
+        bf16_inverses=args.bf16_inverses)
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(model, cfg)
     if kfac is None:
         raise SystemExit('use --kfac-update-freq >= 1')
