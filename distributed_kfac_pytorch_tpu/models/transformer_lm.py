@@ -22,15 +22,23 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from distributed_kfac_pytorch_tpu.parallel.sequence import (
+    chunked_causal_attention,
     local_causal_attention,
     ring_self_attention,
 )
 
 
 class CausalSelfAttention(nn.Module):
-    """Multi-head causal self-attention from four K-FAC-visible Denses."""
+    """Multi-head causal self-attention from four K-FAC-visible Denses.
+
+    ``attn_block_size`` (single-device only) switches to the
+    memory-efficient chunked fold — O(seq * block) live logits instead
+    of O(seq^2) — for long contexts that fit one chip's compute but not
+    monolithic attention's score tensor.
+    """
     num_heads: int
     seq_axis: str | None = None
+    attn_block_size: int | None = None
     dtype: Any = None    # compute dtype (params stay fp32); None = infer
 
     @nn.compact
@@ -49,6 +57,9 @@ class CausalSelfAttention(nn.Module):
         v = heads(nn.Dense(d_model, dtype=self.dtype, name='v_proj')(x))
         if self.seq_axis is not None:
             o = ring_self_attention(q, k, v, axis_name=self.seq_axis)
+        elif self.attn_block_size is not None:
+            o = chunked_causal_attention(q, k, v,
+                                         block_size=self.attn_block_size)
         else:
             o = local_causal_attention(q, k, v)
         o = o.reshape(*x.shape[:-1], d_model).astype(x.dtype)
@@ -61,12 +72,14 @@ class TransformerBlock(nn.Module):
     mlp_ratio: int = 4
     dropout: float = 0.0
     seq_axis: str | None = None
+    attn_block_size: int | None = None
     dtype: Any = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
         d_model = x.shape[-1]
         h = CausalSelfAttention(self.num_heads, seq_axis=self.seq_axis,
+                                attn_block_size=self.attn_block_size,
                                 dtype=self.dtype, name='attn')(
             nn.LayerNorm(dtype=self.dtype, name='ln1')(x))
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
@@ -99,6 +112,7 @@ class TransformerLM(nn.Module):
     dropout: float = 0.1
     tie_weights: bool = True
     seq_axis: str | None = None
+    attn_block_size: int | None = None
     dtype: Any = None    # compute dtype (params stay fp32); None = infer
 
     @nn.compact
@@ -114,7 +128,9 @@ class TransformerLM(nn.Module):
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
         for i in range(self.num_layers):
             x = TransformerBlock(self.num_heads, dropout=self.dropout,
-                                 seq_axis=self.seq_axis, dtype=self.dtype,
+                                 seq_axis=self.seq_axis,
+                                 attn_block_size=self.attn_block_size,
+                                 dtype=self.dtype,
                                  name=f'block{i}')(x, train=train)
         x = nn.LayerNorm(dtype=self.dtype, name='ln_f')(x)
         if self.tie_weights:

@@ -12,6 +12,7 @@ from distributed_kfac_pytorch_tpu.parallel.distributed import (
 )
 from distributed_kfac_pytorch_tpu.parallel.sequence import (
     SEQ_AXIS,
+    chunked_causal_attention,
     local_causal_attention,
     ring_self_attention,
 )

@@ -14,6 +14,10 @@ O(T_global^2), and the K/V transfer overlaps with the block matmuls.
 ``ring_self_attention`` is the in-``shard_map`` building block;
 ``local_causal_attention`` is the single-device fallback with identical
 semantics, so models can be written once and run at either scale.
+``chunked_causal_attention`` is the single-device long-context leg:
+the same block fold scanned within one device with per-block
+rematerialization, pushing the attention-memory wall out by ~block/(3D)
+without a mesh (see its docstring for the exact contract).
 """
 
 from __future__ import annotations
@@ -35,6 +39,15 @@ def _block_attend(q, k, v, scale, qpos, kpos, causal):
     q: (B, Tq, H, D), k/v: (B, Tk, H, D); qpos/kpos: (Tq,)/(Tk,) global
     token positions. Returns (scores_max, exp_scores @ v, exp_scores sum)
     per (B, H, Tq).
+
+    Operands enter the QK^T einsum at their INPUT dtype with fp32
+    accumulation (``preferred_element_type``) — the native MXU contract
+    (bf16 in, fp32 out). Upcasting operands first would halve matmul
+    throughput for identical accumulation; each logit is one q.k dot
+    product of the same operand rows in either the ring or the local
+    path, so blockwise vs monolithic results stay bitwise-comparable
+    at any operand dtype. Softmax statistics (m, l) and the output
+    accumulator are always fp32.
     """
     logits = jnp.einsum('bqhd,bkhd->bhqk', q, k,
                         preferred_element_type=jnp.float32) * scale
@@ -50,6 +63,24 @@ def _block_attend(q, k, v, scale, qpos, kpos, causal):
     o = jnp.einsum('bhqk,bkhd->bqhd', p, v,
                    preferred_element_type=jnp.float32)
     return m, o, l
+
+
+def _fold_update(o, m, l, bm, bo, bl):
+    """Fold one block's (max, out, sum) contribution into the running
+    online-softmax accumulators. Shared by the ring loop and the bench's
+    per-device emulation (benchmarks/ring_attention_bench.py), so the
+    measured schedule can never drift from the shipped algorithm.
+
+    exp of (-inf) - (-inf) is NaN; fully-masked contributions carry
+    m == _NEG_INF (finite sentinel), so the corrections stay finite.
+    """
+    new_m = jnp.maximum(m, bm)
+    corr_old = jnp.exp(m - new_m)
+    corr_new = jnp.exp(bm - new_m)
+    l = l * corr_old + bl * corr_new
+    o = (o * jnp.moveaxis(corr_old, 1, 2)[..., None]
+         + bo * jnp.moveaxis(corr_new, 1, 2)[..., None])
+    return o, new_m, l
 
 
 def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -69,7 +100,6 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     idx = jax.lax.axis_index(axis_name)
     b, t, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    q = q.astype(jnp.float32)
     local_pos = jnp.arange(t)
     qpos = idx * t + local_pos
 
@@ -80,18 +110,9 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         # After `step` rotations we hold the block of device (idx - step).
         src = (idx - step) % s
         kpos = src * t + local_pos
-        bm, bo, bl = _block_attend(q, k_cur.astype(jnp.float32),
-                                   v_cur.astype(jnp.float32),
+        bm, bo, bl = _block_attend(q, k_cur, v_cur,
                                    scale, qpos, kpos, causal)
-        new_m = jnp.maximum(m, bm)
-        corr_old = jnp.exp(m - new_m)
-        corr_new = jnp.exp(bm - new_m)
-        # exp of (-inf) - (-inf) is NaN; fully-masked contributions carry
-        # m == _NEG_INF (finite sentinel), so corr stays finite.
-        l = l * corr_old + bl * corr_new
-        o = (o * jnp.moveaxis(corr_old, 1, 2)[..., None]
-             + bo * jnp.moveaxis(corr_new, 1, 2)[..., None])
-        return o, new_m, l
+        return _fold_update(o, m, l, bm, bo, bl)
 
     def body(step, carry):
         o, m, l, k_cur, v_cur = carry
@@ -117,8 +138,58 @@ def local_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """Single-device attention with the same contract as the ring path."""
     b, t, h, d = q.shape
     pos = jnp.arange(t)
-    m, o, l = _block_attend(q.astype(jnp.float32), k.astype(jnp.float32),
-                            v.astype(jnp.float32), 1.0 / (d ** 0.5),
-                            pos, pos, causal)
+    m, o, l = _block_attend(q, k, v, 1.0 / (d ** 0.5), pos, pos, causal)
+    l = jnp.maximum(jnp.moveaxis(l, 1, 2)[..., None], 1e-30)
+    return o / l
+
+
+def chunked_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                             *, block_size: int,
+                             causal: bool = True) -> jax.Array:
+    """Memory-efficient single-device attention: monolithic attention
+    materializes O(S^2) logits (16 GB at B4/H16/S8192 fp32 — past one
+    chip's HBM, the measured OOM wall in RING_ATTENTION.json), while
+    this folds K/V blocks of ``block_size`` tokens through the same
+    online-softmax update as the ring (`_block_attend`/`_fold_update`),
+    keeping only O(S * block_size) logits live. Each fold is
+    ``jax.checkpoint``-ed, so the backward pass recomputes block logits
+    instead of storing them — the Rabe & Staats memory-efficient
+    attention, here sharing the ring's exact fold code. Exact (not an
+    approximation): same dot products, fp32 softmax statistics.
+
+    Memory contract, precisely: logits never materialize beyond one
+    (S x block) slab, but the scan backward still saves the carry —
+    (S/block) copies of the (B, S, H, D) accumulators — so training
+    residuals scale as O(S^2 * D / block): the S^2 wall is *shifted* by
+    ~block/(3D) (measured: trains S=16384 on a 16 GB chip at B4/H16/D64
+    where monolithic attention cannot run forward past S=4096;
+    RING_ATTENTION.json 'chunked'), not removed. For sequences past
+    that, shard over a mesh axis with the ring. No reference analogue
+    (BPTT-35 truncation is its only long-sequence mechanism). Returns
+    (B, T, H, D) fp32.
+    """
+    b, t, h, d = q.shape
+    if t % block_size:
+        raise ValueError(f'seq {t} not divisible by {block_size=}')
+    s = t // block_size
+    scale = 1.0 / (d ** 0.5)
+    qpos = jnp.arange(t)
+    k_blocks = jnp.moveaxis(k.reshape(b, s, block_size, h, d), 1, 0)
+    v_blocks = jnp.moveaxis(v.reshape(b, s, block_size, h, d), 1, 0)
+    kpos = jnp.arange(t).reshape(s, block_size)
+
+    @jax.checkpoint
+    def fold(carry, blk):
+        o, m, l = carry
+        k_blk, v_blk, kp = blk
+        bm, bo, bl = _block_attend(q, k_blk, v_blk, scale, qpos, kp,
+                                   causal)
+        return _fold_update(o, m, l, bm, bo, bl), None
+
+    o0 = jnp.zeros((b, t, h, d), jnp.float32)
+    m0 = jnp.full((b, h, t), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    (o, m, l), _ = jax.lax.scan(fold, (o0, m0, l0),
+                                (k_blocks, v_blocks, kpos))
     l = jnp.maximum(jnp.moveaxis(l, 1, 2)[..., None], 1e-30)
     return o / l

@@ -87,6 +87,11 @@ def parse_args(argv=None):
     p.add_argument('--no-resume', action='store_true')
     p.add_argument('--seq-parallel', type=int, default=1,
                    help='sequence-parallel degree (transformer only)')
+    p.add_argument('--attn-block-size', type=int, default=None,
+                   help='single-device memory-efficient attention: fold '
+                        'K/V in blocks of this many tokens (O(seq*block) '
+                        'live logits instead of O(seq^2)); transformer '
+                        'only, ignored under --seq-parallel')
     # K-FAC (reference torch_language_model.py:74-104).
     p.add_argument('--kfac-update-freq', type=int, default=10,
                    help='inverse update interval; 0 disables K-FAC')
@@ -151,7 +156,10 @@ def build_model(args, vocab_size, seq_axis=None, dtype=None):
         vocab_size=vocab_size, d_model=args.emsize,
         num_layers=args.nlayers, num_heads=args.nheads,
         max_len=max(args.bptt, 16), dropout=args.dropout,
-        tie_weights=args.tied, seq_axis=seq_axis, dtype=dtype)
+        tie_weights=args.tied, seq_axis=seq_axis,
+        attn_block_size=(args.attn_block_size
+                         if seq_axis is None else None),
+        dtype=dtype)
 
 
 def main(argv=None):

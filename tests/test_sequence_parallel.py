@@ -24,10 +24,15 @@ def _qkv(rng, b, t, h, d):
 
 
 @pytest.mark.parametrize('causal', [True, False])
-def test_ring_attention_matches_local(causal):
+@pytest.mark.parametrize('dtype', [jnp.float32, jnp.bfloat16])
+def test_ring_attention_matches_local(causal, dtype):
+    """Ring == local at BOTH operand dtypes: each logit is one q.k dot
+    product of the same operand rows in either path (blocking does not
+    change a dot product), so the bf16-operand MXU contract preserves
+    mutual exactness — only fold-order fp32 rounding differs."""
     rng = np.random.RandomState(0)
     b, t, h, d = 2, 32, 2, 8       # t sharded 8-way -> 4 tokens/device
-    q, k, v = _qkv(rng, b, t, h, d)
+    q, k, v = (x.astype(dtype) for x in _qkv(rng, b, t, h, d))
     ref = seq.local_causal_attention(q, k, v, causal=causal)
 
     mesh = Mesh(np.asarray(jax.devices()), (seq.SEQ_AXIS,))
@@ -54,6 +59,91 @@ def test_local_attention_is_softmax_attention():
     ref = np.einsum('bhqk,bkhd->bqhd', p, v)
     out = seq.local_causal_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize('causal', [True, False])
+@pytest.mark.parametrize('dtype', [jnp.float32, jnp.bfloat16])
+def test_ring_bench_schedule_matches_monolithic(causal, dtype):
+    """Pin the perf bench's per-device emulation to the real algorithm:
+    ``ring_device_schedule`` at device ``i`` must equal rows
+    ``[i*T_local, (i+1)*T_local)`` of monolithic attention — so the
+    on-chip numbers in RING_ATTENTION.json time the exact compute one
+    ring device performs, not an approximation of it."""
+    from benchmarks.ring_attention_bench import ring_device_schedule
+
+    rng = np.random.RandomState(3)
+    b, t, h, d, s = 2, 32, 2, 8, 4
+    q, k, v = (x.astype(dtype) for x in _qkv(rng, b, t, h, d))
+    ref = np.asarray(seq.local_causal_attention(q, k, v, causal=causal))
+    t_local = t // s
+    k_stack = jnp.stack([k[:, i * t_local:(i + 1) * t_local]
+                         for i in range(s)])
+    v_stack = jnp.stack([v[:, i * t_local:(i + 1) * t_local]
+                         for i in range(s)])
+    for idx in range(s):
+        out = ring_device_schedule(
+            q[:, idx * t_local:(idx + 1) * t_local], k_stack, v_stack,
+            device_idx=idx, ring_size=s, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            ref[:, idx * t_local:(idx + 1) * t_local],
+            rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize('causal', [True, False])
+@pytest.mark.parametrize('dtype', [jnp.float32, jnp.bfloat16])
+def test_chunked_attention_matches_local(causal, dtype):
+    """Chunked (memory-efficient) attention is exact: same fold code as
+    the ring, only scanned within one device."""
+    rng = np.random.RandomState(4)
+    b, t, h, d = 2, 32, 2, 8
+    q, k, v = (x.astype(dtype) for x in _qkv(rng, b, t, h, d))
+    ref = seq.local_causal_attention(q, k, v, causal=causal)
+    for block in (4, 16, 32):
+        out = seq.chunked_causal_attention(q, k, v, block_size=block,
+                                           causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    with pytest.raises(ValueError, match='not divisible'):
+        seq.chunked_causal_attention(q, k, v, block_size=5)
+
+
+def test_chunked_attention_gradients_match_local():
+    """The checkpointed scan backward equals monolithic attention's
+    gradients — the training path, not just inference."""
+    rng = np.random.RandomState(5)
+    b, t, h, d = 2, 16, 2, 4
+    q, k, v = _qkv(rng, b, t, h, d)
+    w = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)  # loss weights
+
+    def loss(attn):
+        def f(q, k, v):
+            return jnp.sum(attn(q, k, v) * w)
+        return f
+
+    ref_grads = jax.grad(loss(seq.local_causal_attention),
+                         argnums=(0, 1, 2))(q, k, v)
+    chk_grads = jax.grad(
+        loss(lambda q, k, v: seq.chunked_causal_attention(
+            q, k, v, block_size=4)), argnums=(0, 1, 2))(q, k, v)
+    for g_ref, g_chk in zip(ref_grads, chk_grads):
+        np.testing.assert_allclose(np.asarray(g_chk), np.asarray(g_ref),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_transformer_lm_chunked_attention_same_logits():
+    """attn_block_size is a pure memory/layout knob: same params, same
+    logits as the monolithic path."""
+    kw = dict(vocab_size=61, size='tiny', max_len=16, dropout=0.0)
+    mono = transformer_lm.get_model(**kw)
+    chunked = transformer_lm.get_model(attn_block_size=4, **kw)
+    ids = jnp.asarray(np.random.RandomState(6).randint(0, 61, (2, 16)),
+                      jnp.int32)
+    variables = mono.init(jax.random.PRNGKey(0), ids, train=False)
+    ref = mono.apply(variables, ids, train=False)
+    out = chunked.apply(variables, ids, train=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
 
 
 def test_transformer_lm_kfac_registration():
