@@ -80,7 +80,8 @@ def _setup(args, with_kfac=True):
         args.model_dtype]
     model = transformer_lm.get_model(
         vocab_size=args.vocab, size=args.size, max_len=args.seq,
-        dropout=0.0, dtype=dt)
+        dropout=0.0, dtype=dt,
+        attn_block_size=args.attn_block_size)
     ids = jax.random.randint(jax.random.PRNGKey(1),
                              (args.batch, args.seq), 0, args.vocab)
     tgt = jax.random.randint(jax.random.PRNGKey(2),
@@ -316,6 +317,8 @@ def spawn_phase(args, phase, inverse_method=None):
         cmd.append('--bf16-inverses')
     if inverse_method:
         cmd += ['--inverse-method', inverse_method]
+    if args.attn_block_size:
+        cmd += ['--attn-block-size', str(args.attn_block_size)]
     try:
         out = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=2400, cwd=REPO)
@@ -352,6 +355,9 @@ def main(argv=None):
                         'reference supports half-precision inverse '
                         'storage too — preconditioner.py:149)')
     p.add_argument('--inverse-method', default=None)
+    p.add_argument('--attn-block-size', type=int, default=None,
+                   help='memory-efficient chunked attention (long-seq '
+                        'single-chip legs)')
     p.add_argument('--firing-methods', nargs='+',
                    default=['auto', 'cholesky', 'eigen'],
                    help='inverse methods to measure standalone firings '
@@ -371,6 +377,7 @@ def main(argv=None):
         emit({'config': 4, 'phase': mode, 'size': args.size,
               'seq': args.seq, 'batch': args.batch, 'vocab': args.vocab,
               'model_dtype': args.model_dtype,
+              'attn_block_size': args.attn_block_size,
               'ms_per_iter': rows[mode], 'mfu': mfus.get(mode)})
     firings = {}
     for method in args.firing_methods:
@@ -392,8 +399,10 @@ def main(argv=None):
     factor_cost = max(rows['factors'] - base, 0.0)
     for fire_method, fire_ms in methods:
         out = {'config': 4, 'row_schema': 2,
-               'workload': f'transformer_lm_{args.size}_seq{args.seq}'
-                           f'_b{args.batch}_v{args.vocab}',
+               'workload': (f'transformer_lm_{args.size}_seq{args.seq}'
+                            f'_b{args.batch}_v{args.vocab}'
+                            + (f'_ab{args.attn_block_size}'
+                               if args.attn_block_size else '')),
                'unit': 'ms/iter', 'sgd': rows['sgd'],
                'mfu_sgd': mfus.get('sgd'),
                'every_iter': base,

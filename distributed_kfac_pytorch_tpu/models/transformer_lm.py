@@ -55,6 +55,11 @@ class CausalSelfAttention(nn.Module):
         q = heads(nn.Dense(d_model, dtype=self.dtype, name='q_proj')(x))
         k = heads(nn.Dense(d_model, dtype=self.dtype, name='k_proj')(x))
         v = heads(nn.Dense(d_model, dtype=self.dtype, name='v_proj')(x))
+        if self.seq_axis is not None and self.attn_block_size is not None:
+            raise ValueError(
+                'seq_axis and attn_block_size are mutually exclusive: '
+                'the ring already folds blockwise per device (set '
+                'attn_block_size=None under sequence parallelism)')
         if self.seq_axis is not None:
             o = ring_self_attention(q, k, v, axis_name=self.seq_axis)
         elif self.attn_block_size is not None:

@@ -172,6 +172,15 @@ def main(argv=None):
     sp = args.seq_parallel
     if sp > 1 and args.arch != 'transformer':
         raise SystemExit('--seq-parallel requires --arch transformer')
+    if args.attn_block_size:
+        if args.arch != 'transformer':
+            raise SystemExit('--attn-block-size requires '
+                             '--arch transformer')
+        if args.bptt % args.attn_block_size:
+            raise SystemExit(
+                f'--bptt {args.bptt} must be divisible by '
+                f'--attn-block-size {args.attn_block_size} '
+                '(e.g. --bptt 1024 --attn-block-size 256)')
     if is_main:
         print(f'devices: {n_dev} global / {info["local_devices"]} local '
               f'x {info["process_count"]} processes '

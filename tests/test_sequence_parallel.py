@@ -146,6 +146,17 @@ def test_transformer_lm_chunked_attention_same_logits():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_transformer_lm_seq_axis_excludes_attn_block():
+    """Ring + chunked is a caller confusion (the ring already folds
+    blockwise per device) — rejected loudly, not silently preferred."""
+    model = transformer_lm.get_model(
+        vocab_size=31, size='tiny', max_len=16, dropout=0.0,
+        seq_axis='kfac_sp', attn_block_size=4)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match='mutually exclusive'):
+        model.init(jax.random.PRNGKey(0), ids, train=False)
+
+
 def test_transformer_lm_kfac_registration():
     model = transformer_lm.get_model(vocab_size=50, size='tiny',
                                      max_len=16, dropout=0.0)
