@@ -82,6 +82,27 @@ TPU_KIND_ALIASES = {
 }
 
 
+def extract_failure_line(stderr: str, limit: int = 200) -> str:
+    """Best failure line from a dead subprocess's stderr, ANSI-stripped.
+
+    The LAST stderr line is often JAX's traceback-filter note ("For
+    simplicity, JAX has removed its internal frames..."), so scan
+    backwards for the line naming the actual failure (OOM probes must
+    read as OOM in recorded artifacts). Shared by the subprocess-leg
+    benchmarks (flagship_lm, ring_attention_bench) so their failure-row
+    heuristics cannot drift.
+    """
+    import re
+    clean = lambda s: re.sub(  # noqa: E731  (no control chars in rows)
+        r'\x1b\[[0-9;]*m', '', s).strip()[-limit:]
+    lines = (stderr or '').strip().splitlines()
+    for line in reversed(lines):
+        if ('RESOURCE_EXHAUSTED' in line or 'Error' in line
+                or 'error' in line):
+            return clean(line)
+    return clean(lines[-1]) if lines else ''
+
+
 def detected_tpu_peak():
     """(peak_flops_or_None, floor_peak): best-known bf16 peak for MFU and
     a conservative peak for the FLOPs floor.

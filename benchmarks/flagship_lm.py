@@ -323,7 +323,7 @@ def spawn_phase(args, phase, inverse_method=None):
         out = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=2400, cwd=REPO)
     except subprocess.TimeoutExpired:
-        return 'failed: timeout', None
+        return 'failed: timeout', None, {}
     for line in reversed(out.stdout.strip().splitlines()):
         try:
             obj = json.loads(line)
@@ -332,9 +332,9 @@ def spawn_phase(args, phase, inverse_method=None):
             return obj['phase_result'], obj.get('mfu'), extras
         except Exception:
             continue
-    err = (out.stderr or '').strip().splitlines()
-    return ('failed: ' + (err[-1][:120] if err else f'rc={out.returncode}'),
-            None, {})
+    from bench import extract_failure_line
+    msg = extract_failure_line(out.stderr, limit=160)
+    return ('failed: ' + (msg or f'rc={out.returncode}'), None, {})
 
 
 def main(argv=None):

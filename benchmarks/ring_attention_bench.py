@@ -389,18 +389,9 @@ def spawn(phase, seq=0, ring=0, args=None, env=None, timeout=1200,
             return obj['phase_result'], obj
         except Exception:
             continue
-    import re
-    clean = lambda s: re.sub(  # noqa: E731  (no control chars in JSON)
-        r'\x1b\[[0-9;]*m', '', s).strip()[-200:]
-    err = (out.stderr or '').strip().splitlines()
-    # The last stderr line is often JAX's traceback-filter note; prefer
-    # the line naming the actual failure (OOM probes must read as OOM).
-    for line in reversed(err):
-        if ('RESOURCE_EXHAUSTED' in line or 'Error' in line
-                or 'error' in line):
-            return None, {'error': clean(line)}
-    return None, {'error': (clean(err[-1]) if err
-                            else f'rc={out.returncode}')}
+    from bench import extract_failure_line
+    msg = extract_failure_line(out.stderr)
+    return None, {'error': msg or f'rc={out.returncode}'}
 
 
 def main(argv=None):
@@ -458,7 +449,10 @@ def main(argv=None):
         result = _run_onchip_legs(args)
         result['fp32_operand_controls'] = _run_fp32_controls(args)
 
-    if args.chunked_only or result.get('chunked') is None:
+    # --skip-onchip refreshes ONLY the CPU-mesh leg (its help text);
+    # chunked on-chip legs run on a full sweep or --chunked-only.
+    if args.chunked_only or (not args.skip_onchip
+                             and result.get('chunked') is None):
         result['chunked'] = _run_chunked_legs(args)
         with open(args.out, 'w') as f:
             json.dump(result, f, indent=1)

@@ -169,6 +169,11 @@ def chunked_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     (B, T, H, D) fp32.
     """
     b, t, h, d = q.shape
+    if t <= block_size:
+        # Degenerate single fold == monolithic attention: lets a model
+        # configured for long-context blocks run short sequences (eval
+        # batches, factor-shaping passes) without touching the knob.
+        return local_causal_attention(q, k, v, causal=causal)
     if t % block_size:
         raise ValueError(f'seq {t} not divisible by {block_size=}')
     s = t // block_size

@@ -176,7 +176,12 @@ def main(argv=None):
         if args.arch != 'transformer':
             raise SystemExit('--attn-block-size requires '
                              '--arch transformer')
-        if args.bptt % args.attn_block_size:
+        # Under --seq-parallel the knob is dropped (ring folds per
+        # device already); bptt <= block degenerates to exact
+        # monolithic attention — both fine. Only a true partial-block
+        # split is rejected.
+        if (sp == 1 and args.bptt > args.attn_block_size
+                and args.bptt % args.attn_block_size):
             raise SystemExit(
                 f'--bptt {args.bptt} must be divisible by '
                 f'--attn-block-size {args.attn_block_size} '

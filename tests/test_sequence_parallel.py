@@ -106,6 +106,12 @@ def test_chunked_attention_matches_local(causal, dtype):
                                    rtol=2e-5, atol=2e-5)
     with pytest.raises(ValueError, match='not divisible'):
         seq.chunked_causal_attention(q, k, v, block_size=5)
+    # block >= t degenerates to exact monolithic attention (short-seq
+    # eval / factor-shaping passes under a long-context config).
+    out = seq.chunked_causal_attention(q, k, v, block_size=4 * t,
+                                       causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
 
 
 def test_chunked_attention_gradients_match_local():
