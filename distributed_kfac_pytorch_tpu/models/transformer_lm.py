@@ -39,6 +39,7 @@ class CausalSelfAttention(nn.Module):
     num_heads: int
     seq_axis: str | None = None
     attn_block_size: int | None = None
+    causal: bool = True  # False = bidirectional (encoder/ViT use)
     dtype: Any = None    # compute dtype (params stay fp32); None = infer
 
     @nn.compact
