@@ -34,6 +34,17 @@ import numpy as np
 CAPTURE_COL = 'kfac_in'
 PROBE_COL = 'kfac_probes'
 
+
+def extra_vars_of(variables) -> dict:
+    """The collections a caller should carry as train-state extra_vars:
+    everything except 'params' and the capture-internal collections
+    (``KFAC.init`` returns ``kfac_probes`` shaped for the *init* batch —
+    stale for any other batch, and dead weight in checkpoints). The one
+    place the internal-collection names are spelled outside this module.
+    """
+    return {k: v for k, v in variables.items()
+            if k not in ('params', PROBE_COL, CAPTURE_COL)}
+
 # Module kinds, mirroring the reference's KNOWN_MODULES
 # (kfac/layers/__init__.py:11) plus the embedding layer the reference
 # disabled (kfac/layers/embedding.py:20).
@@ -376,6 +387,20 @@ class KFACCapture:
 
     # -- capture-time application -----------------------------------------
 
+    @staticmethod
+    def _clean_extra(extra_vars) -> dict:
+        """Caller-supplied collections minus capture internals.
+
+        ``KFAC.init`` returns a ``kfac_probes`` collection shaped for the
+        *init* batch; a caller that forwards every non-param collection
+        (the natural spelling — bench.py, the CLIs) must not pre-seat
+        those stale shapes here, where fresh probes are built per batch.
+        """
+        extra_vars = dict(extra_vars or {})
+        extra_vars.pop(PROBE_COL, None)
+        extra_vars.pop(CAPTURE_COL, None)
+        return extra_vars
+
     def zero_probes(self, params, *args, extra_vars=None, mutable_cols=(),
                     **kwargs):
         """Zero probe pytree shaped for the given batch (via eval_shape).
@@ -385,7 +410,7 @@ class KFACCapture:
         values instead of becoming tracers; ``eval_shape`` never executes
         compute either way.
         """
-        extra_vars = extra_vars or {}
+        extra_vars = self._clean_extra(extra_vars)
 
         def shapes():
             with nn.intercept_methods(
@@ -407,7 +432,7 @@ class KFACCapture:
         model updates in-pass. Returns
         ``(out, activations_tree, updated_vars)``.
         """
-        extra_vars = extra_vars or {}
+        extra_vars = self._clean_extra(extra_vars)
         with nn.intercept_methods(self._make_interceptor(record_specs=False)):
             out, state = self.model.apply(
                 {'params': params, PROBE_COL: probes, **extra_vars}, *args,
@@ -470,7 +495,7 @@ class KFACCapture:
                     'machinery is skipped entirely on non-intercepting '
                     'steps, so precomputed probes indicate caller '
                     'confusion; drop probes or set intercept=True')
-            extra = extra_vars or {}
+            extra = self._clean_extra(extra_vars)
 
             def plain(params):
                 out, state = self.model.apply(

@@ -10,6 +10,9 @@
 - ``mobilenet``: MobileNetV1 — the depthwise workload the reference
   cannot precondition (no grouped-conv layer kind there); exercises
   this framework's ``conv2d_grouped`` path end to end.
+- ``vit``: Vision Transformer — conv patch embed + bidirectional
+  encoder blocks (shared with ``transformer_lm``), another family the
+  reference has no working analogue of.
 """
 
 from distributed_kfac_pytorch_tpu.models import cifar_resnet
@@ -17,3 +20,4 @@ from distributed_kfac_pytorch_tpu.models import imagenet_resnet
 from distributed_kfac_pytorch_tpu.models import lstm_lm
 from distributed_kfac_pytorch_tpu.models import mobilenet
 from distributed_kfac_pytorch_tpu.models import transformer_lm
+from distributed_kfac_pytorch_tpu.models import vit

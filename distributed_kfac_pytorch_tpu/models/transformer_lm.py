@@ -62,23 +62,30 @@ class CausalSelfAttention(nn.Module):
                 'the ring already folds blockwise per device (set '
                 'attn_block_size=None under sequence parallelism)')
         if self.seq_axis is not None:
-            o = ring_self_attention(q, k, v, axis_name=self.seq_axis)
+            o = ring_self_attention(q, k, v, axis_name=self.seq_axis,
+                                    causal=self.causal)
         elif self.attn_block_size is not None:
             o = chunked_causal_attention(q, k, v,
-                                         block_size=self.attn_block_size)
+                                         block_size=self.attn_block_size,
+                                         causal=self.causal)
         else:
-            o = local_causal_attention(q, k, v)
+            o = local_causal_attention(q, k, v, causal=self.causal)
         o = o.reshape(*x.shape[:-1], d_model).astype(x.dtype)
         return nn.Dense(d_model, dtype=self.dtype, name='out_proj')(o)
 
 
 class TransformerBlock(nn.Module):
-    """Pre-LN decoder block: LN -> attention -> LN -> GELU MLP."""
+    """Pre-LN block: LN -> attention -> LN -> GELU MLP.
+
+    ``causal=True`` is the decoder (LM) form; ``causal=False`` the
+    bidirectional encoder form (ViT, ``models/vit.py``).
+    """
     num_heads: int
     mlp_ratio: int = 4
     dropout: float = 0.0
     seq_axis: str | None = None
     attn_block_size: int | None = None
+    causal: bool = True
     dtype: Any = None
 
     @nn.compact
@@ -86,6 +93,7 @@ class TransformerBlock(nn.Module):
         d_model = x.shape[-1]
         h = CausalSelfAttention(self.num_heads, seq_axis=self.seq_axis,
                                 attn_block_size=self.attn_block_size,
+                                causal=self.causal,
                                 dtype=self.dtype, name='attn')(
             nn.LayerNorm(dtype=self.dtype, name='ln1')(x))
         h = nn.Dropout(self.dropout, deterministic=not train)(h)

@@ -1,0 +1,111 @@
+"""Vision Transformer (Dosovitskiy et al. 2021), flax NHWC — the
+encoder-attention workload.
+
+BEYOND the reference: its layer registry knows only Linear / Conv2d /
+Embedding module types (``kfac/layers/__init__.py:13-36``), and its
+attention-bearing example (``torch_language_model.py``) ships broken —
+it has no transformer workload at all. Here every ViT weight layer is
+K-FAC-visible: the patch embedding is a stride-P ``nn.Conv`` (a
+``conv2d`` factor whose A covariance is over non-overlapping patches),
+and each encoder block reuses ``transformer_lm.TransformerBlock`` with
+``causal=False`` — the same four q/k/v/o Denses + two MLP Denses the LM
+flagship preconditions, now under bidirectional attention
+(``parallel.sequence`` ops take ``causal``; exactness at both settings
+is pinned in ``tests/test_sequence_parallel.py``). The cls token and
+position table are plain (non-layer) params, exactly like the LM's
+``pos_embed`` — SGD-updated, outside K-FAC's blocks, matching how the
+reference leaves non-module params alone.
+
+For high-resolution inputs, ``attn_block_size`` folds the patch
+sequence blockwise on one device (the chunked-attention knob inherited
+from the shared block; the cls token's ragged ``num_patches + 1``
+length is handled by the fold's masked padding). Ring attention over a
+mesh (``seq_axis``) is deliberately not exposed here: image
+classification shards over batch, not sequence — the LM is the
+sequence-parallel workload.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_kfac_pytorch_tpu.models.transformer_lm import (
+    TransformerBlock,
+)
+
+
+class VisionTransformer(nn.Module):
+    """Patch-embed conv -> cls token + learned positions -> encoder
+    blocks (bidirectional) -> final LN -> Dense head on the cls token
+    (``pool='mean'`` switches to global average pooling, the paper's
+    appendix-D variant — identical K-FAC coverage either way).
+    """
+    num_classes: int
+    patch_size: int = 16
+    d_model: int = 384
+    num_layers: int = 12
+    num_heads: int = 6
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    pool: str = 'cls'            # 'cls' | 'mean'
+    attn_block_size: int | None = None
+    dtype: Any = None            # compute dtype (params stay fp32)
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        if self.pool not in ('cls', 'mean'):
+            raise ValueError(f"pool must be 'cls' or 'mean', "
+                             f'got {self.pool!r}')
+        p = self.patch_size
+        if x.shape[1] % p or x.shape[2] % p:
+            raise ValueError(f'input {x.shape[1]}x{x.shape[2]} not '
+                             f'divisible by patch_size={p}')
+        y = nn.Conv(self.d_model, (p, p), strides=(p, p), padding='VALID',
+                    dtype=self.dtype, name='patch_embed')(x)
+        b = y.shape[0]
+        y = y.reshape(b, -1, self.d_model)          # (B, HW/P^2, D)
+        if self.pool == 'cls':
+            cls = self.param('cls_token', nn.initializers.zeros,
+                             (1, 1, self.d_model))
+            y = jnp.concatenate(
+                [jnp.broadcast_to(cls, (b, 1, self.d_model)).astype(y.dtype),
+                 y], axis=1)
+        pos = self.param('pos_embed', nn.initializers.normal(0.02),
+                         (y.shape[1], self.d_model))
+        y = y + pos.astype(y.dtype)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        for i in range(self.num_layers):
+            y = TransformerBlock(self.num_heads, mlp_ratio=self.mlp_ratio,
+                                 dropout=self.dropout, causal=False,
+                                 attn_block_size=self.attn_block_size,
+                                 dtype=self.dtype,
+                                 name=f'block{i}')(y, train=train)
+        y = nn.LayerNorm(dtype=self.dtype, name='ln_f')(y)
+        y = y[:, 0] if self.pool == 'cls' else jnp.mean(y, axis=1)
+        return nn.Dense(self.num_classes, dtype=self.dtype, name='head')(y)
+
+
+def get_model(num_classes: int, size: str = 'small',
+              **overrides) -> VisionTransformer:
+    """Named configs following the ViT paper's Ti/S/B ladder, plus a
+    CIFAR-scale variant (patch 4 on 32x32 inputs -> 64 patches)."""
+    configs = {
+        'cifar': dict(patch_size=4, d_model=192, num_layers=6,
+                      num_heads=3),
+        'tiny': dict(patch_size=16, d_model=192, num_layers=12,
+                     num_heads=3),
+        'small': dict(patch_size=16, d_model=384, num_layers=12,
+                      num_heads=6),
+        # ViT-B/16: q/k/v/o A factors 769, MLP A factors 769/3073 —
+        # straddles the 640 eigen/cholesky auto-dispatch cutoff like
+        # both existing flagships.
+        'base': dict(patch_size=16, d_model=768, num_layers=12,
+                     num_heads=12),
+    }
+    if size not in configs:
+        raise ValueError(f'unknown size {size!r}; have {sorted(configs)}')
+    cfg = {**configs[size], **overrides}
+    return VisionTransformer(num_classes=num_classes, **cfg)

@@ -33,11 +33,13 @@ SEQ_AXIS = 'kfac_sp'
 _NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, scale, qpos, kpos, causal):
+def _block_attend(q, k, v, scale, qpos, kpos, causal, kvalid=None):
     """One blockwise attention contribution with positions for masking.
 
     q: (B, Tq, H, D), k/v: (B, Tk, H, D); qpos/kpos: (Tq,)/(Tk,) global
-    token positions. Returns (scores_max, exp_scores @ v, exp_scores sum)
+    token positions. ``kvalid`` (optional, (Tk,) bool) masks out padding
+    keys — the chunked path pads ragged sequences up to a block
+    multiple. Returns (scores_max, exp_scores @ v, exp_scores sum)
     per (B, H, Tq).
 
     Operands enter the QK^T einsum at their INPUT dtype with fp32
@@ -51,12 +53,18 @@ def _block_attend(q, k, v, scale, qpos, kpos, causal):
     """
     logits = jnp.einsum('bqhd,bkhd->bhqk', q, k,
                         preferred_element_type=jnp.float32) * scale
+    mask = None
     if causal:
         mask = kpos[None, :] <= qpos[:, None]          # (Tq, Tk)
+    if kvalid is not None:
+        kv = jnp.broadcast_to(kvalid[None, :],
+                              (qpos.shape[0], kpos.shape[0]))
+        mask = kv if mask is None else mask & kv
+    if mask is not None:
         logits = jnp.where(mask[None, None], logits, _NEG_INF)
     m = jnp.max(logits, axis=-1)                       # (B, H, Tq)
     p = jnp.exp(logits - m[..., None])
-    if causal:
+    if mask is not None:
         # Fully-masked rows: m == _NEG_INF and p == 1 everywhere; zero them.
         p = jnp.where((m == _NEG_INF)[..., None], 0.0, p)
     l = jnp.sum(p, axis=-1)                            # (B, H, Tq)
@@ -174,27 +182,45 @@ def chunked_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # configured for long-context blocks run short sequences (eval
         # batches, factor-shaping passes) without touching the knob.
         return local_causal_attention(q, k, v, causal=causal)
-    if t % block_size:
-        raise ValueError(f'seq {t} not divisible by {block_size=}')
-    s = t // block_size
+    # Ragged sequences (a ViT's num_patches + 1 cls token, ragged final
+    # LM batches): only K/V must reshape into blocks, so they alone pad
+    # up to a block multiple — queries stay length ``t`` (they are
+    # never blocked). The final (padded) block is peeled out of the
+    # scan and folded once with its pad keys masked via ``kvalid``, so
+    # the hot scanned fold stays mask-free at ANY length (the online
+    # softmax folds commute, so fold order does not matter). Exact at
+    # any length.
+    pad = -t % block_size
+    if pad:
+        zeros = jnp.zeros((b, pad, h, d))
+        k, v = (jnp.concatenate([a, zeros.astype(a.dtype)], axis=1)
+                for a in (k, v))
+    s = (t + pad) // block_size
     scale = 1.0 / (d ** 0.5)
     qpos = jnp.arange(t)
     k_blocks = jnp.moveaxis(k.reshape(b, s, block_size, h, d), 1, 0)
     v_blocks = jnp.moveaxis(v.reshape(b, s, block_size, h, d), 1, 0)
-    kpos = jnp.arange(t).reshape(s, block_size)
+    kpos = jnp.arange(t + pad).reshape(s, block_size)
 
     @jax.checkpoint
     def fold(carry, blk):
         o, m, l = carry
-        k_blk, v_blk, kp = blk
+        k_blk, v_blk, kp = blk[:3]
         bm, bo, bl = _block_attend(q, k_blk, v_blk, scale, qpos, kp,
-                                   causal)
+                                   causal,
+                                   kvalid=blk[3] if len(blk) > 3 else None)
         return _fold_update(o, m, l, bm, bo, bl), None
 
+    n_full = s - 1 if pad else s    # pad > 0 implies t > block, so >= 1
     o0 = jnp.zeros((b, t, h, d), jnp.float32)
     m0 = jnp.full((b, h, t), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, t), jnp.float32)
-    (o, m, l), _ = jax.lax.scan(fold, (o0, m0, l0),
-                                (k_blocks, v_blocks, kpos))
+    (o, m, l), _ = jax.lax.scan(
+        fold, (o0, m0, l0),
+        (k_blocks[:n_full], v_blocks[:n_full], kpos[:n_full]))
+    if pad:
+        (o, m, l), _ = fold((o, m, l),
+                            (k_blocks[-1], v_blocks[-1], kpos[-1],
+                             kpos[-1] < t))
     l = jnp.maximum(jnp.moveaxis(l, 1, 2)[..., None], 1e-30)
     return o / l

@@ -26,9 +26,10 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
+from distributed_kfac_pytorch_tpu import capture as capture_lib
 from distributed_kfac_pytorch_tpu import fp16 as fp16_lib
 from distributed_kfac_pytorch_tpu import launch
-from distributed_kfac_pytorch_tpu.models import imagenet_resnet
+from distributed_kfac_pytorch_tpu.models import imagenet_resnet, vit
 from distributed_kfac_pytorch_tpu.parallel import distributed as D
 from distributed_kfac_pytorch_tpu.training import (
     checkpoint as ckpt_lib,
@@ -52,7 +53,9 @@ def parse_args(argv=None):
     p.add_argument('--log-dir', default='./logs/imagenet')
     p.add_argument('--checkpoint-dir', default='./checkpoints/imagenet')
     p.add_argument('--checkpoint-freq', type=int, default=5)
-    p.add_argument('--model', default='resnet50')
+    p.add_argument('--model', default='resnet50',
+                   help="resnet<depth> or 'vit_<tiny|small|base>' "
+                        '(ViT-*/16; --image-size must divide by 16)')
     p.add_argument('--image-size', type=int, default=224)
     p.add_argument('--batch-size', type=int, default=256,
                    help='global batch size')
@@ -75,7 +78,7 @@ def parse_args(argv=None):
                         'BN stats lagging large preconditioned steps; '
                         '0 = off). Eval-only: training EWMA state is '
                         'untouched.')
-    p.add_argument('--bn-momentum', type=float, default=0.9,
+    p.add_argument('--bn-momentum', type=float, default=None,
                    help='BatchNorm running-stat EWMA momentum (flax '
                         'convention; 0.9 = torch momentum 0.1)')
     p.add_argument('--remat', action='store_true',
@@ -191,9 +194,19 @@ def main(argv=None):
             (x.numpy(), y.numpy()) for x, y in
             val_ds.batch(vb, drop_remainder=True))
 
-    model = imagenet_resnet.get_model(
-        args.model, dtype=jnp.float16 if args.fp16 else jnp.float32,
-        bn_momentum=args.bn_momentum, remat=args.remat)
+    dtype = jnp.float16 if args.fp16 else jnp.float32
+    if args.model.startswith('vit'):
+        if args.remat:
+            raise SystemExit('--remat is the ResNet block-level knob; '
+                             'for ViT memory use chunked attention '
+                             '(models/vit.py attn_block_size)')
+        model = vit.get_model(
+            1000, args.model.partition('_')[2] or 'small', dtype=dtype)
+    else:
+        model = imagenet_resnet.get_model(
+            args.model, dtype=dtype,
+            bn_momentum=0.9 if args.bn_momentum is None
+            else args.bn_momentum, remat=args.remat)
     cfg = optimizers.OptimConfig(
         base_lr=args.base_lr, momentum=args.momentum,
         weight_decay=args.wd, warmup_epochs=args.warmup_epochs,
@@ -222,7 +235,16 @@ def main(argv=None):
     else:
         variables = model.init(jax.random.PRNGKey(args.seed), x0)
     params = variables['params']
-    extra = {'batch_stats': variables['batch_stats']}
+    # batch_stats exists only for BatchNorm models (absent for ViT —
+    # stateless LayerNorm).
+    extra = capture_lib.extra_vars_of(variables)
+    mutable = ('batch_stats',) if 'batch_stats' in extra else ()
+    if args.precise_bn_batches > 0 and not mutable:
+        raise SystemExit('--precise-bn-batches requires a BatchNorm '
+                         f'model; {args.model!r} has no batch_stats')
+    if args.bn_momentum is not None and not mutable:
+        raise SystemExit('--bn-momentum requires a BatchNorm model; '
+                         f'{args.model!r} has no batch_stats')
     if args.fp16:
         if kfac is None:
             raise SystemExit('--fp16 requires the K-FAC step '
@@ -250,18 +272,19 @@ def main(argv=None):
         kstate = dkfac.init_state(params)
         step_fn = dkfac.build_train_step(
             loss_fn, tx, metrics_fn=metrics_fn,
-            mutable_cols=('batch_stats',),
+            mutable_cols=mutable,
             grad_accum_steps=args.grad_accum,
             loss_scale='dynamic' if args.fp16 else None)
     else:  # --kfac-update-freq 0: plain SGD (reference optimizers.py:28)
         dkfac, kstate = None, None
         step_fn = engine.build_sgd_train_step(
             model, loss_fn, tx, mesh, metrics_fn=metrics_fn,
-            mutable_cols=('batch_stats',),
+            mutable_cols=mutable,
             grad_accum_steps=args.grad_accum)
     eval_step = engine.make_eval_step(
         model, lambda out, b: utils.label_smooth_loss(out, b[1], 0.0),
-        mesh, model_args_fn=lambda b: (b[0], False))
+        mesh, model_args_fn=lambda b: (b[0],),
+        model_kwargs={'train': False})
 
     state = engine.TrainState(params=params, opt_state=opt_state,
                               kfac_state=kstate, extra_vars=extra)

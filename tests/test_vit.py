@@ -1,0 +1,131 @@
+"""Vision Transformer under K-FAC: registration, param goldens,
+bidirectional wiring, chunked-attention parity, and a full K-FAC step.
+
+The reference has no attention workload at all (its LM example ships
+broken — torch_language_model.py:253,277 — and its registry knows only
+Linear/Conv2d/Embedding, kfac/layers/__init__.py:13-36), so these pin
+a family that exists only here: a stride-P conv2d factor feeding the
+same encoder Denses the LM flagship preconditions, under
+``causal=False`` attention.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import distributed_kfac_pytorch_tpu as kfac_lib
+from distributed_kfac_pytorch_tpu.models import vit
+
+
+def n_params(params):
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+def test_vit_s16_param_count():
+    """ViT-S/16 @ 224px/1000 classes is 22.05M params (Dosovitskiy et
+    al. Table 1 reports 22M for ViT-S/16 with the cls token)."""
+    model = vit.get_model(1000, 'small')
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 224, 224, 3)), train=False)
+    count = n_params(variables['params'])
+    assert abs(count / 1e6 - 22.05) < 0.05, count
+
+
+def test_vit_registration():
+    """Every weight layer registers: the patch-embed conv as conv2d and
+    all 6 Denses per block + the head as linear; only LayerNorms (plain
+    -gradient params) are declined."""
+    model = vit.get_model(10, 'cifar')     # d192, 6 blocks, patch 4
+    k = kfac_lib.KFAC(model)
+    x = jnp.zeros((2, 32, 32, 3))
+    k.init(jax.random.PRNGKey(0), x, train=False)
+    kinds = {n: s.kind for n, s in k.specs.items()}
+    assert sum(kind == 'conv2d' for kind in kinds.values()) == 1
+    assert sum(kind == 'linear' for kind in kinds.values()) == 6 * 6 + 1
+    assert len(kinds) == 38
+    # Declines: LayerNorms + the root module (cls_token/pos_embed are
+    # plain-gradient params, like the LM's pos_embed) — no Dense/Conv.
+    assert all('ln' in name or name == ''
+               for name in k.capture.skipped_modules), (
+        k.capture.skipped_modules)
+
+
+def test_vit_attention_is_bidirectional():
+    """With the cls token at position 0, a *causal* mask would cut every
+    attention edge from patches into the cls stream, making the head
+    input-independent; bidirectional attention must make the logits
+    depend on the patches."""
+    model = vit.VisionTransformer(num_classes=7, patch_size=8, d_model=32,
+                                  num_layers=2, num_heads=4)
+    x1 = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    x2 = jax.random.normal(jax.random.key(2), (2, 32, 32, 3))
+    v = model.init(jax.random.key(0), x1, train=False)
+    o1 = model.apply(v, x1, train=False)
+    o2 = model.apply(v, x2, train=False)
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
+
+
+@pytest.mark.parametrize('pool', ['cls', 'mean'])
+def test_vit_pools_forward(pool):
+    model = vit.VisionTransformer(num_classes=5, patch_size=8, d_model=32,
+                                  num_layers=1, num_heads=2, pool=pool)
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    v = model.init(jax.random.key(0), x, train=False)
+    out = model.apply(v, x, train=False)
+    assert out.shape == (2, 5)
+    assert bool(jnp.isfinite(out).all())
+
+
+@pytest.mark.parametrize('pool', ['cls', 'mean'])
+def test_vit_chunked_attention_matches_monolithic(pool):
+    """`attn_block_size` must not change the math: same params, same
+    logits. With the cls token the sequence is 17 tokens (ragged — the
+    fold's masked padding path); with mean pooling 16 (divisible)."""
+    kw = dict(num_classes=5, patch_size=8, d_model=32, num_layers=2,
+              num_heads=2, pool=pool)
+    mono = vit.VisionTransformer(**kw)
+    chunked = vit.VisionTransformer(**kw, attn_block_size=4)
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    v = mono.init(jax.random.key(0), x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(mono.apply(v, x, train=False)),
+        np.asarray(chunked.apply(v, x, train=False)), rtol=2e-5, atol=2e-5)
+
+
+def test_vit_kfac_step_trains():
+    """Full K-FAC training steps on a tiny ViT: capture -> factor EWMA
+    -> inverse firing -> precondition -> SGD update, loss finite and
+    params move every step."""
+    model = vit.VisionTransformer(num_classes=4, patch_size=8, d_model=32,
+                                  num_layers=2, num_heads=2)
+    x = jax.random.normal(jax.random.key(1), (8, 32, 32, 3))
+    y = jax.random.randint(jax.random.key(2), (8,), 0, 4)
+    k = kfac_lib.KFAC(model, damping=0.003, lr=0.1)
+    variables, kstate = k.init(jax.random.PRNGKey(0), x)
+    params = variables['params']
+    tx = optax.sgd(0.05, momentum=0.9)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, kstate):
+        loss, _, grads, captures, _ = k.capture.loss_and_grads(
+            lambda out: optax.softmax_cross_entropy_with_integer_labels(
+                out, y).mean(), params, x)
+        precond, kstate = k.step(kstate, grads, captures,
+                                 factor_update=True, inv_update=True)
+        updates, opt_state = tx.update(precond, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state, kstate,
+                loss)
+
+    losses = []
+    for _ in range(3):
+        new_params, opt_state, kstate, loss = step(params, opt_state,
+                                                   kstate)
+        losses.append(float(loss))
+        moved = jax.tree.map(
+            lambda a, b: bool(jnp.any(a != b)), params, new_params)
+        assert all(jax.tree.leaves(moved))
+        params = new_params
+    assert np.isfinite(losses).all(), losses
