@@ -373,7 +373,8 @@ def main(argv=None):
     if args.platform:
         jax.config.update('jax_platforms', args.platform)
         if args.platform == 'cpu':
-            jax.config.update('jax_num_cpu_devices', 8)
+            from distributed_kfac_pytorch_tpu import compat
+            compat.set_cpu_device_count(8)
     # Persistent compile cache, AFTER platform resolution (the helper
     # itself refuses on a multi-device CPU configuration — the warm-read
     # segfault workaround, see utils.enable_compilation_cache).

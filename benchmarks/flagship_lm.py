@@ -94,6 +94,13 @@ def _setup(args, with_kfac=True):
     kw = {}
     if args.inverse_method:
         kw['inverse_method'] = args.inverse_method
+    if args.precond_dtype:
+        # The r6 tentpole knob: bf16 precondition-contraction operands
+        # (fp32 accumulation). With --bf16-inverses the stored inverses
+        # are consumed resident — no fp32 upcast-on-read copy of the
+        # 4096^2 operands that dominate the non-factor step.
+        kw['precond_compute_dtype'] = {
+            'fp32': jnp.float32, 'bf16': jnp.bfloat16}[args.precond_dtype]
     if args.bf16_factors:
         kw['factor_dtype'] = jnp.bfloat16
         kw['factor_compute_dtype'] = jnp.bfloat16
@@ -315,6 +322,8 @@ def spawn_phase(args, phase, inverse_method=None):
         cmd.append('--bf16-factors')
     if args.bf16_inverses:
         cmd.append('--bf16-inverses')
+    if args.precond_dtype:
+        cmd += ['--precond-dtype', args.precond_dtype]
     if inverse_method:
         cmd += ['--inverse-method', inverse_method]
     if args.attn_block_size:
@@ -355,6 +364,14 @@ def main(argv=None):
                         'reference supports half-precision inverse '
                         'storage too — preconditioner.py:149)')
     p.add_argument('--inverse-method', default=None)
+    p.add_argument('--precond-dtype', default=None,
+                   choices=['fp32', 'bf16'],
+                   help='precondition-contraction operand dtype (KFAC '
+                        'precond_compute_dtype; default None = the '
+                        'bit-identical legacy fp32-upcast path). bf16 '
+                        'is the r6 A/B leg targeting the +18% '
+                        'every-step precondition tax; pair with '
+                        '--bf16-inverses for the bf16-resident read.')
     p.add_argument('--attn-block-size', type=int, default=None,
                    help='memory-efficient chunked attention (long-seq '
                         'single-chip legs)')
@@ -364,6 +381,13 @@ def main(argv=None):
                         'for (drop eigen at xl dims: the fp32-HIGHEST '
                         'polish at 4096+ is the recorded CNN-flagship '
                         'negative, seconds per firing)')
+    p.add_argument('--precond-ab', action='store_true',
+                   help='r6 precondition-dtype A/B: one sgd leg, then '
+                        'the capture-free nofactor leg per dtype '
+                        'variant (fp32 legacy / bf16 / bf16 with '
+                        'bf16-resident inverses) — isolates the '
+                        'every-step precondition tax per contraction '
+                        'dtype without re-measuring the shared legs')
     p.add_argument('--phase', default=None,
                    help='internal: run one phase in this process')
     args = p.parse_args(argv)
@@ -371,12 +395,40 @@ def main(argv=None):
     if args.phase:
         return run_phase(args)
 
+    if args.precond_ab:
+        import jax as _jax
+        backend = _jax.default_backend()
+        workload = (f'transformer_lm_{args.size}_seq{args.seq}'
+                    f'_b{args.batch}_v{args.vocab}')
+        sgd_ms, sgd_mfu, _ = spawn_phase(args, 'sgd')
+        emit({'config': 4, 'ab': 'precond_dtype', 'phase': 'sgd',
+              'workload': workload, 'backend': backend,
+              'model_dtype': args.model_dtype,
+              'ms_per_iter': sgd_ms, 'mfu': sgd_mfu})
+        for label, pdt, binv in (('fp32_legacy', None, False),
+                                 ('bf16', 'bf16', False),
+                                 ('bf16_resident', 'bf16', True)):
+            args.precond_dtype = pdt
+            args.bf16_inverses = binv
+            ms, mfu, _ = spawn_phase(args, 'nofactor')
+            row = {'config': 4, 'ab': 'precond_dtype', 'leg': label,
+                   'phase': 'nofactor', 'workload': workload,
+                   'backend': backend, 'model_dtype': args.model_dtype,
+                   'precond_dtype': pdt, 'bf16_inverses': binv,
+                   'ms_per_iter': ms, 'mfu': mfu, 'sgd': sgd_ms}
+            if isinstance(ms, (int, float)) and isinstance(
+                    sgd_ms, (int, float)):
+                row['nonfactor_vs_sgd'] = round(ms / sgd_ms, 3)
+            emit(row)
+        return
+
     rows, mfus = {}, {}
     for mode in ('sgd', 'nofactor', 'factors'):
         rows[mode], mfus[mode], _ = spawn_phase(args, mode)
         emit({'config': 4, 'phase': mode, 'size': args.size,
               'seq': args.seq, 'batch': args.batch, 'vocab': args.vocab,
               'model_dtype': args.model_dtype,
+              'precond_dtype': args.precond_dtype,
               'attn_block_size': args.attn_block_size,
               'ms_per_iter': rows[mode], 'mfu': mfus.get(mode)})
     firings = {}
@@ -405,6 +457,7 @@ def main(argv=None):
                                if args.attn_block_size else '')),
                'unit': 'ms/iter', 'sgd': rows['sgd'],
                'mfu_sgd': mfus.get('sgd'),
+               'precond_dtype': args.precond_dtype,
                'every_iter': base,
                'factor_step_extra': round(factor_cost, 2),
                'inv_firing_method': fire_method,

@@ -316,7 +316,8 @@ def phase_cpumesh(args):
     import jax
 
     jax.config.update('jax_platforms', 'cpu')
-    jax.config.update('jax_num_cpu_devices', 8)
+    from distributed_kfac_pytorch_tpu import compat
+    compat.set_cpu_device_count(8)
 
     import jax.numpy as jnp
     import numpy as np

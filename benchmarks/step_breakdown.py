@@ -12,6 +12,9 @@ every pipeline stage is a recorded number, not an inference:
   factors        + factor EWMA every iter (factor_update=True)
   full           + amortized inverse updates every ``inv_freq`` iters
   full_polishN   full with eigh_polish_iters=N variants
+  precond_bf16   the 'precond' phase with precond_compute_dtype=bf16
+                 (r6 A/B: attributes the every-step precondition tax
+                 per contraction dtype)
 
 The phase cost is the difference between adjacent rows; the rows are
 cumulative so each is independently meaningful. Methodology = bench.py
@@ -41,11 +44,14 @@ from distributed_kfac_pytorch_tpu import KFAC
 from distributed_kfac_pytorch_tpu.models import cifar_resnet
 
 
-def build(model, x, y, inv_freq, n_iters, mode, polish_iters=None):
+def build(model, x, y, inv_freq, n_iters, mode, polish_iters=None,
+          precond_dtype=None):
     """One scanned runner for a cumulative phase ``mode``."""
     kw = {}
     if polish_iters is not None:
         kw['eigh_polish_iters'] = polish_iters
+    if precond_dtype is not None:
+        kw['precond_compute_dtype'] = precond_dtype
     kfac = KFAC(model, factor_update_freq=1, inv_update_freq=inv_freq,
                 damping=0.003, lr=0.1, **kw)
     variables, kstate = kfac.init(jax.random.PRNGKey(0), x)
@@ -159,6 +165,17 @@ def main(argv=None):
         rows[mode] = round(ms, 2)
         print(json.dumps({'phase': mode, 'ms_per_iter': rows[mode]}),
               flush=True)
+    # bf16 precondition A/B on the same cumulative 'precond' phase, so
+    # the every-step precondition tax is attributed per dtype (the r6
+    # knob; the delta against 'precond' is the whole saving/regression).
+    import jax.numpy as jnp
+    run, carry = build(model, x, y, inv_freq, n_iters, 'precond',
+                       precond_dtype=jnp.bfloat16)
+    ms = B.time_chained(run, carry, n_iters, floor_ms=floor_ms,
+                        leg='precond_bf16')
+    rows['precond_bf16'] = round(ms, 2)
+    print(json.dumps({'phase': 'precond_bf16',
+                      'ms_per_iter': rows['precond_bf16']}), flush=True)
     for n in args.polish:
         run, carry = build(model, x, y, inv_freq, n_iters, 'full',
                            polish_iters=n)
@@ -171,6 +188,8 @@ def main(argv=None):
     deltas = {
         'capture_cost': round(rows['capture'] - rows['sgd'], 2),
         'precond_clip_cost': round(rows['precond'] - rows['capture'], 2),
+        'precond_bf16_saving': round(rows['precond']
+                                     - rows['precond_bf16'], 2),
         'factor_cost': round(rows['factors'] - rows['precond'], 2),
         'inverse_amortized_cost': round(rows['full'] - rows['factors'], 2),
     }

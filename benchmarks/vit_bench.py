@@ -2,8 +2,9 @@
 
 BEYOND the reference: it has no working attention workload (its LM
 example ships broken — ``torch_language_model.py:253,277`` — and its
-registry knows only Linear/Conv2d/Embedding,
-``kfac/layers/__init__.py:13-36``). Here every ViT weight layer is
+registry has no attention-bearing kinds: Linear/Conv2d/Embedding/
+LSTMCell only, ``kfac/layers/__init__.py:13-36``). Here every ViT
+weight layer is
 preconditioned — the stride-P patch-embed conv plus the 6 encoder
 Denses per block (``models/vit.py``) — and this bench records what
 that costs on a real chip.
@@ -184,8 +185,10 @@ def main(argv=None):
     production = (rows['precond'] + factor_extra / 50
                   + firing_extra_per_iter * inv_freq / 500)
     out = {
-        'workload': f'vit_{args.size}16_{args.image}px_b{args.batch}_'
-                    f'{args.model_dtype}',
+        # Patch size from the model config, not a hardcoded 16: the
+        # patch-4 'cifar' config used to mislabel as vit_cifar16_32px.
+        'workload': f'vit_{args.size}{model.patch_size}_{args.image}px_'
+                    f'b{args.batch}_{args.model_dtype}',
         'backend': jax.default_backend(),
         'n_registered_layers': len(kfac.specs),
         'unit': 'ms/iter',
@@ -205,7 +208,12 @@ def main(argv=None):
         'note': 'encoder-attention workload the reference has no '
                 'working analogue of; mfu counts registered-layer '
                 'matmuls only (attention einsums excluded — see '
-                'module docstring)',
+                'module docstring)'
+                + ('' if on_tpu else
+                   '; NOT-TPU CAVEAT: measured on the CPU shake-out '
+                   'config (batch 4, 32px, cifar size) — relative '
+                   'phase structure only, no MFU, not comparable to '
+                   'the v5e flagship rows'),
     }
     with open(args.out, 'w') as f:
         json.dump(out, f, indent=1)

@@ -1,0 +1,89 @@
+"""Version-compatibility shims for the supported jax range (0.4.x–0.8+).
+
+The framework is written against the current jax surface (``jax.shard_map``
+with ``check_vma``, the ``jax_num_cpu_devices`` config option). Older
+long-lived runtime images pin jax 0.4.x, where the same functionality
+lives under ``jax.experimental.shard_map`` (flag named ``check_rep``) and
+the virtual CPU device count is only settable through ``XLA_FLAGS``
+before backend init. Everything here is a thin translation — no behavior
+differences beyond the renamed flag.
+
+``install()`` is idempotent and runs at package import, so every entry
+point (tests, benchmarks, examples, ``__graft_entry__``) sees one
+consistent API without per-call-site guards.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def install() -> None:
+    """Backfill ``jax.shard_map`` on jax < 0.6 (idempotent)."""
+    if hasattr(jax, 'shard_map'):
+        return
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        if check_vma is not None and 'check_rep' not in kw:
+            kw['check_rep'] = check_vma
+        return _shard_map(f, mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+
+    jax.shard_map = shard_map
+
+
+def set_cpu_device_count(n: int) -> None:
+    """Request ``n`` virtual CPU devices, on any supported jax.
+
+    Uses the ``jax_num_cpu_devices`` config option where it exists
+    (jax >= 0.5); on older jax falls back to the ``XLA_FLAGS``
+    host-platform override, which only takes effect if the backend has
+    not initialized yet (same constraint the config option has).
+    """
+    try:
+        jax.config.update('jax_num_cpu_devices', n)
+    except AttributeError:
+        import re
+
+        flags = os.environ.get('XLA_FLAGS', '')
+        if '--xla_force_host_platform_device_count' in flags:
+            # Match the config option's semantics: the requested count
+            # WINS over an inherited environment value (a silent no-op
+            # here would surface later as an obscure mesh-size error).
+            flags = re.sub(
+                r'--xla_force_host_platform_device_count=\d+',
+                f'--xla_force_host_platform_device_count={n}', flags)
+            os.environ['XLA_FLAGS'] = flags
+        else:
+            os.environ['XLA_FLAGS'] = (
+                flags + f' --xla_force_host_platform_device_count={n}'
+            ).strip()
+
+
+def cpu_collective_timeout_flags_supported() -> bool:
+    """True when this jaxlib's XLA knows the
+    ``--xla_cpu_collective_call_*_timeout_seconds`` flags (>= 0.5).
+
+    XLA aborts the process on unknown ``XLA_FLAGS`` entries, so callers
+    must not set them blind; version-gated because the flag registry is
+    not introspectable before backend init.
+    """
+    import jaxlib
+
+    try:
+        major, minor = (int(x) for x in
+                        jaxlib.__version__.split('.')[:2])
+    except ValueError:  # pragma: no cover - exotic dev versions
+        return True
+    return (major, minor) >= (0, 5)
+
+
+def configured_cpu_device_count() -> int:
+    """The ``jax_num_cpu_devices`` value, or 0 where the option does not
+    exist (jax < 0.5 — the XLA_FLAGS env var is the only channel there,
+    and callers already inspect it separately)."""
+    return getattr(jax.config, 'jax_num_cpu_devices', 0) or 0

@@ -1,10 +1,11 @@
 """Vision Transformer (Dosovitskiy et al. 2021), flax NHWC — the
 encoder-attention workload.
 
-BEYOND the reference: its layer registry knows only Linear / Conv2d /
-Embedding module types (``kfac/layers/__init__.py:13-36``), and its
-attention-bearing example (``torch_language_model.py``) ships broken —
-it has no transformer workload at all. Here every ViT weight layer is
+BEYOND the reference: its layer registry has no attention-bearing
+module kinds (Linear / Conv2d / Embedding / LSTMCell only,
+``kfac/layers/__init__.py:13-36``), and its attention-bearing example
+(``torch_language_model.py``) ships broken — it has no transformer
+workload at all. Here every ViT weight layer is
 K-FAC-visible: the patch embedding is a stride-P ``nn.Conv`` (a
 ``conv2d`` factor whose A covariance is over non-overlapping patches),
 and each encoder block reuses ``transformer_lm.TransformerBlock`` with

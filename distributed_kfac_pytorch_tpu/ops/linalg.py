@@ -395,37 +395,89 @@ def get_elementwise_inverse(v: jax.Array,
     return jnp.where(v != 0.0, 1.0 / jnp.where(v != 0.0, v, 1.0), 0.0)
 
 
+def _precond_mm(compute_dtype):
+    """(operand dtype, matmul) for a non-default precondition compute dtype.
+
+    Mirrors the ``ops.factors.get_cov`` contract: operands are cast to
+    ``compute_dtype`` while every contraction accumulates in fp32
+    (``preferred_element_type``); ``float32`` additionally requests
+    ``Precision.HIGHEST`` (strict fp32 — no TPU bf16 rounding of the
+    inputs). Callers keep the legacy upcast-to-fp32 path for
+    ``compute_dtype=None`` so the default is bit-identical to the
+    pre-knob behavior.
+    """
+    cdt = jnp.dtype(compute_dtype)
+    precision = (jax.lax.Precision.HIGHEST if cdt == jnp.float32
+                 else None)
+    mm = functools.partial(jnp.matmul,
+                           preferred_element_type=jnp.float32,
+                           precision=precision)
+    return cdt, mm
+
+
 def precondition_eigen(grad: jax.Array, qa: jax.Array, qg: jax.Array,
                        da: jax.Array, dg: jax.Array,
-                       damping: float | jax.Array) -> jax.Array:
+                       damping: float | jax.Array,
+                       compute_dtype=None) -> jax.Array:
     """Eigenbasis preconditioning: ``QG ((QG^T grad QA) / (dG dA^T + λ)) QA^T``.
 
     ``grad`` is the (out_dim, in_dim[+1]) gradient matrix. Matches the
     reference's eigen path (kfac/layers/base.py:459-470), returning fp32.
+
+    ``compute_dtype``: input dtype for the four contractions (fp32
+    accumulation; see :func:`_precond_mm`). The eigenvalue quotient —
+    the damping-sensitive part — always runs in fp32; only the matmul
+    *operands* drop precision. ``None`` (default) keeps the legacy
+    upcast-everything-to-fp32 path bit-for-bit.
     """
-    grad = grad.astype(jnp.float32)
-    v1 = qg.T @ grad @ qa
-    v2 = v1 / (dg[:, None] * da[None, :] + damping)
-    return qg @ v2 @ qa.T
+    if compute_dtype is None:
+        grad = grad.astype(jnp.float32)
+        v1 = qg.T @ grad @ qa
+        v2 = v1 / (dg[:, None] * da[None, :] + damping)
+        return qg @ v2 @ qa.T
+    cdt, mm = _precond_mm(compute_dtype)
+    qa = qa.astype(cdt)
+    qg = qg.astype(cdt)
+    v1 = mm(qg.T, mm(grad.astype(cdt), qa))
+    denom = (dg.astype(jnp.float32)[:, None]
+             * da.astype(jnp.float32)[None, :] + damping)
+    v2 = (v1 / denom).astype(cdt)
+    return mm(qg, mm(v2, qa.T))
 
 
 def precondition_inv(grad: jax.Array, a_inv: jax.Array,
-                     g_inv: jax.Array) -> jax.Array:
+                     g_inv: jax.Array, compute_dtype=None) -> jax.Array:
     """Inverse-method preconditioning: ``G_inv @ grad @ A_inv``.
 
-    Reference parity: kfac/layers/base.py:472-475.
+    Reference parity: kfac/layers/base.py:472-475. With
+    ``compute_dtype=jnp.bfloat16`` and bf16-stored inverses
+    (``inv_dtype=jnp.bfloat16``) the casts are no-ops: the inverses are
+    consumed *resident* — no fp32 upcast copy of the (dim, dim) operand
+    is ever materialized, which is the bandwidth lever at LM scale
+    (4096² inverse reads every step; PERF.md r6).
     """
-    return g_inv @ grad.astype(jnp.float32) @ a_inv
+    if compute_dtype is None:
+        return g_inv @ grad.astype(jnp.float32) @ a_inv
+    cdt, mm = _precond_mm(compute_dtype)
+    return mm(g_inv.astype(cdt), mm(grad.astype(cdt),
+                                    a_inv.astype(cdt)))
 
 
 def precondition_diag_a(grad: jax.Array, a_inv_diag: jax.Array,
-                        g_inv: jax.Array) -> jax.Array:
+                        g_inv: jax.Array, compute_dtype=None) -> jax.Array:
     """Preconditioning with a diagonal A inverse (embedding layers).
 
     ``(A_inv[:, None] * grad) @ G_inv`` for a (vocab, dim) gradient.
     Reference analogue: kfac/layers/embedding.py:87-99 (disabled there).
+    The diagonal scale (elementwise, VPU-bound) always runs in fp32;
+    ``compute_dtype`` governs the G-side contraction only.
     """
-    return (a_inv_diag[:, None] * grad.astype(jnp.float32)) @ g_inv
+    if compute_dtype is None:
+        return (a_inv_diag[:, None] * grad.astype(jnp.float32)) @ g_inv
+    cdt, mm = _precond_mm(compute_dtype)
+    scaled = a_inv_diag.astype(jnp.float32)[:, None] * grad.astype(
+        jnp.float32)
+    return mm(scaled.astype(cdt), g_inv.astype(cdt))
 
 
 def eigen_side_inverse(q: jax.Array, d: jax.Array,
@@ -446,7 +498,8 @@ def eigen_side_inverse(q: jax.Array, d: jax.Array,
 
 def precondition_dispatch(grad: jax.Array, entry: dict,
                           damping: float | jax.Array,
-                          diag_a: jax.Array | None = None) -> jax.Array:
+                          diag_a: jax.Array | None = None,
+                          compute_dtype=None) -> jax.Array:
     """Per-layer preconditioning, dispatched on the inverse slots present.
 
     Single point of truth for the single-chip and SPMD preconditioners
@@ -468,16 +521,34 @@ def precondition_dispatch(grad: jax.Array, entry: dict,
 
     ``diag_a``: diagonal A inverse for embedding layers (elementwise,
     damping already baked) — then ``entry`` carries only the G side.
+
+    ``compute_dtype``: operand dtype for the precondition contractions
+    (``KFAC.precond_compute_dtype``), threaded through every branch so
+    ``auto`` mixed-method layers cannot drift: ``None`` = the legacy
+    fp32-upcast path (bit-identical default), ``jnp.bfloat16`` = bf16
+    operands with fp32 accumulation (the MXU fast path; bf16-stored
+    inverses are consumed resident, no upcast copy), ``jnp.float32`` =
+    strict fp32 (``Precision.HIGHEST``).
     """
     if diag_a is not None:
         if 'G_inv' in entry:
-            return precondition_diag_a(grad, diag_a, entry['G_inv'])
-        v1 = grad.astype(jnp.float32) @ entry['QG']
-        v2 = v1 / (entry['dG'][None, :] + damping)
-        return diag_a[:, None] * (v2 @ entry['QG'].T)
+            return precondition_diag_a(grad, diag_a, entry['G_inv'],
+                                       compute_dtype=compute_dtype)
+        if compute_dtype is None:
+            v1 = grad.astype(jnp.float32) @ entry['QG']
+            v2 = v1 / (entry['dG'][None, :] + damping)
+            return diag_a[:, None] * (v2 @ entry['QG'].T)
+        cdt, mm = _precond_mm(compute_dtype)
+        qg = entry['QG'].astype(cdt)
+        v1 = mm(grad.astype(cdt), qg)
+        v2 = v1 / (entry['dG'].astype(jnp.float32)[None, :] + damping)
+        return diag_a.astype(jnp.float32)[:, None] * mm(
+            v2.astype(cdt), qg.T)
     a_baked = 'A_inv' in entry
     g_baked = 'G_inv' in entry
     if not a_baked and not g_baked:
         return precondition_eigen(grad, entry['QA'], entry['QG'],
-                                  entry['dA'], entry['dG'], damping)
-    return precondition_inv(grad, entry['A_inv'], entry['G_inv'])
+                                  entry['dA'], entry['dG'], damping,
+                                  compute_dtype=compute_dtype)
+    return precondition_inv(grad, entry['A_inv'], entry['G_inv'],
+                            compute_dtype=compute_dtype)

@@ -323,8 +323,11 @@ class DistributedKFAC:
         # dense layers per inverse group and dynamic-slices per device,
         # so MEM/HYBRID rows compute only their OWN layers' precondition
         # matmuls (1/n_rows of the FLOPs) instead of computing every
-        # layer and masking. False keeps the replicate-and-mask form
-        # (the round-1..3 path; also the parity oracle in tests).
+        # layer and masking; at n_rows == 1 (COMM_OPT) the same plan is
+        # a pure same-shape batching — one vmapped matmul per shape
+        # group on the replicated path too (r6). False keeps the
+        # per-layer replicate-and-mask form (the round-1..3 path; also
+        # the parity oracle in tests).
         self.shard_precond_compute = shard_precond_compute
         self.n_rows = mesh.shape[INV_GROUP_AXIS]
         self.n_cols = mesh.shape[GRAD_WORKER_AXIS]
@@ -722,8 +725,9 @@ class DistributedKFAC:
                 entry['A_inv'] = inv_stacks[str(a_dim)]['inv'][my_a]
                 entry['G_inv'] = inv_stacks[str(g_dim)]['inv'][my_g]
             vs = jax.vmap(
-                lambda gm, e: linalg.precondition_dispatch(gm, e,
-                                                           damping))(
+                lambda gm, e: linalg.precondition_dispatch(
+                    gm, e, damping,
+                    compute_dtype=kfac.precond_compute_dtype))(
                 local, entry)
             for name, gslot in grp['slot_of'].items():
                 mask = (row == self.assignment.layer_row[name]).astype(
@@ -749,7 +753,14 @@ class DistributedKFAC:
         grad_mats = {
             name: L.grads_to_matrix(spec, _get(grads, spec.path))
             for name, spec in kfac.specs.items()}
-        sharded = self.shard_precond_compute and self.n_rows > 1
+        # Bucketed batched precondition matmuls on every mesh shape:
+        # with n_rows > 1 each row computes only its own layers (KAISA
+        # compute sharding); at n_rows == 1 (COMM_OPT) the same path
+        # degenerates to a pure same-shape batching — one vmapped
+        # matmul per shape group instead of a per-layer dispatch, the
+        # replicated-path analogue of the single-chip
+        # KFAC._bucketed_precond_mats.
+        sharded = self.shard_precond_compute
         precond_mats = (self._rowsharded_precond_mats(
             inv_stacks, grad_mats, damping, row) if sharded else {})
         for name, spec in kfac.specs.items():
@@ -769,7 +780,8 @@ class DistributedKFAC:
             v = linalg.precondition_dispatch(
                 grad_mats[name], inv, damping,
                 diag_a=(diag_inv[name] if spec.kind == EMBEDDING
-                        else None))
+                        else None),
+                compute_dtype=kfac.precond_compute_dtype)
             mask = (row == self.assignment.layer_row[name]).astype(v.dtype)
             precond_mats[name] = v * mask
 

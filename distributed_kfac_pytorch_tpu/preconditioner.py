@@ -182,6 +182,32 @@ class KFAC:
         (kfac/layers/base.py:385).
       inv_dtype: dtype for stored inverses (default fp32; decompositions
         always *computed* in fp32, reference base.py:432-441).
+      precond_compute_dtype: input dtype for the per-step precondition
+        contractions (``inverse · grad``), mirroring
+        ``factor_compute_dtype``'s contract — accumulation is always
+        fp32, and the damping quotient on the eigen path stays fp32.
+        Default None is the legacy path (operands upcast to fp32,
+        backend-native matmul precision) and is bit-identical to the
+        pre-knob behavior. ``jnp.bfloat16`` runs bf16 operands with
+        fp32 accumulation — the MXU fast path for the every-step
+        ``G_inv @ grad @ A_inv`` matmuls that dominate the LM
+        flagship's non-factor overhead (PERF.md r6); combined with
+        ``inv_dtype=jnp.bfloat16`` the stored inverses are consumed
+        *resident* (no fp32 upcast-on-read copy — the bandwidth lever
+        when the step is HBM-bound on inverse reads). ``jnp.float32``
+        requests strict fp32 (``Precision.HIGHEST``). Threaded through
+        ``linalg.precondition_dispatch`` for every branch
+        (eigen / baked-inverse / diagonal / mixed), single-chip and
+        SPMD alike.
+      precond_bucketing: batch same-shape dense layers' precondition
+        matmuls into one vmapped kernel per shape group (default True —
+        the r6 fast path). ``False`` restores the per-layer dispatch
+        loop exactly — the escape hatch if a backend's batched
+        dot_general ever tiles/accumulates differently from the
+        unbatched matmul (bit-identity of the default-dtype bucketed
+        path is pinned on the CPU test backend; on-TPU bit-identity is
+        expected — vmap adds a batch dim, it does not reassociate a
+        slice's contraction — but remains to be pinned on-chip).
       skip_layers: module names/classes to skip (case-insensitive, prunes
         subtrees).
       trainable: optional predicate ``trainable(module_path) -> bool``
@@ -220,6 +246,8 @@ class KFAC:
                  factor_batch_fraction: float = 1.0,
                  capture_dtype: Any = 'auto',
                  inv_dtype: Any = jnp.float32,
+                 precond_compute_dtype: Any = None,
+                 precond_bucketing: bool = True,
                  skip_layers: str | Sequence[str] | None = None,
                  trainable: Any = None,
                  symmetry_aware_comm: bool = False,
@@ -294,6 +322,8 @@ class KFAC:
         self.factor_dtype = factor_dtype
         self.factor_compute_dtype = factor_compute_dtype
         self.inv_dtype = inv_dtype
+        self.precond_compute_dtype = precond_compute_dtype
+        self.precond_bucketing = precond_bucketing
         self.symmetry_aware_comm = symmetry_aware_comm
         self.assignment_strategy = assignment_strategy
         self.comm_method = comm_method
@@ -309,7 +339,9 @@ class KFAC:
                   'auto_eigen_max_dim', 'auto_large_method',
                   'eigh_method', 'eigh_polish_iters', 'newton_iters',
                   'factor_batch_fraction', 'factor_dtype',
-                  'factor_compute_dtype', 'inv_dtype', 'symmetry_aware_comm',
+                  'factor_compute_dtype', 'inv_dtype',
+                  'precond_compute_dtype', 'precond_bucketing',
+                  'symmetry_aware_comm',
                   'assignment_strategy', 'comm_method',
                   'grad_worker_fraction')
         lines = [f'  {name}: {getattr(self, name)!r}' for name in fields]
@@ -617,29 +649,59 @@ class KFAC:
         update_gradients (preconditioner.py:577-590,661-682). Unregistered
         params pass through unchanged. ``layer_filter`` restricts which
         layers this device computes (MEM/HYBRID placement).
+
+        Dense layers are bucketed by gradient-matrix shape and
+        preconditioned as ONE vmapped batched matmul per bucket — the
+        single-chip analogue of the row-sharded KAISA batching
+        (``parallel.distributed._rowsharded_precond_mats``). On a
+        transformer, the q/k/v/o and MLP Denses of every block share
+        shapes, so ~100 per-layer (dim, dim) matmul dispatches collapse
+        into a handful of batched MXU kernels. Within a bucket the
+        per-slice contraction is the same matmul the per-layer path ran
+        (vmap adds a batch dim; it does not reassociate a slice's
+        contraction) — default-dtype bit-identity with the historical
+        per-layer dispatch is pinned on the CPU test backend
+        (tests/test_mixed_precision.py); ``precond_bucketing=False``
+        restores the per-layer loop exactly if a backend's batched
+        kernel ever tiles differently.
         """
         names = list(self.specs) if layer_filter is None else list(
             layer_filter)
-        precond_mats = {}
+        cdt = self.precond_compute_dtype
+        grad_mats = {
+            name: L.grads_to_matrix(self.specs[name],
+                                    _get(grads, self.specs[name].path))
+            for name in names}
+        precond_mats = (dict(self._bucketed_precond_mats(
+            state['inverses'], grad_mats, damping, names))
+                        if self.precond_bucketing else {})
         for name in names:
+            if name in precond_mats:
+                continue  # dense layer: computed by a shape bucket
             spec = self.specs[name]
-            grad_mat = L.grads_to_matrix(spec, _get(grads, spec.path))
             inv = state['inverses'][name]
-            # Four-way per-side dispatch (eigen / baked inverse on each
-            # side — the 'auto' mode mixes them per dim); embedding A is
-            # the diagonal elementwise inverse. Shared with the SPMD
-            # preconditioner: linalg.precondition_dispatch.
+            # Per-layer path for the non-dense kinds: embedding A is the
+            # diagonal elementwise inverse; grouped convs broadcast the
+            # batched G_inv @ grad @ A_inv over their block stacks.
+            # Same dispatch as the SPMD preconditioner:
+            # linalg.precondition_dispatch.
             precond_mats[name] = linalg.precondition_dispatch(
-                grad_mat, inv, damping,
-                diag_a=(inv['A_inv'] if spec.kind == EMBEDDING else None))
+                grad_mats[name], inv, damping,
+                diag_a=(inv['A_inv'] if spec.kind == EMBEDDING else None),
+                compute_dtype=cdt)
 
         if self.kl_clip is not None:
+            # Fused with the precondition pass: the grad matrices are
+            # already live (no second grads_to_matrix walk), and XLA
+            # fuses each product-reduce with its bucket's batched
+            # matmul output. Accumulation stays per-layer in
+            # registration order — the historical summation order, so
+            # the clip scale is bit-stable against bucketing.
             vg_sum = jnp.zeros((), jnp.float32)
             for name in names:
-                spec = self.specs[name]
-                grad_mat = L.grads_to_matrix(spec, _get(grads, spec.path))
                 vg_sum += jnp.sum(precond_mats[name] *
-                                  grad_mat.astype(jnp.float32) * lr ** 2)
+                                  grad_mats[name].astype(jnp.float32)
+                                  * lr ** 2)
             nu = jnp.minimum(
                 1.0, jnp.sqrt(self.kl_clip / (jnp.abs(vg_sum) + 1e-30)))
         else:
@@ -654,6 +716,41 @@ class KFAC:
             out = _set(out, spec.path, jax.tree.map(
                 lambda n, o: n.astype(o.dtype), new_sub, sub))
         return out
+
+    def _bucketed_precond_mats(self, inverses: dict, grad_mats: dict,
+                               damping, names: Sequence[str]):
+        """Batched precondition matmuls for the dense layers in ``names``.
+
+        Yields ``(name, preconditioned matrix)``. Layers are grouped by
+        gradient-matrix shape; each group stacks its grads and inverse
+        operands and runs ONE vmapped
+        :func:`linalg.precondition_dispatch` — per-group entry keys are
+        uniform because the per-dim method is a function of the factor
+        dims alone (``method_for_dim``), so a shape group is wholly
+        eigen-typed (QA/dA/QG/dG) or wholly baked (A_inv/G_inv; mixed
+        layers carry baked inverses for both sides). Embedding
+        (diagonal A) and grouped-conv (block-stack) layers are not
+        dense (g, a) matmuls and stay on the caller's per-layer path.
+        """
+        cdt = self.precond_compute_dtype
+        groups: dict[tuple[int, ...], list[str]] = {}
+        for name in names:
+            if self.specs[name].kind in (EMBEDDING, CONV2D_GROUPED):
+                continue
+            groups.setdefault(tuple(grad_mats[name].shape),
+                              []).append(name)
+        for members in groups.values():
+            gstack = jnp.stack([grad_mats[n] for n in members])
+            e0 = inverses[members[0]]
+            keys = (('A_inv', 'G_inv') if 'A_inv' in e0 or 'G_inv' in e0
+                    else ('QA', 'dA', 'QG', 'dG'))
+            entry = {k: jnp.stack([inverses[n][k] for n in members])
+                     for k in keys}
+            vs = jax.vmap(
+                lambda gm, e: linalg.precondition_dispatch(
+                    gm, e, damping, compute_dtype=cdt))(gstack, entry)
+            for i, n in enumerate(members):
+                yield n, vs[i]
 
     # ------------------------------------------------------------------
     # The full step

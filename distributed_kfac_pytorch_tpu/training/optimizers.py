@@ -76,6 +76,12 @@ class OptimConfig:
     # b256 ResNet-50 capture-free step on a 16 GB chip and speeds the
     # 'auto' firing 1.5x (PERF.md round 5).
     bf16_inverses: bool = False
+    # bf16 precondition-contraction operands (KFAC
+    # precond_compute_dtype; accumulation stays fp32) — the every-step
+    # inverse·grad matmuls run on the MXU bf16 path, and with
+    # bf16_inverses the stored inverses are consumed resident (no fp32
+    # upcast-on-read). Default False = the bit-identical fp32 path.
+    bf16_precond: bool = False
     skip_layers: Sequence[str] = ()
     symmetry_aware_comm: bool = False
     comm_method: str = 'comm-opt'
@@ -169,6 +175,8 @@ def get_optimizer(model, cfg: OptimConfig):
                                   else None),
             inv_dtype=(jnp.bfloat16 if cfg.bf16_inverses
                        else jnp.float32),
+            precond_compute_dtype=(jnp.bfloat16 if cfg.bf16_precond
+                                   else None),
             skip_layers=list(cfg.skip_layers) or None,
             symmetry_aware_comm=cfg.symmetry_aware_comm,
             comm_method=COMM_METHODS[cfg.comm_method.lower()],

@@ -198,9 +198,18 @@ def raise_cpu_collective_timeouts(terminate_s: int = 600,
     host between epoch-boundary program variants. Must run BEFORE the
     CPU backend initializes (XLA_FLAGS is read at backend init);
     existing user-provided values for these flags win.
+
+    No-op on old jaxlib (< 0.5): the flags do not exist there, and XLA
+    aborts the whole process on unknown ``XLA_FLAGS`` entries (fatal
+    check in parse_flags_from_env.cc) — strictly worse than the starved
+    rendezvous this guards against.
     """
     import os
 
+    from distributed_kfac_pytorch_tpu import compat
+
+    if not compat.cpu_collective_timeout_flags_supported():
+        return
     flags = os.environ.get('XLA_FLAGS', '')
     add = []
     if '--xla_cpu_collective_call_terminate_timeout_seconds' not in flags:
@@ -233,8 +242,10 @@ def _multi_device_cpu_configured() -> str | None:
     first = plats.split(',')[0] if plats else None
     m = re.search(r'xla_force_host_platform_device_count=(\d+)',
                   os.environ.get('XLA_FLAGS', ''))
+    from distributed_kfac_pytorch_tpu import compat
+
     forced = bool(m and int(m.group(1)) > 1) or (
-        jax.config.jax_num_cpu_devices > 1)
+        compat.configured_cpu_device_count() > 1)
     if first == 'cpu' and forced:
         return 'explicit'
     if forced and first is None:

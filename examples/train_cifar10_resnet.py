@@ -121,6 +121,11 @@ def parse_args(argv=None):
                    help='bf16 factor storage/averaging + bf16 covariance '
                         'matmul inputs (matmuls accumulate fp32); the '
                         'reference fp16 factor mode')
+    p.add_argument('--bf16-precond', action='store_true',
+                   help='bf16 precondition-contraction operands (fp32 '
+                        'accumulation; KFAC precond_compute_dtype) — '
+                        'the every-step inverse-times-grad matmuls on '
+                        'the MXU bf16 path (r6)')
     p.add_argument('--fp16', action='store_true',
                    help='fp16 model compute with dynamic loss scaling + '
                         'overflow-skip (GradScaler parity, reference '
@@ -147,9 +152,16 @@ def main(argv=None):
 
     (train_x, train_y), (test_x, test_y) = datasets.get_cifar(args.data_dir)
     dtype = jnp.float16 if args.fp16 else jnp.float32
-    if args.model.startswith('vit'):
-        model = vit.get_model(10, args.model.partition('_')[2] or 'cifar',
-                              dtype=dtype)
+    # Strict name parsing: exactly 'vit' or 'vit_<size>'. A prefix match
+    # alone would let 'vitbase'/'vit-base' fall through and silently
+    # train the default config (ADVICE r5).
+    model_head, _, vit_size = args.model.partition('_')
+    if model_head == 'vit':
+        model = vit.get_model(10, vit_size or 'cifar', dtype=dtype)
+    elif args.model.startswith('vit'):
+        raise SystemExit(
+            f'unknown model {args.model!r}: ViT configs are spelled '
+            "'vit' or 'vit_<cifar|tiny|small|base>'")
     else:
         model = cifar_resnet.get_model(
             args.model, dtype=dtype,
@@ -177,7 +189,8 @@ def main(argv=None):
         damping_schedule=args.damping_decay,
         kfac_update_freq_alpha=args.kfac_update_freq_alpha,
         kfac_update_freq_schedule=args.kfac_update_freq_decay,
-        bf16_factors=args.bf16_factors)
+        bf16_factors=args.bf16_factors,
+        bf16_precond=args.bf16_precond)
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(model, cfg)
 
     x0 = jnp.zeros((2, 32, 32, 3), jnp.float32)

@@ -80,7 +80,8 @@ def parse_args(argv=None):
                         'untouched.')
     p.add_argument('--bn-momentum', type=float, default=None,
                    help='BatchNorm running-stat EWMA momentum (flax '
-                        'convention; 0.9 = torch momentum 0.1)')
+                        'convention; default 0.9 = torch momentum 0.1; '
+                        'rejected for models without BatchNorm)')
     p.add_argument('--remat', action='store_true',
                    help='block-level gradient checkpointing: ~1/3 extra '
                         'forward FLOPs for O(depth) activation memory — '
@@ -136,6 +137,12 @@ def parse_args(argv=None):
                         'fp32); with --bf16-factors this is the '
                         'measured b256 production config on 16 GB '
                         'chips (PERF.md round 5)')
+    p.add_argument('--bf16-precond', action='store_true',
+                   help='bf16 precondition-contraction operands (fp32 '
+                        'accumulation; KFAC precond_compute_dtype) — '
+                        'the every-step inverse-times-grad matmuls on '
+                        'the MXU bf16 path; with --bf16-inverses the '
+                        'stored inverses are consumed resident (r6)')
     p.add_argument('--fp16', action='store_true',
                    help='fp16 model compute with dynamic loss scaling + '
                         'overflow-skip (GradScaler parity — the '
@@ -195,13 +202,20 @@ def main(argv=None):
             val_ds.batch(vb, drop_remainder=True))
 
     dtype = jnp.float16 if args.fp16 else jnp.float32
-    if args.model.startswith('vit'):
+    # Strict name parsing: exactly 'vit' or 'vit_<size>'. A prefix match
+    # alone would let 'vitbase'/'vit-base' fall through and silently
+    # train the default config (ADVICE r5).
+    model_head, _, vit_size = args.model.partition('_')
+    if model_head == 'vit':
         if args.remat:
             raise SystemExit('--remat is the ResNet block-level knob; '
                              'for ViT memory use chunked attention '
                              '(models/vit.py attn_block_size)')
-        model = vit.get_model(
-            1000, args.model.partition('_')[2] or 'small', dtype=dtype)
+        model = vit.get_model(1000, vit_size or 'small', dtype=dtype)
+    elif args.model.startswith('vit'):
+        raise SystemExit(
+            f'unknown model {args.model!r}: ViT configs are spelled '
+            "'vit' or 'vit_<tiny|small|base>'")
     else:
         model = imagenet_resnet.get_model(
             args.model, dtype=dtype,
@@ -226,7 +240,8 @@ def main(argv=None):
         kfac_update_freq_alpha=args.kfac_update_freq_alpha,
         kfac_update_freq_schedule=args.kfac_update_freq_decay,
         bf16_factors=args.bf16_factors,
-        bf16_inverses=args.bf16_inverses)
+        bf16_inverses=args.bf16_inverses,
+        bf16_precond=args.bf16_precond)
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(model, cfg)
 
     x0 = jnp.zeros((2, args.image_size, args.image_size, 3), jnp.float32)

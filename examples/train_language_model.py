@@ -134,6 +134,12 @@ def parse_args(argv=None):
                    help='bf16 factor storage/averaging + bf16 covariance '
                         'matmul inputs (matmuls accumulate fp32); the '
                         'reference fp16 factor mode')
+    p.add_argument('--bf16-precond', action='store_true',
+                   help='bf16 precondition-contraction operands (fp32 '
+                        'accumulation; KFAC precond_compute_dtype) — '
+                        'the every-step inverse-times-grad matmuls on '
+                        'the MXU bf16 path; with --bf16-inverses the '
+                        'stored inverses are consumed resident (r6)')
     p.add_argument('--fp16', action='store_true',
                    help='fp16 model compute with dynamic loss scaling + '
                         'overflow-skip (GradScaler parity, reference '
@@ -218,7 +224,8 @@ def main(argv=None):
         grad_worker_fraction=args.grad_worker_fraction,
         symmetry_aware_comm=args.symmetry_aware_comm,
         bf16_factors=args.bf16_factors,
-        bf16_inverses=args.bf16_inverses)
+        bf16_inverses=args.bf16_inverses,
+        bf16_precond=args.bf16_precond)
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(model, cfg)
     if kfac is None:
         raise SystemExit('use --kfac-update-freq >= 1')

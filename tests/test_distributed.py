@@ -336,6 +336,7 @@ def test_grad_accumulation_threads_batch_stats():
 
 
 @pytest.mark.parametrize('comm_method,frac', [
+    (CommMethod.COMM_OPT, 0.0),
     (CommMethod.MEM_OPT, 0.0),
     (CommMethod.HYBRID_OPT, 0.5),
 ])
@@ -345,9 +346,11 @@ def test_rowsharded_precond_matches_masked(comm_method, frac):
     ``shard_precond_compute=True`` (default) computes each row's own
     layers only (stacked dynamic-slice, reference
     preconditioner.py:577-585 semantics); False is the replicate-and-
-    mask oracle. Same model, same steps — parameters and K-FAC factors
-    must agree to fp tolerance (the matmuls are reassociated across a
-    vmap, so not bit-equal).
+    mask oracle. At COMM_OPT (one row) the sharded plan degenerates to
+    pure same-shape batching — the r6 bucketed replicated path — and
+    must still match. Same model, same steps — parameters and K-FAC
+    factors must agree to fp tolerance (the matmuls are reassociated
+    across a vmap, so not bit-equal).
     """
     model = SmallCNN()
     x = jax.random.normal(jax.random.PRNGKey(1), (16, 8, 8, 3))
@@ -459,3 +462,54 @@ def test_distributed_step_with_fraction_trains():
         lambda a, b: float(jnp.abs(a - b).max()),
         outs[1.0][2]['factors'], outs[0.25][2]['factors']))
     assert max(diffs) > 0
+
+
+@pytest.mark.parametrize('comm_method,frac', [
+    (CommMethod.COMM_OPT, 0.0),
+    (CommMethod.HYBRID_OPT, 0.5),
+])
+def test_spmd_precond_compute_dtype_bf16_parity(comm_method, frac):
+    """precond_compute_dtype=bf16 on the 8-device mesh == the
+    single-device bf16 step (r6 tentpole: the knob threads through
+    the row-sharded bucket path AND the per-layer fallback), and
+    tracks the fp32 distributed step to bf16 tolerance."""
+    model = SmallCNN()
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 8, 8, 3))
+    y = jax.random.randint(jax.random.PRNGKey(2), (16,), 0, 10)
+
+    def run(precond_dtype):
+        kfac = KFAC(model, factor_update_freq=1, inv_update_freq=2,
+                    damping=0.003, lr=0.1, eigh_method='xla',
+                    precond_compute_dtype=precond_dtype)
+        variables, state = kfac.init(jax.random.PRNGKey(0), x)
+        params = variables['params']
+        ref_params, _, _ = single_device_reference(
+            kfac, params, state, (x, y), n_steps=2, lr=0.1)
+        dkfac = make_dist(kfac, params, comm_method, frac)
+        dstate = dkfac.init_state(params)
+        tx = optax.sgd(0.1)
+        opt_state = tx.init(params)
+        step = dkfac.build_train_step(loss_fn, tx, donate=False)
+        dparams, extra = jax.tree.map(jnp.asarray, params), {}
+        for _ in range(2):
+            dparams, opt_state, dstate, extra, _ = step(
+                dparams, opt_state, dstate, extra, (x, y),
+                {'lr': 0.1, 'damping': 0.003})
+        return ref_params, dparams
+
+    ref16, dist16 = run(jnp.bfloat16)
+    # Distributed bf16 == single-device bf16 (same contraction dtype).
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-2,
+                                                atol=1e-4),
+        ref16, dist16)
+    # And the bf16 distributed step tracks fp32 to bf16 tolerance.
+    _, dist32 = run(None)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-2,
+                                                atol=5e-3),
+        dist16, dist32)
+    # The knob genuinely changed bits somewhere.
+    assert any(not np.array_equal(a, b)
+               for a, b in zip(jax.tree.leaves(dist16),
+                               jax.tree.leaves(dist32)))

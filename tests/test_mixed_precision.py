@@ -13,14 +13,60 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from distributed_kfac_pytorch_tpu import KFAC
+from distributed_kfac_pytorch_tpu import layers as L
+from distributed_kfac_pytorch_tpu.capture import EMBEDDING
+from distributed_kfac_pytorch_tpu.ops import linalg
+from distributed_kfac_pytorch_tpu.preconditioner import _get
 
 
 class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         return nn.Dense(4)(nn.relu(nn.Dense(12)(x)))
+
+
+class StraddleEmbedNet(nn.Module):
+    """Embedding + four Denses hitting every precondition dispatch
+    branch under ``auto_eigen_max_dim=16``: both-eigen, A-eigen/G-inv,
+    both-inv, A-inv/G-eigen, plus the diagonal-A embedding path."""
+
+    @nn.compact
+    def __call__(self, ids):
+        x = nn.Embed(24, 8, name='emb')(ids).mean(axis=1)
+        x = nn.relu(nn.Dense(8, name='l_ee')(x))
+        x = nn.relu(nn.Dense(24, name='l_ei')(x))
+        x = nn.relu(nn.Dense(24, name='l_ii')(x))
+        return nn.Dense(6, name='l_ie')(x)
+
+
+def _embed_batch():
+    ids = jax.random.randint(jax.random.PRNGKey(1), (32, 5), 0, 24)
+    y = jax.random.randint(jax.random.PRNGKey(2), (32,), 0, 6)
+    return ids, y
+
+
+def _stepped(precond_compute_dtype, kl_clip=None, inv_dtype=jnp.float32,
+             inverse_method=None):
+    """One full factor+inverse+precondition step on StraddleEmbedNet."""
+    ids, y = _embed_batch()
+    kfac = KFAC(StraddleEmbedNet(), factor_update_freq=1,
+                inv_update_freq=1, damping=0.01, lr=0.1,
+                auto_eigen_max_dim=16, kl_clip=kl_clip,
+                eigh_method='xla', inv_dtype=inv_dtype,
+                inverse_method=inverse_method,
+                precond_compute_dtype=precond_compute_dtype)
+    variables, state = kfac.init(jax.random.PRNGKey(0), ids)
+    params = variables['params']
+    _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+        lambda out: optax.softmax_cross_entropy_with_integer_labels(
+            out, y).mean(), params, ids)
+    precond, new_state = jax.jit(
+        lambda s, g, c: kfac.step(s, g, c, factor_update=True,
+                                  inv_update=True))(state, grads, captures)
+    return kfac, grads, precond, new_state
 
 
 def _data():
@@ -184,3 +230,155 @@ class TestFp16Robustness:
         _, new_state = kfac.step(state, grads, clean)
         for leaf in jax.tree.leaves(new_state['factors']):
             assert np.isfinite(np.asarray(leaf)).all()
+
+
+# ---------------------------------------------------------------------------
+# precond_compute_dtype: the bf16 precondition pipeline (r6 tentpole)
+# ---------------------------------------------------------------------------
+
+def _legacy_per_layer_precondition(kfac, state, grads, damping, lr):
+    """The pre-r6 single-chip precondition: per-layer dispatch, KL clip
+    as a second grads_to_matrix walk. The bit-identity oracle for the
+    bucketed path's default-dtype contract."""
+    from distributed_kfac_pytorch_tpu.preconditioner import _set
+
+    names = list(kfac.specs)
+    precond_mats = {}
+    for name in names:
+        spec = kfac.specs[name]
+        grad_mat = L.grads_to_matrix(spec, _get(grads, spec.path))
+        inv = state['inverses'][name]
+        precond_mats[name] = linalg.precondition_dispatch(
+            grad_mat, inv, damping,
+            diag_a=(inv['A_inv'] if spec.kind == EMBEDDING else None))
+    if kfac.kl_clip is not None:
+        vg_sum = jnp.zeros((), jnp.float32)
+        for name in names:
+            spec = kfac.specs[name]
+            grad_mat = L.grads_to_matrix(spec, _get(grads, spec.path))
+            vg_sum += jnp.sum(precond_mats[name] *
+                              grad_mat.astype(jnp.float32) * lr ** 2)
+        nu = jnp.minimum(
+            1.0, jnp.sqrt(kfac.kl_clip / (jnp.abs(vg_sum) + 1e-30)))
+    else:
+        nu = jnp.ones((), jnp.float32)
+    out = jax.tree.map(lambda x: x, grads)
+    for name in names:
+        spec = kfac.specs[name]
+        sub = _get(grads, spec.path)
+        new_sub = L.matrix_to_grads(
+            spec, (nu * precond_mats[name]).astype(jnp.float32), sub)
+        out = _set(out, spec.path, jax.tree.map(
+            lambda n, o: n.astype(o.dtype), new_sub, sub))
+    return out
+
+
+def _oracle_mats(kfac, state, grads, damping):
+    """fp64 dense-oracle preconditioned matrices per layer (the
+    reference operators, from the post-step factors)."""
+    want = {}
+    for name, spec in kfac.specs.items():
+        grad_mat = np.asarray(
+            L.grads_to_matrix(spec, _get(grads, spec.path)), np.float64)
+        a = np.asarray(state['factors'][name]['A'], np.float64)
+        g = np.asarray(state['factors'][name]['G'], np.float64)
+        g_inv = np.linalg.inv(g + damping * np.eye(g.shape[0]))
+        if spec.kind == EMBEDDING:
+            want[name] = (1.0 / (a + damping))[:, None] * (
+                grad_mat @ g_inv)
+            continue
+        a_dim, g_dim = a.shape[0], g.shape[0]
+        both_eigen = (kfac.method_for_dim(a_dim) == 'eigen'
+                      and kfac.method_for_dim(g_dim) == 'eigen')
+        if both_eigen:
+            da_, qa = np.linalg.eigh(a)
+            dg_, qg = np.linalg.eigh(g)
+            v1 = qg.T @ grad_mat @ qa
+            v2 = v1 / (dg_[:, None] * da_[None, :] + damping)
+            want[name] = qg @ v2 @ qa.T
+        else:
+            a_inv = np.linalg.inv(a + damping * np.eye(a_dim))
+            want[name] = g_inv @ grad_mat @ a_inv
+    return want
+
+
+class TestPrecondComputeDtype:
+    """r6 tentpole: low-precision, bucketed precondition pipeline."""
+
+    def test_default_bit_identical_to_per_layer_dispatch(self):
+        """precond_compute_dtype=None + shape bucketing == the pre-r6
+        per-layer loop, bit for bit (incl. the KL-clip scale)."""
+        kfac, grads, _, state = _stepped(None, kl_clip=0.001)
+        got = jax.jit(
+            lambda s, g: kfac.precondition(s, g, 0.01, 0.1))(state, grads)
+        want = jax.jit(
+            lambda s, g: _legacy_per_layer_precondition(
+                kfac, s, g, 0.01, 0.1))(state, grads)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            got, want)
+
+    @pytest.mark.parametrize('method', ['auto', 'cholesky'])
+    def test_dtype_ladder_vs_dense_oracle(self, method):
+        """fp32-strict and bf16 preconditioned grads vs the fp64 dense
+        oracle, across every dispatch branch (both-eigen, mixed x2,
+        both-inverse via 'auto'; all-baked + diag/G_inv via 'cholesky';
+        diag/eigen-G embedding via 'auto')."""
+        damping = 0.01
+        outs = {}
+        for cdt in (None, jnp.float32, jnp.bfloat16):
+            kfac, grads, precond, state = _stepped(
+                cdt, inverse_method=method)
+            outs[cdt] = precond
+        tols = {None: 1e-4, jnp.float32: 1e-4, jnp.bfloat16: 5e-2}
+        want = _oracle_mats(kfac, state, grads, damping)
+        for cdt, precond in outs.items():
+            for name, spec in kfac.specs.items():
+                v = np.asarray(L.grads_to_matrix(
+                    spec, _get(precond, spec.path)), np.float64)
+                scale = np.abs(want[name]).max()
+                np.testing.assert_allclose(
+                    v, want[name], rtol=tols[cdt],
+                    atol=tols[cdt] * scale,
+                    err_msg=f'{name} @ {cdt}')
+        # bf16 genuinely changed the operand bits (the cast really ran).
+        leaves0 = jax.tree.leaves(outs[None])
+        leaves16 = jax.tree.leaves(outs[jnp.bfloat16])
+        assert any(not np.array_equal(a, b)
+                   for a, b in zip(leaves0, leaves16))
+
+    def test_bf16_resident_inverses_consumed_without_upcast(self):
+        """inv_dtype=bf16 + precond_compute_dtype=bf16 (the
+        bandwidth-lever config: stored inverses consumed resident)
+        tracks the fp32-read path to bf16 tolerance."""
+        base_kfac, grads, base, state = _stepped(
+            None, inv_dtype=jnp.bfloat16)
+        _, _, resident, _ = _stepped(jnp.bfloat16,
+                                     inv_dtype=jnp.bfloat16)
+        for a, b in zip(jax.tree.leaves(base), jax.tree.leaves(resident)):
+            a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+            assert np.isfinite(b).all()
+            scale = max(np.abs(a).max(), 1e-30)
+            np.testing.assert_allclose(a, b, rtol=5e-2,
+                                       atol=5e-2 * scale)
+
+    def test_bucketing_opt_out_is_exact(self):
+        """precond_bucketing=False restores the per-layer dispatch loop
+        bit-for-bit (the escape hatch if a backend's batched kernel
+        ever tiles differently from the unbatched matmul)."""
+        kfac, grads, _, state = _stepped(None, kl_clip=0.001)
+        bucketed = jax.jit(
+            lambda s, g: kfac.precondition(s, g, 0.01, 0.1))(state, grads)
+        kfac.precond_bucketing = False  # host-side static knob
+        per_layer = jax.jit(
+            lambda s, g: kfac.precondition(s, g, 0.01, 0.1))(state, grads)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            bucketed, per_layer)
+
+    def test_repr_lists_precond_dtype(self):
+        kfac = KFAC(MLP(), precond_compute_dtype=jnp.bfloat16)
+        assert 'precond_compute_dtype' in repr(kfac)
+        assert 'precond_bucketing' in repr(kfac)

@@ -2,8 +2,9 @@
 bidirectional wiring, chunked-attention parity, and a full K-FAC step.
 
 The reference has no attention workload at all (its LM example ships
-broken — torch_language_model.py:253,277 — and its registry knows only
-Linear/Conv2d/Embedding, kfac/layers/__init__.py:13-36), so these pin
+broken — torch_language_model.py:253,277 — and its registry has no
+attention-bearing kinds: Linear/Conv2d/Embedding/LSTMCell only,
+kfac/layers/__init__.py:13-36), so these pin
 a family that exists only here: a stride-P conv2d factor feeding the
 same encoder Denses the LM flagship preconditions, under
 ``causal=False`` attention.
