@@ -16,6 +16,7 @@ from distributed_kfac_pytorch_tpu import compat
 compat.install()
 
 from distributed_kfac_pytorch_tpu import fp16
+from distributed_kfac_pytorch_tpu import observability
 from distributed_kfac_pytorch_tpu import ops
 from distributed_kfac_pytorch_tpu import parallel
 from distributed_kfac_pytorch_tpu import utils

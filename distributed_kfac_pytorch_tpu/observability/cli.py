@@ -1,0 +1,106 @@
+"""Shared CLI wiring for the observability flags.
+
+All three example entry points (CIFAR / ImageNet / LM) expose the same
+observability surface; this module is its single implementation:
+
+    add_observability_args(parser)       # --kfac-metrics / --metrics-
+                                         # interval / --health-action /
+                                         # --profile-dir
+    sink = make_metrics_sink(args, info, meta={...})
+    profile_epoch(args, info, epoch, start_epoch)   # context manager
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+from distributed_kfac_pytorch_tpu.observability import health as obs_health
+from distributed_kfac_pytorch_tpu.observability import profiling
+from distributed_kfac_pytorch_tpu.observability import sink as obs_sink
+
+
+def add_observability_args(p) -> None:
+    """Observability flags (r7; see README "Observability")."""
+    p.add_argument('--kfac-metrics', nargs='?', const='auto',
+                   default=None, metavar='PATH',
+                   help='collect on-device K-FAC step metrics (damping, '
+                        'KL-clip nu, grad/precond norms, firing counts, '
+                        'non-finite events) into a schema-versioned '
+                        'JSONL — default PATH <log-dir>/'
+                        'kfac_metrics.jsonl, rank-0 only, no host '
+                        'syncs added to the step. Summarize with: '
+                        'python -m distributed_kfac_pytorch_tpu'
+                        '.observability.report PATH')
+    p.add_argument('--metrics-interval', type=int, default=10,
+                   help='keep every Nth step record in the metrics '
+                        'JSONL (epoch records always kept)')
+    p.add_argument('--health-action', default=None,
+                   choices=['warn', 'skip', 'raise'],
+                   help='K-FAC health monitoring over the drained '
+                        'metrics (non-finite events, factor staleness, '
+                        'damping jumps). skip/raise also arm the '
+                        'on-device non-finite factor-update guard — '
+                        'which protects the FACTOR STATISTICS only; '
+                        'for a whole-step skip of params/optimizer on '
+                        'non-finite grads use --fp16 (dynamic loss '
+                        'scaling, GradScaler parity). Requires '
+                        '--kfac-metrics')
+    p.add_argument('--profile-dir', default=None,
+                   help='capture a jax.profiler trace of the first '
+                        'trained epoch into this dir (kfac/* named '
+                        'stage scopes attribute step time; rank 0 only)')
+
+
+def wants_guard(args) -> bool:
+    """True when the on-device non-finite factor guard should be armed
+    ('warn' observes only; 'skip'/'raise' protect the state)."""
+    return getattr(args, 'health_action', None) in ('skip', 'raise')
+
+
+def make_metrics_sink(args, info, meta: dict | None = None):
+    """JSONL sink (+ optional health monitor) for a CLI, or None.
+
+    Rank gating happens inside the sink (non-zero ranks get a no-op
+    sink), so callers need no is_main branches. The monitor's
+    factor-staleness threshold derives from the CLI's cov-update
+    cadence (10x the expected interval — a schedule bug signature, not
+    normal jitter); without that wiring the check would be dead from
+    the CLIs (its constructor default is off).
+    """
+    if args.health_action and not args.kfac_metrics:
+        raise SystemExit('--health-action requires --kfac-metrics '
+                         '(the monitor consumes the drained metrics)')
+    if not args.kfac_metrics:
+        return None
+    path = (os.path.join(args.log_dir, 'kfac_metrics.jsonl')
+            if args.kfac_metrics == 'auto' else args.kfac_metrics)
+    monitor = None
+    if args.health_action:
+        cov_freq = max(1, int(getattr(args, 'kfac_cov_update_freq', 1)))
+        monitor = obs_health.HealthMonitor(
+            action=args.health_action,
+            stale_after_steps=10 * cov_freq)
+    return obs_sink.JsonlMetricsSink(
+        path, interval=args.metrics_interval,
+        process_index=info['process_index'], monitor=monitor,
+        meta=meta)
+
+
+@contextlib.contextmanager
+def profile_epoch(args, info, epoch: int, start_epoch: int):
+    """Profile exactly the first trained epoch when --profile-dir is set.
+
+    Compile time of the step variants lands inside this window too —
+    that is deliberate (the profile then shows compile vs steady-state);
+    steady-state-only captures can re-run with checkpoints resumed.
+    """
+    active = (args.profile_dir is not None and epoch == start_epoch
+              and profiling.start_trace(
+                  args.profile_dir,
+                  process_index=info['process_index']))
+    try:
+        yield
+    finally:
+        if active:
+            profiling.stop_trace()

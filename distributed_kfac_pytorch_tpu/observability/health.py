@@ -1,0 +1,154 @@
+"""Host-side K-FAC health monitoring over drained metric records.
+
+The on-device half lives in the preconditioner (the non-finite factor
+guard: a NaN/Inf candidate factor update is *skipped* on device and
+counted in ``metrics['nonfinite_skips']``, so the running factors are
+never poisoned). This module is the host half: it watches the drained
+JSONL records and turns anomalies into events with a configurable
+``action``:
+
+  - ``'warn'``  — ``warnings.warn`` once per event (default);
+  - ``'skip'``  — record the event silently (the device guard already
+    protected the state; useful for unattended sweeps);
+  - ``'raise'`` — raise :class:`HealthError` (fail fast in CI or when a
+    run's numerics must be pristine).
+
+Checks (each one host-arithmetic over scalars — zero device work):
+
+  - **non-finite events**: ``nonfinite_skips`` increments, or any
+    non-finite ``loss`` / ``grad_norm`` / ``precond_norm``;
+  - **factor staleness**: steps since ``factor_updates`` last
+    incremented exceeds ``stale_after_steps``;
+  - **damping trajectory**: the per-step damping jumps by more than
+    ``damping_jump_factor`` between consecutive records (a scheduler
+    bug signature), or goes non-positive/non-finite;
+  - **eigenvalue floor**: ``eig_clipped`` (eigenvalues pinned at the
+    0.0 clip floor) rises past ``eig_clip_limit`` — rising-edge
+    detection, so a persistently floored (stable, damping-covered)
+    spectrum fires once per new high, not once per record.
+
+The monitor runs at sink drain time (off the step path) — see
+``JsonlMetricsSink(monitor=...)`` — or standalone over records from
+``sink.read_jsonl``.
+"""
+
+from __future__ import annotations
+
+import math
+import warnings
+
+from distributed_kfac_pytorch_tpu.observability.sink import (
+    to_float as _num,  # shared coercion ('nan'/'inf' strings round-trip)
+)
+
+ACTIONS = ('warn', 'skip', 'raise')
+
+
+class HealthError(RuntimeError):
+    """Raised by a monitor with ``action='raise'`` on a health event."""
+
+
+class HealthMonitor:
+    """Stateful record-stream watcher (one instance per run)."""
+
+    def __init__(self, action: str = 'warn', *,
+                 stale_after_steps: int | None = None,
+                 damping_jump_factor: float = 10.0,
+                 eig_clip_limit: int = 0):
+        if action not in ACTIONS:
+            raise ValueError(f'action must be one of {ACTIONS}, '
+                             f'got {action!r}')
+        self.action = action
+        self.stale_after_steps = stale_after_steps
+        self.damping_jump_factor = damping_jump_factor
+        self.eig_clip_limit = eig_clip_limit
+        self.events: list[str] = []
+        self._last_factor_updates: float | None = None
+        self._last_factor_step: int | None = None
+        self._last_damping: float | None = None
+        self._nonfinite_skips = 0.0
+        self._max_eig_clipped = float(eig_clip_limit)
+
+    # -- the checks ----------------------------------------------------
+
+    def observe(self, rec: dict) -> list[str]:
+        """Consume one record; returns (and acts on) new events."""
+        if rec.get('kind') != 'step':
+            return []
+        step = int(rec.get('step', 0))
+        m = rec.get('metrics', {})
+        events: list[str] = []
+
+        skips = _num(m.get('kfac/nonfinite_skips'))
+        if not math.isnan(skips) and skips > self._nonfinite_skips:
+            events.append(
+                f'step {step}: non-finite candidate factor update '
+                f'(total {int(skips)}) — gradients/captures contained '
+                "NaN/Inf (skipped on device when the guard is armed, "
+                "i.e. --health-action skip/raise)")
+            self._nonfinite_skips = skips
+        for key in ('loss', 'kfac/grad_norm', 'kfac/precond_norm'):
+            if key in m and not math.isfinite(_num(m[key])):
+                events.append(f'step {step}: non-finite {key} = '
+                              f'{m[key]!r}')
+
+        fu = _num(m.get('kfac/factor_updates'))
+        if not math.isnan(fu):
+            if self._last_factor_updates is None or \
+                    fu > self._last_factor_updates:
+                self._last_factor_updates = fu
+                self._last_factor_step = step
+            elif (self.stale_after_steps is not None
+                  and self._last_factor_step is not None
+                  and step - self._last_factor_step
+                  > self.stale_after_steps):
+                events.append(
+                    f'step {step}: factors stale — no factor update '
+                    f'for {step - self._last_factor_step} steps '
+                    f'(limit {self.stale_after_steps})')
+
+        damping = _num(m.get('kfac/damping'))
+        if 'kfac/damping' in m:
+            if not math.isfinite(damping) or damping <= 0.0:
+                events.append(f'step {step}: damping {m["kfac/damping"]!r}'
+                              ' is not a positive finite value')
+            elif self._last_damping is not None and self._last_damping > 0:
+                ratio = max(damping / self._last_damping,
+                            self._last_damping / damping)
+                if ratio > self.damping_jump_factor:
+                    events.append(
+                        f'step {step}: damping jumped {ratio:.1f}x '
+                        f'({self._last_damping:g} -> {damping:g})')
+            if math.isfinite(damping):
+                self._last_damping = damping
+
+        # Rising-edge only: the stored spectra persist between inverse
+        # firings, so a rank-deficient factor would otherwise re-fire
+        # on EVERY drained record (warn-storm under 'warn', instant
+        # abort under 'raise' — floored-but-stable eigenvalues are
+        # numerically harmless, the damping carries them).
+        clipped = _num(m.get('kfac/eig_clipped'))
+        if not math.isnan(clipped) and clipped > self._max_eig_clipped:
+            events.append(
+                f'step {step}: {int(clipped)} eigenvalues at the 0.0 '
+                f'clip floor (limit {self.eig_clip_limit}, previous '
+                f'high {int(self._max_eig_clipped)}) — factors are '
+                'rank-deficient or numerically indefinite')
+            self._max_eig_clipped = clipped
+
+        self.events.extend(events)
+        for e in events:
+            self._act(e)
+        return events
+
+    def _act(self, event: str) -> None:
+        if self.action == 'raise':
+            raise HealthError(event)
+        if self.action == 'warn':
+            warnings.warn(f'KFAC health: {event}', RuntimeWarning,
+                          stacklevel=3)
+
+    def summary(self) -> dict:
+        return {'events': len(self.events),
+                'nonfinite_skips': int(self._nonfinite_skips),
+                'last_damping': self._last_damping}

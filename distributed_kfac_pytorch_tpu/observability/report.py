@@ -1,0 +1,179 @@
+"""Offline run report over a recorded K-FAC metrics JSONL.
+
+    python -m distributed_kfac_pytorch_tpu.observability.report run.jsonl
+
+Prints, from the recorded stream alone (no live process needed):
+
+  - run/meta header and record inventory;
+  - the per-stage step-time breakdown (host trace-table snapshots from
+    epoch records — the stages CLIs/benchmarks decorate with
+    ``observability.tracing.trace`` — plus per-step host dispatch
+    time);
+  - K-FAC health: factor/inverse firing counts, non-finite skips,
+    eigenvalue-floor clips, damping/ν trajectory, grad vs
+    preconditioned-grad norm ratio;
+  - per precondition-bucket norms (last recorded step).
+
+Exit status is non-zero when the file fails schema validation, so the
+CI smoke can gate on it directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import sys
+
+from distributed_kfac_pytorch_tpu.observability.health import (
+    HealthMonitor,
+)
+from distributed_kfac_pytorch_tpu.observability.sink import (
+    read_jsonl,
+    to_float as _num,
+)
+
+
+def _fmt(v: float, unit: str = '') -> str:
+    if math.isnan(v):
+        return '-'
+    return f'{v:.4g}{unit}'
+
+
+def _series(records, key):
+    out = []
+    for r in records:
+        if r.get('kind') == 'step' and key in r.get('metrics', {}):
+            out.append((r['step'], _num(r['metrics'][key])))
+    return out
+
+
+def summarize(records: list[dict]) -> dict:
+    """Structured summary of a record stream (the report's data model)."""
+    steps = [r for r in records if r.get('kind') == 'step']
+    epochs = [r for r in records if r.get('kind') == 'epoch']
+    meta = next((r['meta'] for r in records if r.get('kind') == 'meta'),
+                {})
+
+    # Per-stage breakdown: the LAST epoch record's trace snapshot holds
+    # the cumulative table (snapshot_trace accumulates over the run).
+    stages = {}
+    for r in epochs:
+        for k, v in r.get('trace', {}).items():
+            stages[k] = v
+
+    host_ms = [r['host_step_ms'] for r in steps if 'host_step_ms' in r]
+    loss = _series(records, 'loss')
+    gn = _series(records, 'kfac/grad_norm')
+    pn = _series(records, 'kfac/precond_norm')
+    ratio = [(s, p / g if g else float('nan'))
+             for (s, g), (_, p) in zip(gn, pn)]
+    damping = _series(records, 'kfac/damping')
+    nu = _series(records, 'kfac/nu')
+
+    last = steps[-1]['metrics'] if steps else {}
+    buckets = {k.split('/', 2)[-1]: _num(v) for k, v in last.items()
+               if k.startswith('kfac/bucket_norm/')}
+
+    monitor = HealthMonitor(action='skip')
+    for r in records:
+        monitor.observe(r)
+
+    return {
+        'meta': meta,
+        'n_records': len(records),
+        'n_steps': len(steps),
+        'n_epochs': len(epochs),
+        'step_range': ((steps[0]['step'], steps[-1]['step'])
+                       if steps else None),
+        'stages': stages,
+        'host_step_ms': (sum(host_ms) / len(host_ms) if host_ms
+                         else float('nan')),
+        'loss': loss,
+        'precond_ratio': ratio,
+        'damping': damping,
+        'nu': nu,
+        'factor_updates': _num(last.get('kfac/factor_updates')),
+        'inv_updates': _num(last.get('kfac/inv_updates')),
+        'nonfinite_skips': _num(last.get('kfac/nonfinite_skips')),
+        'eig_clipped': _num(last.get('kfac/eig_clipped')),
+        'bucket_norms': buckets,
+        'health_events': list(monitor.events),
+    }
+
+
+def print_report(s: dict, out=None) -> None:
+    out = out or sys.stdout
+    w = lambda line='': print(line, file=out)
+    w('== K-FAC run report ==')
+    if s['meta']:
+        w('meta: ' + ', '.join(f'{k}={v}' for k, v in
+                               sorted(s['meta'].items())))
+    rng = s['step_range']
+    w(f"records: {s['n_records']} ({s['n_steps']} step / "
+      f"{s['n_epochs']} epoch)"
+      + (f", steps {rng[0]}..{rng[1]}" if rng else ''))
+    w()
+    w('-- step time --')
+    w(f"host dispatch: {_fmt(s['host_step_ms'], ' ms/step')}")
+    if s['stages']:
+        w('stage                              mean ms    total ms  calls')
+        for k in sorted(s['stages']):
+            v = s['stages'][k]
+            w(f"{k:<34} {v['mean_ms']:>8.3f} {v['total_ms']:>11.3f}"
+              f"  {v['count']:>5}")
+    else:
+        w('(no host trace-table snapshots in the records — epoch '
+          'records absent or no host phase was timed; see '
+          'observability.tracing)')
+    w()
+    w('-- K-FAC health --')
+    w(f"factor updates: {_fmt(s['factor_updates'])}   "
+      f"inverse updates: {_fmt(s['inv_updates'])}")
+    w(f"non-finite skips: {_fmt(s['nonfinite_skips'])}   "
+      f"eigenvalues at clip floor: {_fmt(s['eig_clipped'])}")
+    for name, series in (('loss', s['loss']),
+                         ('damping', s['damping']),
+                         ('kl-clip nu', s['nu']),
+                         ('precond/grad norm ratio',
+                          s['precond_ratio'])):
+        if series:
+            vals = [v for _, v in series if not math.isnan(v)]
+            if vals:
+                w(f'{name}: first {_fmt(series[0][1])}  '
+                  f'last {_fmt(series[-1][1])}  '
+                  f'min {_fmt(min(vals))}  max {_fmt(max(vals))}')
+    if s['bucket_norms']:
+        w()
+        w('-- precondition buckets (last step, |v| per shape) --')
+        for k in sorted(s['bucket_norms']):
+            w(f'{k:<16} {_fmt(s["bucket_norms"][k])}')
+    w()
+    if s['health_events']:
+        w(f"-- {len(s['health_events'])} health event(s) --")
+        for e in s['health_events']:
+            w(f'  ! {e}')
+    else:
+        w('no health events.')
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog='python -m distributed_kfac_pytorch_tpu.observability'
+             '.report',
+        description='Summarize a recorded K-FAC metrics JSONL '
+                    '(schema-validates; non-zero exit on invalid '
+                    'files).')
+    p.add_argument('jsonl', help='metrics file from --kfac-metrics '
+                                 '(rotated segments are read too)')
+    args = p.parse_args(argv)
+    try:
+        records = read_jsonl(args.jsonl)
+    except (OSError, ValueError) as e:
+        print(f'error: {e}', file=sys.stderr)
+        return 1
+    print_report(summarize(records))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,294 @@
+"""Schema-versioned JSONL metrics sink (rank-0 gated, atomic, rotating).
+
+Design constraints (ISSUE r7):
+
+  - **No host syncs in the step path.** ``step_record`` only *enqueues*
+    the device scalars (kicking off an async device->host copy where
+    the backend supports it); conversion to floats happens at drain
+    time, by which point the host has dispatched well past the step
+    that produced them.
+  - **Rank-0 gating.** Every process constructs the sink with its
+    ``process_index``; only rank 0 ever touches the filesystem, so a
+    multihost run produces exactly one stream (covered by
+    tests/multihost_worker.py mode='metrics').
+  - **Atomic write-then-rename.** The current segment's lines are
+    rewritten to ``<path>.tmp.<pid>`` and ``os.replace``d over the
+    target on every drain — a reader (or a crashed run) never observes
+    a torn/interleaved line. Rotation bounds the rewrite cost:
+    a full segment is renamed to ``<path>.<n>`` and a fresh one starts.
+
+Record schema (``schema`` = :data:`SCHEMA_VERSION`):
+
+  {"schema": 1, "kind": "step",  "step": int, "wall_time": float,
+   "host_step_ms": float?, "metrics": {flat name -> float}}
+  {"schema": 1, "kind": "epoch", "epoch": int, "wall_time": float,
+   "metrics": {...averaged epoch metrics...}, "trace": {stage: {...}}}
+  {"schema": 1, "kind": "meta",  "wall_time": float, "meta": {...}}
+
+``validate_record`` / ``read_jsonl`` are the single schema authority,
+shared by the report CLI and the tests.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Any
+
+SCHEMA_VERSION = 1
+RECORD_KINDS = ('meta', 'step', 'epoch')
+
+
+def to_float(x) -> float:
+    """Best-effort scalar coercion (device arrays, numbers, 'nan'/'inf'
+    strings); anything non-numeric degrades to NaN instead of raising.
+    Single point of truth shared with :mod:`health` and :mod:`report`.
+    """
+    try:
+        return float(x)
+    except (TypeError, ValueError):
+        return float('nan')
+
+
+def validate_record(rec: Any) -> None:
+    """Raise ValueError unless ``rec`` is a schema-valid record dict."""
+    if not isinstance(rec, dict):
+        raise ValueError(f'record is not an object: {type(rec).__name__}')
+    if rec.get('schema') != SCHEMA_VERSION:
+        raise ValueError(f'unknown schema version {rec.get("schema")!r} '
+                         f'(expected {SCHEMA_VERSION})')
+    kind = rec.get('kind')
+    if kind not in RECORD_KINDS:
+        raise ValueError(f'unknown record kind {kind!r}')
+    if not isinstance(rec.get('wall_time'), (int, float)):
+        raise ValueError('missing/invalid wall_time')
+    if kind == 'step' and not isinstance(rec.get('step'), int):
+        raise ValueError('step record missing integer step')
+    if kind == 'epoch' and not isinstance(rec.get('epoch'), int):
+        raise ValueError('epoch record missing integer epoch')
+    if kind in ('step', 'epoch'):
+        metrics = rec.get('metrics')
+        if not isinstance(metrics, dict):
+            raise ValueError(f'{kind} record missing metrics object')
+        for k, v in metrics.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                continue
+            if isinstance(v, str):
+                # Non-finite values ride as 'nan'/'inf'/'-inf' strings
+                # (JSON has no literals for them); float() round-trips.
+                try:
+                    float(v)
+                    continue
+                except ValueError:
+                    pass
+            raise ValueError(f'metric {k!r} is not a number: {v!r}')
+
+
+def _rotated_segments(path: str) -> list[str]:
+    """Existing rotated segments ``<path>.1 .. .N``, oldest first."""
+    out = []
+    n = 1
+    while os.path.exists(f'{path}.{n}'):
+        out.append(f'{path}.{n}')
+        n += 1
+    return out
+
+
+def read_jsonl(path: str, validate: bool = True) -> list[dict]:
+    """Load (and by default schema-validate) every record of a run.
+
+    Rotated segments ``<path>.1 .. .N`` are read first (oldest-first),
+    then the live file — one call reconstructs the full stream.
+    """
+    paths = _rotated_segments(path)
+    if os.path.exists(path):
+        paths.append(path)
+    if not paths:
+        raise FileNotFoundError(path)
+    records = []
+    for p in paths:
+        with open(p) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError as e:
+                    raise ValueError(f'{p}:{i + 1}: torn/invalid JSON '
+                                     f'line: {e}') from e
+                if validate:
+                    validate_record(rec)
+                records.append(rec)
+    return records
+
+
+class JsonlMetricsSink:
+    """Asynchronous JSONL writer for per-step K-FAC metrics.
+
+    Args:
+      path: target ``.jsonl`` file (parent dirs are created).
+      interval: keep every Nth step record (``metrics_interval``; epoch
+        and meta records are always kept).
+      process_index: this process's rank; non-zero ranks become no-op
+        sinks (safe to call unconditionally from SPMD code).
+      rotate_bytes: rotate the live segment past this size. Bounds both
+        segment size and the atomic-rewrite cost *per drain* (each
+        drain republishes the current segment — crash-durable at drain
+        granularity). None disables.
+      drain_every: drain-and-publish after this many enqueued records
+        (keeps host memory flat, bounds telemetry loss on a crash, and
+        sets the health monitor's reaction latency — all while staying
+        far behind the dispatch frontier).
+      monitor: optional :class:`observability.health.HealthMonitor`;
+        every drained record is fed to it (its action — warn / skip /
+        raise — fires at drain time, off the step path, and always
+        AFTER the drained records are persisted).
+      meta: optional run-config dict written once as the leading
+        ``kind='meta'`` record.
+    """
+
+    def __init__(self, path: str, *, interval: int = 1,
+                 process_index: int = 0,
+                 rotate_bytes: int | None = 4 * 1024 * 1024,
+                 drain_every: int = 64,
+                 monitor=None,
+                 meta: dict | None = None):
+        if interval < 1:
+            raise ValueError(f'{interval=} must be >= 1')
+        self.path = path
+        self.interval = interval
+        self.enabled = process_index == 0
+        self.rotate_bytes = rotate_bytes
+        self.drain_every = drain_every
+        self.monitor = monitor
+        self._pending: list[dict] = []    # records w/ device scalars
+        self._lines: list[str] = []       # serialized current segment
+        self._bytes = 0
+        self._segments = 0
+        self._step_seen = 0
+        if not self.enabled:
+            return
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        # A fresh sink owns its path: clear the previous run's live file
+        # and rotated segments, otherwise ``read_jsonl`` would stitch
+        # two runs' (individually schema-valid) records into one
+        # chimeric stream — e.g. on the CLIs' default <log-dir> path.
+        for stale in (path, *_rotated_segments(path)):
+            try:
+                os.unlink(stale)
+            except FileNotFoundError:
+                pass
+        if meta is not None:
+            self._pending.append({'schema': SCHEMA_VERSION,
+                                  'kind': 'meta',
+                                  'wall_time': time.time(),
+                                  'meta': dict(meta)})
+
+    # -- enqueue (step path: no syncs) ---------------------------------
+
+    def step_record(self, step: int, metrics: dict,
+                    host_step_ms: float | None = None) -> None:
+        """Enqueue one step's metrics (every ``interval``-th kept).
+
+        ``metrics`` values may be device scalars; an async copy to host
+        is kicked off here and the float conversion happens at drain.
+        """
+        self._step_seen += 1
+        if not self.enabled or (self._step_seen - 1) % self.interval:
+            return
+        rec = {'schema': SCHEMA_VERSION, 'kind': 'step',
+               'step': int(step), 'wall_time': time.time(),
+               'metrics': dict(metrics)}
+        if host_step_ms is not None:
+            rec['host_step_ms'] = float(host_step_ms)
+        for v in rec['metrics'].values():
+            copy_async = getattr(v, 'copy_to_host_async', None)
+            if copy_async is not None:
+                try:
+                    copy_async()
+                except Exception:
+                    pass
+        self._pending.append(rec)
+        if len(self._pending) >= self.drain_every:
+            # Full flush, not just an in-memory drain: a crash between
+            # drains must not lose the run's telemetry, and the health
+            # monitor must see records at drain cadence (not only at
+            # epoch end). The atomic segment rewrite is bounded by
+            # rotate_bytes.
+            self.flush()
+
+    def epoch_record(self, epoch: int, metrics: dict,
+                     trace: dict | None = None) -> None:
+        """Record epoch-level averages plus a host trace-table snapshot."""
+        if not self.enabled:
+            return
+        rec = {'schema': SCHEMA_VERSION, 'kind': 'epoch',
+               'epoch': int(epoch), 'wall_time': time.time(),
+               'metrics': dict(metrics)}
+        if trace:
+            rec['trace'] = trace
+        self._pending.append(rec)
+
+    # -- drain / write (off the step path) -----------------------------
+
+    def _drain(self) -> list[dict]:
+        """Serialize pending records into the current segment.
+
+        Pending is cleared up front and every record is serialized
+        before any monitor sees it — a raising health action can then
+        neither lose nor duplicate records (see the callers: the
+        segment is written before the exception propagates).
+        """
+        drained, self._pending = self._pending, []
+        for rec in drained:
+            if 'metrics' in rec:
+                cleaned = {}
+                for k, v in rec['metrics'].items():
+                    f = to_float(v)
+                    # JSON has no inf/nan literals; stringify so the
+                    # reader sees the signal instead of a parse error.
+                    cleaned[k] = f if math.isfinite(f) else repr(f)
+                rec['metrics'] = cleaned
+            self._lines.append(json.dumps(rec, sort_keys=True))
+        return drained
+
+    def _observe(self, drained: list[dict]) -> None:
+        if self.monitor is None:
+            return
+        for rec in drained:
+            self.monitor.observe(rec)
+
+    def _write_segment(self) -> None:
+        data = '\n'.join(self._lines) + ('\n' if self._lines else '')
+        tmp = f'{self.path}.tmp.{os.getpid()}'
+        with open(tmp, 'w') as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._bytes = len(data)
+
+    def flush(self) -> None:
+        """Drain pending records and atomically publish the segment.
+
+        The health monitor runs AFTER the write: an action='raise'
+        propagates with the full stream already on disk (the run that
+        dies on a health event needs its telemetry most).
+        """
+        if not self.enabled:
+            return
+        drained = self._drain()
+        self._write_segment()
+        if self.rotate_bytes and self._bytes >= self.rotate_bytes:
+            self._segments += 1
+            os.replace(self.path, f'{self.path}.{self._segments}')
+            self._lines = []
+            self._write_segment()
+        self._observe(drained)
+
+    def close(self) -> None:
+        self.flush()

@@ -1,0 +1,115 @@
+"""Host-side wall-clock tracing (the legacy ``utils.py`` trace table).
+
+Decorator-based wall-clock tracing for host-side phases and dispatched
+device work, folded into the observability subsystem in r7 (the
+``trace``/``get_trace``/``clear_trace`` names stay re-exported from
+``distributed_kfac_pytorch_tpu.utils`` for reference-parity callers).
+``sync=True`` calls ``jax.block_until_ready`` on the result (the XLA
+analogue of the reference's pre/post ``backend.barrier()`` — without it,
+timings measure async dispatch only).
+
+Reference bugs fixed (SURVEY.md §8): ``clear_trace`` actually clears
+(utils.py:11-12 rebinds a local) and ``get_trace`` has no undefined
+variable (utils.py:18-19 ``max_times``).
+
+This table is the host-visible *stage* attribution: phases a CLI or
+benchmark decorates (data loading, eval, checkpoint, whole-step
+dispatch). Stages *inside* the jitted step are attributed by the
+profiler scopes in :mod:`observability.profiling` instead, and the
+JSONL sink (:mod:`observability.sink`) snapshots this table into each
+epoch record so ``observability.report`` can print the breakdown
+offline.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Callable
+
+import jax
+
+_FUNC_TRACES: dict[str, list[float]] = {}
+
+
+def trace(sync: bool = False, name: str | None = None) -> Callable:
+    """Decorator appending each call's duration to the module trace table.
+
+    Args:
+      sync: block on the result (and on a dummy device sync before
+        starting) so the measurement covers device execution, not just
+        dispatch.
+      name: trace key (defaults to the function's __name__).
+    """
+    def decorator(fn):
+        key = name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if sync:
+                jax.block_until_ready(
+                    [a for a in args if isinstance(a, jax.Array)])
+            start = time.perf_counter()
+            out = fn(*args, **kwargs)
+            if sync:
+                jax.block_until_ready(out)
+            _FUNC_TRACES.setdefault(key, []).append(
+                time.perf_counter() - start)
+            return out
+
+        return wrapper
+
+    return decorator
+
+
+def get_trace(average: bool = True, max_history: int | None = None
+              ) -> dict[str, float]:
+    """Per-key mean (or total) duration in seconds.
+
+    ``max_history`` restricts to the most recent N samples.
+    """
+    out = {}
+    for key, times in _FUNC_TRACES.items():
+        window = times[-max_history:] if max_history else times
+        if not window:
+            continue
+        out[key] = (sum(window) / len(window)) if average else sum(window)
+    return out
+
+
+def print_trace(average: bool = True, max_history: int | None = None
+                ) -> None:
+    for key, val in sorted(get_trace(average, max_history).items()):
+        print(f'{key}: {val * 1000:.3f} ms')
+
+
+def clear_trace() -> None:
+    _FUNC_TRACES.clear()
+
+
+def record(key: str, seconds: float) -> None:
+    """Append one externally-measured duration to the trace table.
+
+    For callers that already hold a timing (e.g. the engine's per-step
+    dispatch measurement) — same table as the ``@trace`` decorator, so
+    the JSONL epoch snapshots and the report's stage table see both.
+    """
+    _FUNC_TRACES.setdefault(key, []).append(seconds)
+
+
+def snapshot_trace() -> dict[str, dict[str, float]]:
+    """``{key: {'mean_ms', 'total_ms', 'count'}}`` for JSONL records.
+
+    The sink embeds this into epoch records so the report CLI can
+    reconstruct the per-stage step-time breakdown offline without the
+    live process.
+    """
+    out = {}
+    for key, times in _FUNC_TRACES.items():
+        if not times:
+            continue
+        total = sum(times)
+        out[key] = {'mean_ms': total / len(times) * 1000.0,
+                    'total_ms': total * 1000.0,
+                    'count': len(times)}
+    return out

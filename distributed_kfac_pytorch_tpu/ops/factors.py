@@ -14,6 +14,8 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 
+from distributed_kfac_pytorch_tpu.observability import profiling
+
 
 def append_bias_ones(x: jax.Array) -> jax.Array:
     """Append a column of ones to the last dim (homogeneous coordinates).
@@ -139,6 +141,7 @@ def _assemble_bias_factor(cov: jax.Array, bias_col: jax.Array,
     return padded + jnp.outer(onehot, b_ext) + jnp.outer(b_ext, onehot)
 
 
+@profiling.scope('kfac/factors/linear_a')
 def linear_a_factor(a: jax.Array, has_bias: bool,
                     compute_dtype=None) -> jax.Array:
     """A = cov(inputs (+ ones column)) for a dense layer.
@@ -156,6 +159,7 @@ def linear_a_factor(a: jax.Array, has_bias: bool,
     return _assemble_bias_factor(cov, bias_col, 1.0)
 
 
+@profiling.scope('kfac/factors/linear_g')
 def linear_g_factor(g: jax.Array, compute_dtype=None) -> jax.Array:
     """G = cov(grad wrt layer outputs) for a dense layer.
 
@@ -415,6 +419,7 @@ def _conv_a_cov_crosscov(a: jax.Array, kernel_size, strides, padding,
     return (gram + gram.T) * 0.5
 
 
+@profiling.scope('kfac/factors/conv2d_a')
 def conv2d_a_factor(a: jax.Array, kernel_size, strides, padding,
                     has_bias: bool, compute_dtype=None) -> jax.Array:
     """A factor for conv2d from NHWC inputs via im2col patches.
@@ -591,6 +596,7 @@ def conv2d_a_factor(a: jax.Array, kernel_size, strides, padding,
     return _assemble_bias_factor(cov, bias_col, 1.0 / (spatial * spatial))
 
 
+@profiling.scope('kfac/factors/conv2d_grouped_a')
 def conv2d_grouped_a_factor(a: jax.Array, kernel_size, strides, padding,
                             groups: int, has_bias: bool,
                             compute_dtype=None) -> jax.Array:
@@ -651,6 +657,7 @@ def conv2d_grouped_a_factor(a: jax.Array, kernel_size, strides, padding,
         cov, bias_cols.astype(cov.dtype))
 
 
+@profiling.scope('kfac/factors/conv2d_grouped_g')
 def conv2d_grouped_g_factor(g: jax.Array, groups: int,
                             compute_dtype=None) -> jax.Array:
     """Per-group G factors from NHWC output grads: (G, dg, dg).
@@ -678,6 +685,7 @@ def conv2d_grouped_g_factor(g: jax.Array, groups: int,
         0.5 / (rows * spatial * spatial))
 
 
+@profiling.scope('kfac/factors/conv2d_g')
 def conv2d_g_factor(g: jax.Array, compute_dtype=None) -> jax.Array:
     """G factor for conv2d from NHWC output grads.
 
@@ -691,6 +699,7 @@ def conv2d_g_factor(g: jax.Array, compute_dtype=None) -> jax.Array:
                    compute_dtype=compute_dtype)
 
 
+@profiling.scope('kfac/factors/embedding_a')
 def embedding_a_factor(ids: jax.Array, vocab_size: int) -> jax.Array:
     """Diagonal A factor for an embedding layer: mean one-hot frequency.
 

@@ -18,6 +18,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from distributed_kfac_pytorch_tpu.observability import profiling
+
 
 def get_eigendecomp(x: jax.Array, clip: float | None = 0.0
                     ) -> tuple[jax.Array, jax.Array]:
@@ -300,25 +302,29 @@ def batched_eigh(stack: jax.Array, method: str = 'xla',
     if method == 'warm':
         if q_prev is None:
             raise ValueError("eigh method 'warm' requires q_prev")
-        qs, ds = jax.vmap(
-            lambda m, q0: eigh_polish(m, q0, iters=polish_iters))(
-                stack, q_prev)
-        if clip is not None:
-            ds = jnp.maximum(ds, clip)
-        return qs, ds
+        with profiling.annotate('kfac/eigh/warm'):
+            qs, ds = jax.vmap(
+                lambda m, q0: eigh_polish(m, q0, iters=polish_iters))(
+                    stack, q_prev)
+            if clip is not None:
+                ds = jnp.maximum(ds, clip)
+            return qs, ds
     if method == 'jacobi':
         from distributed_kfac_pytorch_tpu.ops import pallas_kernels
-        qs, ds = pallas_kernels.batched_jacobi_eigh(stack, sweeps)
-        if clip is not None:
-            ds = jnp.maximum(ds, clip)
-        return qs, ds
+        with profiling.annotate('kfac/eigh/jacobi'):
+            qs, ds = pallas_kernels.batched_jacobi_eigh(stack, sweeps)
+            if clip is not None:
+                ds = jnp.maximum(ds, clip)
+            return qs, ds
     if method != 'xla':
         raise ValueError(
             "eigh method must be 'auto', 'xla', 'jacobi' or 'warm', "
             f'got {method!r}')
-    return jax.vmap(lambda m: get_eigendecomp(m, clip=clip))(stack)
+    with profiling.annotate('kfac/eigh/xla'):
+        return jax.vmap(lambda m: get_eigendecomp(m, clip=clip))(stack)
 
 
+@profiling.scope('kfac/inverse/cholesky')
 def get_inverse(x: jax.Array, damping: float | jax.Array | None = None
                 ) -> jax.Array:
     """Damped SPD inverse via Cholesky: ``(x + damping*I)^-1`` in fp32.
@@ -336,6 +342,7 @@ def get_inverse(x: jax.Array, damping: float | jax.Array | None = None
     return inv_l.T @ inv_l
 
 
+@profiling.scope('kfac/inverse/newton')
 def newton_schulz_inverse(x: jax.Array,
                           damping: float | jax.Array | None = None,
                           iters: int = 100,
@@ -415,6 +422,7 @@ def _precond_mm(compute_dtype):
     return cdt, mm
 
 
+@profiling.scope('kfac/precond/eigen')
 def precondition_eigen(grad: jax.Array, qa: jax.Array, qg: jax.Array,
                        da: jax.Array, dg: jax.Array,
                        damping: float | jax.Array,
@@ -445,6 +453,7 @@ def precondition_eigen(grad: jax.Array, qa: jax.Array, qg: jax.Array,
     return mm(qg, mm(v2, qa.T))
 
 
+@profiling.scope('kfac/precond/inv')
 def precondition_inv(grad: jax.Array, a_inv: jax.Array,
                      g_inv: jax.Array, compute_dtype=None) -> jax.Array:
     """Inverse-method preconditioning: ``G_inv @ grad @ A_inv``.
@@ -463,6 +472,7 @@ def precondition_inv(grad: jax.Array, a_inv: jax.Array,
                                     a_inv.astype(cdt)))
 
 
+@profiling.scope('kfac/precond/diag_a')
 def precondition_diag_a(grad: jax.Array, a_inv_diag: jax.Array,
                         g_inv: jax.Array, compute_dtype=None) -> jax.Array:
     """Preconditioning with a diagonal A inverse (embedding layers).
@@ -534,16 +544,17 @@ def precondition_dispatch(grad: jax.Array, entry: dict,
         if 'G_inv' in entry:
             return precondition_diag_a(grad, diag_a, entry['G_inv'],
                                        compute_dtype=compute_dtype)
-        if compute_dtype is None:
-            v1 = grad.astype(jnp.float32) @ entry['QG']
-            v2 = v1 / (entry['dG'][None, :] + damping)
-            return diag_a[:, None] * (v2 @ entry['QG'].T)
-        cdt, mm = _precond_mm(compute_dtype)
-        qg = entry['QG'].astype(cdt)
-        v1 = mm(grad.astype(cdt), qg)
-        v2 = v1 / (entry['dG'].astype(jnp.float32)[None, :] + damping)
-        return diag_a.astype(jnp.float32)[:, None] * mm(
-            v2.astype(cdt), qg.T)
+        with profiling.annotate('kfac/precond/diag_a_eigen'):
+            if compute_dtype is None:
+                v1 = grad.astype(jnp.float32) @ entry['QG']
+                v2 = v1 / (entry['dG'][None, :] + damping)
+                return diag_a[:, None] * (v2 @ entry['QG'].T)
+            cdt, mm = _precond_mm(compute_dtype)
+            qg = entry['QG'].astype(cdt)
+            v1 = mm(grad.astype(cdt), qg)
+            v2 = v1 / (entry['dG'].astype(jnp.float32)[None, :] + damping)
+            return diag_a.astype(jnp.float32)[:, None] * mm(
+                v2.astype(cdt), qg.T)
     a_baked = 'A_inv' in entry
     g_baked = 'G_inv' in entry
     if not a_baked and not g_baked:

@@ -56,6 +56,10 @@ from distributed_kfac_pytorch_tpu import layers as L
 from distributed_kfac_pytorch_tpu.capture import (CONV2D_GROUPED,
                                                   EMBEDDING,
                                                   subsample_captures)
+from distributed_kfac_pytorch_tpu.observability import (
+    metrics as obs_metrics,
+)
+from distributed_kfac_pytorch_tpu.observability import profiling
 from distributed_kfac_pytorch_tpu.ops import factors as F
 from distributed_kfac_pytorch_tpu.ops import linalg
 from distributed_kfac_pytorch_tpu.ops import pallas_kernels
@@ -66,6 +70,7 @@ from distributed_kfac_pytorch_tpu.preconditioner import (
     CommMethod,
     cadence_gate,
     grouped_block_inverses,
+    guard_nonfinite_factors,
     q_stack_degenerate,
     resolve_eigh_method,
 )
@@ -450,9 +455,15 @@ class DistributedKFAC:
         # single-chip init already builds the right zero shapes).
         grouped_inv = {name: base['inverses'][name]
                        for name in self.assignment.grouped_layers}
-        return {'step': base['step'], 'factors': base['factors'],
-                'inv_stacks': stacks, 'diag_inv': diag_inv,
-                'grouped_inv': grouped_inv}
+        state = {'step': base['step'], 'factors': base['factors'],
+                 'inv_stacks': stacks, 'diag_inv': diag_inv,
+                 'grouped_inv': grouped_inv}
+        if self.kfac.collect_metrics:
+            # Replicated on-device metrics scalars (the single-chip
+            # slot; state_pspecs' default P() covers them).
+            state['metrics'] = obs_metrics.init_metrics(
+                self.kfac.metric_bucket_keys(params))
+        return state
 
     def state_pspecs(self, state: dict) -> dict:
         """PartitionSpecs for a state pytree: stacks row-sharded, rest
@@ -487,6 +498,7 @@ class DistributedKFAC:
                                                compute_dtype=cdt)}
                 for name, spec in self.kfac.specs.items()}
 
+    @profiling.scope('kfac/factors')
     def _spmd_update_factors(self, state, contribs, factor_decay):
         """Local covariance contributions, ``pmean``ed over the mesh.
 
@@ -515,11 +527,12 @@ class DistributedKFAC:
             mask/concat pack+unpack (ops.factors.pack_symmetric).
             Embedding A factors are 1-D (already minimal).
             """
-            if kfac.symmetry_aware_comm and m.ndim == 2:
-                packed = jax.lax.pmean(F.pack_symmetric(m),
-                                       self.data_axes)
-                return F.unpack_symmetric(packed, m.shape[-1])
-            return jax.lax.pmean(m, self.data_axes)
+            with profiling.annotate('kfac/comm/factor_allreduce'):
+                if kfac.symmetry_aware_comm and m.ndim == 2:
+                    packed = jax.lax.pmean(F.pack_symmetric(m),
+                                           self.data_axes)
+                    return F.unpack_symmetric(packed, m.shape[-1])
+                return jax.lax.pmean(m, self.data_axes)
 
         new_factors = {}
         for name in kfac.specs:
@@ -547,6 +560,7 @@ class DistributedKFAC:
         eye = jnp.eye(plan.dim, dtype=jnp.float32)
         return jnp.stack([eye if m is None else m for m in mats])
 
+    @profiling.scope('kfac/inverses')
     def _spmd_update_inverses(self, factors, damping, prev_stacks=None):
         """Sharded batched inverse computation + in-group all_gather.
 
@@ -598,13 +612,16 @@ class DistributedKFAC:
                     inv = jax.vmap(
                         lambda qi, di: linalg.eigen_side_inverse(
                             qi, di, damping))(q, d)
-                    entry['inv'] = jax.lax.all_gather(
-                        inv, GRAD_WORKER_AXIS,
-                        tiled=True).astype(kfac.inv_dtype)
-                q = jax.lax.all_gather(
-                    q, GRAD_WORKER_AXIS, tiled=True)
-                d = jax.lax.all_gather(
-                    d, GRAD_WORKER_AXIS, tiled=True)
+                    with profiling.annotate(
+                            'kfac/comm/inverse_allgather'):
+                        entry['inv'] = jax.lax.all_gather(
+                            inv, GRAD_WORKER_AXIS,
+                            tiled=True).astype(kfac.inv_dtype)
+                with profiling.annotate('kfac/comm/inverse_allgather'):
+                    q = jax.lax.all_gather(
+                        q, GRAD_WORKER_AXIS, tiled=True)
+                    d = jax.lax.all_gather(
+                        d, GRAD_WORKER_AXIS, tiled=True)
                 stacks[str(dim)] = {'Q': q.astype(kfac.inv_dtype),
                                     'd': d.astype(kfac.inv_dtype),
                                     **entry}
@@ -612,8 +629,9 @@ class DistributedKFAC:
                 inv = pallas_kernels.damped_inverse_stack(
                     local, damping, bucket_method,
                     iters=kfac.newton_iters)
-                inv = jax.lax.all_gather(
-                    inv, GRAD_WORKER_AXIS, tiled=True)
+                with profiling.annotate('kfac/comm/inverse_allgather'):
+                    inv = jax.lax.all_gather(
+                        inv, GRAD_WORKER_AXIS, tiled=True)
                 stacks[str(dim)] = {'inv': inv.astype(kfac.inv_dtype)}
         diag_inv = {}
         for name in self.assignment.diag_layers:
@@ -735,8 +753,9 @@ class DistributedKFAC:
                 out[name] = vs[gslot % s] * mask
         return out
 
+    @profiling.scope('kfac/precond')
     def _spmd_precondition(self, inv_stacks, diag_inv, grouped_inv,
-                           grads, damping, lr):
+                           grads, damping, lr, with_stats: bool = False):
         """Row-masked preconditioning + one ``psum`` gradient broadcast.
 
         Every member of a layer's inverse group computes its preconditioned
@@ -791,14 +810,20 @@ class DistributedKFAC:
                 vg_sum += jnp.sum(precond_mats[name] *
                                   grad_mats[name].astype(jnp.float32)
                                   * lr ** 2)
-            vg_sum = jax.lax.psum(vg_sum, INV_GROUP_AXIS)
+            with profiling.annotate('kfac/comm/klclip_psum'):
+                vg_sum = jax.lax.psum(vg_sum, INV_GROUP_AXIS)
             nu = jnp.minimum(
                 1.0, jnp.sqrt(kfac.kl_clip / (jnp.abs(vg_sum) + 1e-30)))
         else:
             nu = jnp.ones((), jnp.float32)
 
-        precond_mats = jax.lax.psum(precond_mats, INV_GROUP_AXIS)
+        with profiling.annotate('kfac/comm/grad_psum'):
+            precond_mats = jax.lax.psum(precond_mats, INV_GROUP_AXIS)
 
+        # Stats AFTER the delivery psum: every device sees the full
+        # preconditioned matrices, so the norms are replicated scalars.
+        stats = (obs_metrics.precond_stats(grad_mats, precond_mats, nu)
+                 if with_stats else None)
         out = jax.tree.map(lambda x: x, grads)
         for name, spec in kfac.specs.items():
             sub = _get(grads, spec.path)
@@ -806,7 +831,7 @@ class DistributedKFAC:
                 spec, (nu * precond_mats[name]).astype(jnp.float32), sub)
             out = _set(out, spec.path, jax.tree.map(
                 lambda n, o: n.astype(o.dtype), new_sub, sub))
-        return out
+        return (out, stats) if with_stats else out
 
     # -- the step -------------------------------------------------------
 
@@ -857,8 +882,24 @@ class DistributedKFAC:
                  else self.local_factor_contribs(captures)),
                 factor_decay)
 
-        factors = cadence_gate(factor_update, step, f_freq, do_factors,
-                               lambda: state['factors'])
+        track = kfac.collect_metrics or kfac.nonfinite_guard
+        if track:
+            # Tracked form: finiteness of the candidate factors rides
+            # out of the gate (guard skip + metrics count); semantics
+            # shared with the single-chip step via
+            # preconditioner.guard_nonfinite_factors.
+            def do_factors_tracked():
+                return guard_nonfinite_factors(
+                    do_factors(), state['factors'],
+                    kfac.nonfinite_guard)
+
+            factors, finite_f = cadence_gate(
+                factor_update, step, f_freq, do_factors_tracked,
+                lambda: (state['factors'], jnp.ones((), jnp.int32)))
+        else:
+            # Metrics/guard off: the historical program, untouched.
+            factors = cadence_gate(factor_update, step, f_freq,
+                                   do_factors, lambda: state['factors'])
         inv_stacks, diag_inv, grouped_inv = cadence_gate(
             inv_update, step, i_freq,
             lambda: self._spmd_update_inverses(
@@ -866,11 +907,35 @@ class DistributedKFAC:
             lambda: (state['inv_stacks'], state['diag_inv'],
                      state.get('grouped_inv', {})))
 
-        precond = self._spmd_precondition(inv_stacks, diag_inv,
-                                          grouped_inv, grads, damping, lr)
+        if not kfac.collect_metrics:
+            precond = self._spmd_precondition(
+                inv_stacks, diag_inv, grouped_inv, grads, damping, lr)
+            new_state = {'step': step + 1, 'factors': factors,
+                         'inv_stacks': inv_stacks, 'diag_inv': diag_inv,
+                         'grouped_inv': grouped_inv}
+            return precond, new_state
+
+        precond, stats = self._spmd_precondition(
+            inv_stacks, diag_inv, grouped_inv, grads, damping, lr,
+            with_stats=True)
+        one = lambda: jnp.ones((), jnp.int32)
+        zero = lambda: jnp.zeros((), jnp.int32)
+        did_f = cadence_gate(factor_update, step, f_freq, one, zero)
+        did_i = cadence_gate(inv_update, step, i_freq, one, zero)
+        # Row-local clip counts summed over inverse groups: each row's
+        # stacks hold only its own layers' spectra (columns agree after
+        # the in-group all_gather), so one psum yields the global count.
+        eig_clipped = jax.lax.psum(
+            obs_metrics.count_clipped_eigvals_stacks(inv_stacks),
+            INV_GROUP_AXIS)
         new_state = {'step': step + 1, 'factors': factors,
                      'inv_stacks': inv_stacks, 'diag_inv': diag_inv,
-                     'grouped_inv': grouped_inv}
+                     'grouped_inv': grouped_inv,
+                     'metrics': obs_metrics.update_metrics(
+                         state['metrics'], damping=damping, stats=stats,
+                         did_factor=did_f, did_inv=did_i,
+                         factor_finite=finite_f,
+                         eig_clipped=eig_clipped)}
         return precond, new_state
 
     # -- checkpointing --------------------------------------------------
@@ -1259,6 +1324,14 @@ class DistributedKFAC:
                 if updated:
                     extra_vars = {**extra_vars,
                                   **jax.lax.pmean(updated, self.data_axes)}
+                if self.kfac.collect_metrics:
+                    # Expose the on-device K-FAC metrics in the step's
+                    # metrics dict (replicated scalars — flows through
+                    # the P() out-spec): the engine's sink drains these
+                    # asynchronously, and the epoch meters average them
+                    # like any other metric.
+                    metrics = {**metrics, **obs_metrics.flatten_metrics(
+                        new_kstate['metrics'])}
                 return (new_params, new_opt_state, new_kstate, extra_vars,
                         metrics)
             return local_step

@@ -39,7 +39,12 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from distributed_kfac_pytorch_tpu import fp16 as fp16_ops
 from distributed_kfac_pytorch_tpu import layers as L
+from distributed_kfac_pytorch_tpu.observability import (
+    metrics as obs_metrics,
+)
+from distributed_kfac_pytorch_tpu.observability import profiling
 from distributed_kfac_pytorch_tpu.capture import (CONV2D_GROUPED, EMBEDDING,
                                                   KFACCapture,
                                                   subsample_captures)
@@ -225,6 +230,28 @@ class KFAC:
         LPT work balancer (reference preconditioner.py:625-628).
       comm_method / grad_worker_fraction: see CommMethod; consumed by the
         distributed step builder in ``parallel.distributed``.
+      collect_metrics: carry an on-device metrics pytree in the state
+        (``state['metrics']``, see observability.metrics) updated by
+        the step — damping, KL-clip ν, grad/preconditioned-grad norms,
+        per-bucket precondition norms, factor/inverse firing counts,
+        eigenvalue-floor clips, non-finite events. All traced scalar
+        updates: no host syncs; the host drains asynchronously (the
+        engine's JSONL sink). Default False is bit-identical to the
+        pre-observability step — the same discipline as
+        ``precond_compute_dtype=None`` (test-pinned).
+      nonfinite_guard: skip the factor EWMA update when the candidate
+        factors are non-finite (a NaN/Inf gradient/capture batch would
+        otherwise poison the running averages forever — EWMA keeps
+        NaN). The skip is on-device (``where`` on a finiteness flag,
+        collective-safe: it checks the post-average candidates) and
+        counted in ``metrics['nonfinite_skips']`` when metrics are on.
+        Scope: this protects the FACTOR STATISTICS only — the same
+        step's gradients still flow through precondition and whatever
+        optimizer update the caller applies. For a whole-step skip of
+        params/optimizer on non-finite gradients, use the dynamic
+        loss-scale path (``build_train_step(loss_scale='dynamic')`` —
+        GradScaler parity), which composes with this guard.
+        Default False = reference behavior (no guard).
     """
 
     def __init__(self, model: nn.Module, *,
@@ -254,6 +281,8 @@ class KFAC:
                  assignment_strategy: str = 'compute',
                  comm_method: CommMethod = CommMethod.COMM_OPT,
                  grad_worker_fraction: float = 0.25,
+                 collect_metrics: bool = False,
+                 nonfinite_guard: bool = False,
                  verbose: bool = False):
         if factor_update_freq < 1 or inv_update_freq < 1:
             raise ValueError('update frequencies must be >= 1')
@@ -328,6 +357,8 @@ class KFAC:
         self.assignment_strategy = assignment_strategy
         self.comm_method = comm_method
         self.grad_worker_fraction = grad_worker_fraction
+        self.collect_metrics = collect_metrics
+        self.nonfinite_guard = nonfinite_guard
         self.verbose = verbose
         self._specs: dict[str, Any] | None = None
 
@@ -343,7 +374,8 @@ class KFAC:
                   'precond_compute_dtype', 'precond_bucketing',
                   'symmetry_aware_comm',
                   'assignment_strategy', 'comm_method',
-                  'grad_worker_fraction')
+                  'grad_worker_fraction', 'collect_metrics',
+                  'nonfinite_guard')
         lines = [f'  {name}: {getattr(self, name)!r}' for name in fields]
         n_layers = (len(self._specs) if self._specs is not None
                     else '<uninitialized>')
@@ -475,8 +507,39 @@ class KFAC:
             else:
                 entry['G_inv'] = jnp.zeros((g_dim, g_dim), idt)
             inverses[name] = entry
-        return {'step': jnp.zeros((), jnp.int32),
-                'factors': factors, 'inverses': inverses}
+        state = {'step': jnp.zeros((), jnp.int32),
+                 'factors': factors, 'inverses': inverses}
+        if self.collect_metrics:
+            state['metrics'] = obs_metrics.init_metrics(
+                self.metric_bucket_keys(params))
+        return state
+
+    def metric_bucket_keys(self, params) -> list[str]:
+        """Precondition shape-bucket keys for the metrics pytree.
+
+        Derived by ``eval_shape`` over the same ``grads_to_matrix``
+        transform the precondition pass runs, so the keys in the state
+        structure match the runtime grouping exactly (one source of
+        shape truth; trace-static).
+        """
+        keys: list[str] = []
+        for name, spec in self.specs.items():
+            sh = jax.eval_shape(
+                lambda p, s=spec: L.grads_to_matrix(s, p),
+                _get(params, spec.path)).shape
+            key = obs_metrics.shape_key(sh)
+            if key not in keys:
+                keys.append(key)
+        return keys
+
+    def _tracked_factor_update(self, state: dict, captures: dict,
+                               factor_decay) -> tuple[dict, jax.Array]:
+        """Factor update + finiteness flag (metrics/guard path); the
+        guard semantics live in :func:`guard_nonfinite_factors` (shared
+        with the SPMD step)."""
+        return guard_nonfinite_factors(
+            self.update_factors(state, captures, factor_decay),
+            state['factors'], self.nonfinite_guard)
 
     # NOTE: worker assignment (the reference's one-time deferred
     # _assign_workers, preconditioner.py:616-659) lives in
@@ -488,6 +551,7 @@ class KFAC:
     # The pipeline stages (pure; called under jit)
     # ------------------------------------------------------------------
 
+    @profiling.scope('kfac/factors')
     def update_factors(self, state: dict, captures: dict,
                        factor_decay=None) -> dict:
         """EWMA-update all factor running averages from captures.
@@ -560,6 +624,7 @@ class KFAC:
                 out[n] = invs[i]
         return out
 
+    @profiling.scope('kfac/inverses')
     def update_inverses(self, state: dict, damping, *,
                         warm: bool = True) -> dict:
         """Recompute inverses/eigendecompositions from current factors.
@@ -641,8 +706,10 @@ class KFAC:
             new_inv[name] = entry
         return new_inv
 
+    @profiling.scope('kfac/precond')
     def precondition(self, state: dict, grads: dict, damping, lr,
-                     layer_filter: Sequence[str] | None = None) -> dict:
+                     layer_filter: Sequence[str] | None = None,
+                     with_stats: bool = False):
         """Precondition registered layers' grads; KL-clip scale on-device.
 
         Reference: compute_preconditioned_gradients + _compute_grad_scale +
@@ -664,6 +731,12 @@ class KFAC:
         (tests/test_mixed_precision.py); ``precond_bucketing=False``
         restores the per-layer loop exactly if a backend's batched
         kernel ever tiles differently.
+
+        ``with_stats=True`` additionally returns
+        ``(out, observability.metrics.precond_stats(...))`` — ν, grad /
+        preconditioned-grad norms and per-shape-bucket norms, all traced
+        scalars (the metrics path; default False is the historical
+        single-value return).
         """
         names = list(self.specs) if layer_filter is None else list(
             layer_filter)
@@ -707,6 +780,8 @@ class KFAC:
         else:
             nu = jnp.ones((), jnp.float32)
 
+        stats = (obs_metrics.precond_stats(grad_mats, precond_mats, nu)
+                 if with_stats else None)
         out = jax.tree.map(lambda x: x, grads)  # copy structure
         for name in names:
             spec = self.specs[name]
@@ -715,7 +790,7 @@ class KFAC:
                 spec, (nu * precond_mats[name]).astype(jnp.float32), sub)
             out = _set(out, spec.path, jax.tree.map(
                 lambda n, o: n.astype(o.dtype), new_sub, sub))
-        return out
+        return (out, stats) if with_stats else out
 
     def _bucketed_precond_mats(self, inverses: dict, grad_mats: dict,
                                damping, names: Sequence[str]):
@@ -789,10 +864,22 @@ class KFAC:
                   else inv_update_freq)
         step = state['step']
 
-        factors = cadence_gate(
-            factor_update, step, f_freq,
-            lambda: self.update_factors(state, captures, factor_decay),
-            lambda: state['factors'])
+        track = self.collect_metrics or self.nonfinite_guard
+        if track:
+            # Tracked form: the factor branch additionally yields the
+            # candidate factors' finiteness flag (guard + metrics).
+            factors, finite_f = cadence_gate(
+                factor_update, step, f_freq,
+                lambda: self._tracked_factor_update(state, captures,
+                                                    factor_decay),
+                lambda: (state['factors'], jnp.ones((), jnp.int32)))
+        else:
+            # Metrics/guard off: the historical program, untouched
+            # (bit-identity pinned by tests/test_observability.py).
+            factors = cadence_gate(
+                factor_update, step, f_freq,
+                lambda: self.update_factors(state, captures, factor_decay),
+                lambda: state['factors'])
         state_f = {**state, 'factors': factors}
 
         inverses = cadence_gate(
@@ -801,8 +888,24 @@ class KFAC:
             lambda: state['inverses'])
         state_i = {**state_f, 'inverses': inverses}
 
-        precond = self.precondition(state_i, grads, damping, lr)
-        new_state = {**state_i, 'step': step + 1}
+        if not self.collect_metrics:
+            precond = self.precondition(state_i, grads, damping, lr)
+            new_state = {**state_i, 'step': step + 1}
+            return precond, new_state
+
+        precond, stats = self.precondition(state_i, grads, damping, lr,
+                                           with_stats=True)
+        one = lambda: jnp.ones((), jnp.int32)
+        zero = lambda: jnp.zeros((), jnp.int32)
+        did_f = cadence_gate(factor_update, step, f_freq, one, zero)
+        did_i = cadence_gate(inv_update, step, i_freq, one, zero)
+        new_state = {**state_i, 'step': step + 1,
+                     'metrics': obs_metrics.update_metrics(
+                         state['metrics'], damping=damping, stats=stats,
+                         did_factor=did_f, did_inv=did_i,
+                         factor_finite=finite_f,
+                         eig_clipped=obs_metrics.count_clipped_eigvals(
+                             inverses))}
         return precond, new_state
 
     # ------------------------------------------------------------------
@@ -860,6 +963,29 @@ class KFAC:
                      'inverses': self.update_inverses(state, self.damping,
                                                       warm=False)}
         return state
+
+
+def guard_nonfinite_factors(new_factors: dict, old_factors: dict,
+                            guard: bool) -> tuple[dict, jax.Array]:
+    """``(factors, finite 0/1)`` — the non-finite factor-guard
+    transition, single point of truth for the single-chip and SPMD
+    steps (they must not drift).
+
+    Finiteness is checked on the *candidate* post-average factors —
+    collective-safe under SPMD (every device sees the same averaged
+    values, so the skip cannot diverge across the mesh) and it catches
+    NaN *and* Inf contamination from any capture batch. With ``guard``
+    a non-finite candidate keeps the previous factors (reference
+    GradScaler spirit, engine.py:75-80, extended to the factor
+    statistics the reference leaves unprotected); without, the flag is
+    detection-only (the metrics path).
+    """
+    finite = fp16_ops.tree_all_finite(new_factors)
+    if guard:
+        new_factors = jax.tree.map(
+            lambda n, o: jnp.where(finite, n, o),
+            new_factors, old_factors)
+    return new_factors, finite.astype(jnp.int32)
 
 
 def grouped_block_inverses(factors: dict, damping, inv_dtype) -> dict:

@@ -72,7 +72,14 @@ def get_cifar(data_dir: str | None = None, synthetic_size: int = 2048):
 
     Reads pickle batches from ``data_dir`` or the standard search paths;
     falls back to a synthetic set (``synthetic_size`` train / 1/4 test).
+    ``KFAC_SYNTHETIC_CIFAR`` overrides the synthetic size from the
+    environment — smoke tooling (e.g. the observability CI smoke) can
+    bound a CLI run's data volume without a flag-surface change; real
+    data directories are unaffected.
     """
+    env_size = os.environ.get('KFAC_SYNTHETIC_CIFAR')
+    if env_size:
+        synthetic_size = int(env_size)
     roots = [data_dir] if data_dir else []
     roots += list(CIFAR_SEARCH_PATHS)
     for root in roots:

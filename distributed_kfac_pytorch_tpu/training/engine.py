@@ -16,6 +16,7 @@ from typing import Any, Callable, Iterable
 import jax
 import jax.numpy as jnp
 
+from distributed_kfac_pytorch_tpu.observability import tracing
 from distributed_kfac_pytorch_tpu.parallel.distributed import KFAC_AXES
 from distributed_kfac_pytorch_tpu.training.utils import Metric, accuracy
 
@@ -38,8 +39,8 @@ class TrainState:
 def train_epoch(step_fn, state: TrainState, batches: Iterable,
                 hyper: dict, *, log_writer=None, verbose: bool = False,
                 epoch_len: int | None = None,
-                static_cadence: tuple[int, int] | str | None = 'auto'
-                ) -> dict[str, float]:
+                static_cadence: tuple[int, int] | str | None = 'auto',
+                metrics_sink=None) -> dict[str, float]:
     """One training epoch; returns averaged metrics.
 
     ``hyper`` holds this epoch's dynamic hyperparameters ('lr', 'damping',
@@ -58,6 +59,14 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
     The default ``'auto'`` uses the freqs in ``hyper`` when ``step_fn``
     accepts the flags (i.e. is a K-FAC step) and falls back to dynamic
     otherwise (e.g. the SGD baseline step).
+
+    ``metrics_sink``: an ``observability.sink.JsonlMetricsSink`` (or
+    None). Per-step metrics (including the on-device K-FAC telemetry
+    when ``collect_metrics`` is on) are *enqueued* each step — device
+    scalars, no sync — plus the host dispatch time; an epoch record with
+    the averaged metrics and a host trace-table snapshot is appended and
+    the sink flushed at epoch end (the only point the host blocks on
+    metric values, where it already blocks for the epoch summary).
     """
     if static_cadence == 'auto':
         import inspect
@@ -108,9 +117,20 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
                      'inv_update': state.step % int(i_freq) == 0}
         else:
             flags = {}
+        t_it = time.perf_counter()
         (state.params, state.opt_state, state.kfac_state, state.extra_vars,
          metrics) = step_fn(state.params, state.opt_state, state.kfac_state,
                             state.extra_vars, batch, hyper, **flags)
+        if metrics_sink is not None:
+            # Enqueue only (device scalars + async host copy): the sink
+            # converts to floats at drain time, far behind dispatch.
+            dt = time.perf_counter() - t_it
+            metrics_sink.step_record(state.step, metrics,
+                                     host_step_ms=dt * 1000.0)
+            # Feed the dispatch timing into the host trace table too,
+            # so epoch snapshots (and the report's stage table) carry a
+            # per-stage row even when no phase is @trace-decorated.
+            tracing.record('train_step_dispatch', dt)
         state.step += 1
         n_batches += 1
         for k, v in metrics.items():
@@ -125,6 +145,10 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
     out = {k: m.avg for k, m in meters.items()}
     out['time_s'] = elapsed
     out['ms_per_iter'] = elapsed / max(n_batches, 1) * 1000.0
+    if metrics_sink is not None:
+        metrics_sink.epoch_record(state.epoch, out,
+                                  trace=tracing.snapshot_trace())
+        metrics_sink.flush()
     if log_writer is not None:
         for k, v in out.items():
             log_writer.scalar(f'train/{k}', v, state.epoch)

@@ -82,6 +82,13 @@ class OptimConfig:
     # bf16_inverses the stored inverses are consumed resident (no fp32
     # upcast-on-read). Default False = the bit-identical fp32 path.
     bf16_precond: bool = False
+    # r7 observability: carry an on-device K-FAC metrics pytree in the
+    # state (damping, KL-clip nu, grad/precond norms, firing counts —
+    # see observability.metrics). Off (default) = bit-identical step.
+    kfac_metrics: bool = False
+    # Skip factor EWMA updates whose candidate factors are non-finite
+    # (the on-device health guard; counted in metrics when they are on).
+    nonfinite_guard: bool = False
     skip_layers: Sequence[str] = ()
     symmetry_aware_comm: bool = False
     comm_method: str = 'comm-opt'
@@ -180,7 +187,9 @@ def get_optimizer(model, cfg: OptimConfig):
             skip_layers=list(cfg.skip_layers) or None,
             symmetry_aware_comm=cfg.symmetry_aware_comm,
             comm_method=COMM_METHODS[cfg.comm_method.lower()],
-            grad_worker_fraction=cfg.grad_worker_fraction)
+            grad_worker_fraction=cfg.grad_worker_fraction,
+            collect_metrics=cfg.kfac_metrics,
+            nonfinite_guard=cfg.nonfinite_guard)
         kfac_scheduler = KFACParamScheduler(
             kfac,
             damping_alpha=cfg.damping_alpha,

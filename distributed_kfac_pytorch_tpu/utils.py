@@ -1,79 +1,27 @@
 """Tracing / timing utilities (reference kfac/utils.py:8-56).
 
-Decorator-based wall-clock tracing for host-side phases and dispatched
-device work. ``sync=True`` calls ``jax.block_until_ready`` on the result
-(the XLA analogue of the reference's pre/post ``backend.barrier()`` —
-without it, timings measure async dispatch only).
-
-Reference bugs fixed (SURVEY.md §8): ``clear_trace`` actually clears
-(utils.py:11-12 rebinds a local) and ``get_trace`` has no undefined
-variable (utils.py:18-19 ``max_times``).
+The wall-clock trace table moved to
+``observability.tracing`` (the r7 observability subsystem); the
+``trace`` / ``get_trace`` / ``print_trace`` / ``clear_trace`` names
+stay importable from here so reference-parity callers and existing
+tests keep working unchanged.
 """
 
 from __future__ import annotations
 
-import functools
-import time
-from typing import Any, Callable
+from typing import Any
 
 import jax
 
-_FUNC_TRACES: dict[str, list[float]] = {}
-
-
-def trace(sync: bool = False, name: str | None = None) -> Callable:
-    """Decorator appending each call's duration to the module trace table.
-
-    Args:
-      sync: block on the result (and on a dummy device sync before
-        starting) so the measurement covers device execution, not just
-        dispatch.
-      name: trace key (defaults to the function's __name__).
-    """
-    def decorator(fn):
-        key = name or fn.__name__
-
-        @functools.wraps(fn)
-        def wrapper(*args, **kwargs):
-            if sync:
-                jax.block_until_ready(
-                    [a for a in args if isinstance(a, jax.Array)])
-            start = time.perf_counter()
-            out = fn(*args, **kwargs)
-            if sync:
-                jax.block_until_ready(out)
-            _FUNC_TRACES.setdefault(key, []).append(
-                time.perf_counter() - start)
-            return out
-
-        return wrapper
-
-    return decorator
-
-
-def get_trace(average: bool = True, max_history: int | None = None
-              ) -> dict[str, float]:
-    """Per-key mean (or total) duration in seconds.
-
-    ``max_history`` restricts to the most recent N samples.
-    """
-    out = {}
-    for key, times in _FUNC_TRACES.items():
-        window = times[-max_history:] if max_history else times
-        if not window:
-            continue
-        out[key] = (sum(window) / len(window)) if average else sum(window)
-    return out
-
-
-def print_trace(average: bool = True, max_history: int | None = None
-                ) -> None:
-    for key, val in sorted(get_trace(average, max_history).items()):
-        print(f'{key}: {val * 1000:.3f} ms')
-
-
-def clear_trace() -> None:
-    _FUNC_TRACES.clear()
+# Re-exports (same objects — the module-level table is shared, so
+# decorating through either path feeds one table).
+from distributed_kfac_pytorch_tpu.observability.tracing import (  # noqa: F401
+    _FUNC_TRACES,
+    clear_trace,
+    get_trace,
+    print_trace,
+    trace,
+)
 
 
 def tree_bytes(tree: Any) -> int:

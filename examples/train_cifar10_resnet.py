@@ -28,6 +28,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 from distributed_kfac_pytorch_tpu import capture as capture_lib
 from distributed_kfac_pytorch_tpu import fp16 as fp16_lib
 from distributed_kfac_pytorch_tpu import launch
+from distributed_kfac_pytorch_tpu import observability as obs
 from distributed_kfac_pytorch_tpu.models import cifar_resnet, vit
 from distributed_kfac_pytorch_tpu.parallel import distributed as D
 from distributed_kfac_pytorch_tpu.training import (
@@ -133,6 +134,7 @@ def parse_args(argv=None):
                         '(--bf16-factors and bf16 activations) is the '
                         'native half mode and needs no scaler; --fp16 '
                         'exists for exact reference-recipe parity.')
+    obs.cli.add_observability_args(p)
     return p.parse_args(argv)
 
 
@@ -190,8 +192,19 @@ def main(argv=None):
         kfac_update_freq_alpha=args.kfac_update_freq_alpha,
         kfac_update_freq_schedule=args.kfac_update_freq_decay,
         bf16_factors=args.bf16_factors,
-        bf16_precond=args.bf16_precond)
+        bf16_precond=args.bf16_precond,
+        kfac_metrics=bool(args.kfac_metrics),
+        nonfinite_guard=obs.cli.wants_guard(args))
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(model, cfg)
+    if args.kfac_metrics and kfac is None:
+        raise SystemExit('--kfac-metrics requires the K-FAC step '
+                         '(--kfac-update-freq > 0)')
+    metrics_sink = obs.cli.make_metrics_sink(
+        args, info, meta={'cli': 'train_cifar10_resnet',
+                          'model': args.model,
+                          'batch_size': args.batch_size,
+                          'devices': n_dev,
+                          'metrics_interval': args.metrics_interval})
 
     x0 = jnp.zeros((2, 32, 32, 3), jnp.float32)
     if kfac is not None:
@@ -308,8 +321,11 @@ def main(argv=None):
         batches = launch.global_batches(mesh, datasets.epoch_batches(
             train_x, train_y, args.batch_size, seed=args.seed,
             epoch=epoch, augment=True))
-        train_m = engine.train_epoch(step_fn, state, batches, hyper,
-                                     log_writer=writer, verbose=is_main)
+        with obs.cli.profile_epoch(args, info, epoch, start_epoch):
+            train_m = engine.train_epoch(step_fn, state, batches, hyper,
+                                         log_writer=writer,
+                                         verbose=is_main,
+                                         metrics_sink=metrics_sink)
         val_batches = launch.global_batches(mesh, datasets.epoch_batches(
             test_x, test_y, args.val_batch_size, shuffle=False,
             augment=False))
@@ -342,6 +358,8 @@ def main(argv=None):
                 schedulers={'kfac': kfac_sched} if kfac_sched else None,
                 step=state.step))
     mgr.wait_until_finished()  # async saves: durable before exit
+    if metrics_sink is not None:
+        metrics_sink.close()
     if writer is not None:
         writer.flush()
     if is_main:

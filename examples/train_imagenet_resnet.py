@@ -29,6 +29,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 from distributed_kfac_pytorch_tpu import capture as capture_lib
 from distributed_kfac_pytorch_tpu import fp16 as fp16_lib
 from distributed_kfac_pytorch_tpu import launch
+from distributed_kfac_pytorch_tpu import observability as obs
 from distributed_kfac_pytorch_tpu.models import imagenet_resnet, vit
 from distributed_kfac_pytorch_tpu.parallel import distributed as D
 from distributed_kfac_pytorch_tpu.training import (
@@ -151,6 +152,7 @@ def parse_args(argv=None):
                         'engine.py:38-41,75-80). On TPU, bf16 is the '
                         'native half mode and needs no scaler; --fp16 '
                         'exists for exact reference-recipe parity.')
+    obs.cli.add_observability_args(p)
     return p.parse_args(argv)
 
 
@@ -241,8 +243,19 @@ def main(argv=None):
         kfac_update_freq_schedule=args.kfac_update_freq_decay,
         bf16_factors=args.bf16_factors,
         bf16_inverses=args.bf16_inverses,
-        bf16_precond=args.bf16_precond)
+        bf16_precond=args.bf16_precond,
+        kfac_metrics=bool(args.kfac_metrics),
+        nonfinite_guard=obs.cli.wants_guard(args))
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(model, cfg)
+    if args.kfac_metrics and kfac is None:
+        raise SystemExit('--kfac-metrics requires the K-FAC step '
+                         '(--kfac-update-freq > 0)')
+    metrics_sink = obs.cli.make_metrics_sink(
+        args, info, meta={'cli': 'train_imagenet_resnet',
+                          'model': args.model,
+                          'batch_size': args.batch_size,
+                          'devices': n_dev,
+                          'metrics_interval': args.metrics_interval})
 
     x0 = jnp.zeros((2, args.image_size, args.image_size, 3), jnp.float32)
     if kfac is not None:
@@ -353,11 +366,13 @@ def main(argv=None):
         state.opt_state = optimizers.set_lr(state.opt_state, lr)
         hyper = {'lr': lr,
                  **(kfac_sched.params() if kfac_sched else {})}
-        train_m = engine.train_epoch(
-            step_fn, state,
-            launch.global_batches(mesh, train_iter_fn(epoch),
-                                  already_sharded=batches_local),
-            hyper, log_writer=writer, verbose=is_main)
+        with obs.cli.profile_epoch(args, info, epoch, start_epoch):
+            train_m = engine.train_epoch(
+                step_fn, state,
+                launch.global_batches(mesh, train_iter_fn(epoch),
+                                      already_sharded=batches_local),
+                hyper, log_writer=writer, verbose=is_main,
+                metrics_sink=metrics_sink)
         if args.precise_bn_batches > 0:
             # Precise-BN: eval with stats re-estimated at the current
             # weights; the training EWMA state is restored afterwards.
@@ -389,6 +404,8 @@ def main(argv=None):
                 schedulers={'kfac': kfac_sched} if kfac_sched else None,
                 step=state.step))
     mgr.wait_until_finished()  # async saves: durable before exit
+    if metrics_sink is not None:
+        metrics_sink.close()
     if writer is not None:
         writer.flush()
     if is_main:

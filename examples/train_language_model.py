@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 
 from distributed_kfac_pytorch_tpu import fp16 as fp16_lib
 from distributed_kfac_pytorch_tpu import launch
+from distributed_kfac_pytorch_tpu import observability as obs
 from distributed_kfac_pytorch_tpu.models import lstm_lm, transformer_lm
 from distributed_kfac_pytorch_tpu.parallel import distributed as D
 from distributed_kfac_pytorch_tpu.parallel import sequence as seq
@@ -147,6 +148,7 @@ def parse_args(argv=None):
                         'lacks AMP — this completes the CLI surface). On '
                         'TPU, bf16 is the native half mode and needs no '
                         'scaler.')
+    obs.cli.add_observability_args(p)
     return p.parse_args(argv)
 
 
@@ -225,10 +227,19 @@ def main(argv=None):
         symmetry_aware_comm=args.symmetry_aware_comm,
         bf16_factors=args.bf16_factors,
         bf16_inverses=args.bf16_inverses,
-        bf16_precond=args.bf16_precond)
+        bf16_precond=args.bf16_precond,
+        kfac_metrics=bool(args.kfac_metrics),
+        nonfinite_guard=obs.cli.wants_guard(args))
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(model, cfg)
     if kfac is None:
         raise SystemExit('use --kfac-update-freq >= 1')
+    metrics_sink = obs.cli.make_metrics_sink(
+        args, info, meta={'cli': 'train_language_model',
+                          'arch': args.arch,
+                          'batch_size': args.batch_size,
+                          'bptt': args.bptt,
+                          'devices': n_dev,
+                          'metrics_interval': args.metrics_interval})
     if args.grad_clip:
         tx = optax.chain(optax.clip_by_global_norm(args.grad_clip), tx)
 
@@ -335,11 +346,14 @@ def main(argv=None):
         lr = lr_schedule(epoch)
         state.opt_state = optimizers.set_lr(state.opt_state, lr)
         hyper = {'lr': lr, **kfac_sched.params()}
-        train_m = engine.train_epoch(
-            step_fn, state,
-            launch.global_batches(mesh, batches(epoch),
-                                  batch_spec=(data_spec, data_spec, P())),
-            hyper, log_writer=writer, verbose=is_main)
+        with obs.cli.profile_epoch(args, info, epoch, start_epoch):
+            train_m = engine.train_epoch(
+                step_fn, state,
+                launch.global_batches(
+                    mesh, batches(epoch),
+                    batch_spec=(data_spec, data_spec, P())),
+                hyper, log_writer=writer, verbose=is_main,
+                metrics_sink=metrics_sink)
         val_m = engine.evaluate(
             eval_step, state,
             launch.global_batches(
@@ -359,6 +373,8 @@ def main(argv=None):
                 dkfac.state_dict(state.kfac_state), {},
                 schedulers={'kfac': kfac_sched}, step=state.step))
     mgr.wait_until_finished()  # async saves: durable before exit
+    if metrics_sink is not None:
+        metrics_sink.close()
     if writer is not None:
         writer.flush()
     if is_main:

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Fast-tier observability smoke (r7): 3 CPU steps of the CIFAR CLI with
+# --kfac-metrics, then schema-validate the emitted JSONL via the report
+# CLI (non-zero exit on invalid streams). The same check runs in the
+# test suite as tests/test_observability.py::test_cifar_cli_metrics_smoke;
+# this wrapper is the standalone/CI-pipeline form.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="$(mktemp -d)"
+trap 'rm -rf "$out"' EXIT
+
+JAX_PLATFORMS=cpu KFAC_COMPILE_CACHE=0 KFAC_SYNTHETIC_CIFAR=384 \
+python examples/train_cifar10_resnet.py \
+    --epochs 1 --model resnet20 \
+    --batch-size 128 --val-batch-size 96 \
+    --kfac-update-freq 1 --kfac-cov-update-freq 1 \
+    --no-resume \
+    --log-dir "$out/logs" --checkpoint-dir "$out/ckpt" \
+    --kfac-metrics "$out/metrics.jsonl" \
+    --metrics-interval 1 --health-action raise
+
+python -m distributed_kfac_pytorch_tpu.observability.report \
+    "$out/metrics.jsonl"
+echo "metrics smoke OK"

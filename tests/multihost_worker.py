@@ -30,12 +30,19 @@ def _configure(n_local_devices=4):
     return jax
 
 
-def run_training(n_steps=3):
+def run_training(n_steps=3, metrics_path=None, process_index=0):
     """Build a small conv net + DistributedKFAC on the global mesh and
     train ``n_steps`` deterministic steps through ``global_batches``.
 
     Returns (params, metrics_history) — identical across processes
     (all outputs are replicated) and across 1-vs-2-process runs.
+
+    ``metrics_path`` switches on the r7 observability path: the K-FAC
+    step collects on-device metrics and every process constructs a
+    ``JsonlMetricsSink`` on the SAME path — the sink's rank-0 gating
+    (plus atomic write-then-rename) is what keeps a multi-process run
+    from interleaving or tearing lines, and that is exactly what
+    test_multihost asserts on the result.
     """
     import jax
     import jax.numpy as jnp
@@ -64,7 +71,9 @@ def run_training(n_steps=3):
     kfac = KFAC(model, factor_update_freq=1, inv_update_freq=2,
                 damping=0.003, lr=0.1,
                 comm_method=CommMethod.HYBRID_OPT,
-                grad_worker_fraction=0.5)
+                grad_worker_fraction=0.5,
+                collect_metrics=metrics_path is not None,
+                nonfinite_guard=metrics_path is not None)
     x0 = jnp.zeros((2, 8, 8, 3))
     variables, _ = kfac.init(jax.random.PRNGKey(0), x0)
     params = variables['params']
@@ -82,6 +91,16 @@ def run_training(n_steps=3):
     step = dkfac.build_train_step(loss_fn, tx, donate=False)
     hyper = {'lr': 0.05, 'damping': 0.003}
 
+    sink = None
+    if metrics_path is not None:
+        from distributed_kfac_pytorch_tpu.observability import (
+            sink as obs_sink,
+        )
+        sink = obs_sink.JsonlMetricsSink(
+            metrics_path, interval=1, process_index=process_index,
+            meta={'mode': 'multihost-metrics',
+                  'process_index': process_index})
+
     rng = np.random.default_rng(0)
     raw = [(rng.normal(size=(32, 8, 8, 3)).astype(np.float32),
             rng.integers(0, 10, 32).astype(np.int32))
@@ -93,7 +112,11 @@ def run_training(n_steps=3):
         params, opt_state, kstate, extra, metrics = step(
             params, opt_state, kstate, extra, batch, hyper,
             factor_update=True, inv_update=(i % 2 == 0))
+        if sink is not None:
+            sink.step_record(i, metrics)
         losses.append(float(jax.device_get(metrics['loss'])))
+    if sink is not None:
+        sink.close()
     params_host = jax.tree.map(
         lambda a: np.asarray(jax.device_get(a)), params)
     return params_host, losses
@@ -228,6 +251,13 @@ def main():
         num_processes=int(nproc), process_id=int(pid))
     assert info['process_count'] == int(nproc), info
     assert info['global_devices'] == 4 * int(nproc), info
+    if mode == 'metrics':
+        # r7 observability: every process constructs the sink on the
+        # same path; only rank 0 writes (the gating under test).
+        run_training(metrics_path=out_path,
+                     process_index=info['process_index'])
+        print(f'worker {pid} done', flush=True)
+        return
     if mode in ('comm', 'comm_flagship'):
         result = (run_comm_bench_flagship() if mode == 'comm_flagship'
                   else run_comm_bench())

@@ -68,6 +68,55 @@ def test_detected_world_size_multi_host_env(monkeypatch):
 
 
 @pytest.mark.slow
+def test_two_process_metrics_sink_rank0_gated(tmp_path):
+    """Both processes construct the JSONL sink on the SAME path; the
+    rank-0 gating + atomic write-then-rename must leave exactly one
+    schema-valid stream (no interleaving, no torn lines, no stray
+    per-rank or temp files) — the r7 observability multihost contract.
+    """
+    port = _free_port()
+    out = tmp_path / 'metrics.jsonl'
+    worker = os.path.join(os.path.dirname(__file__),
+                          'multihost_worker.py')
+    repo_root = os.path.dirname(os.path.dirname(worker))
+    env = {**os.environ, 'PYTHONPATH': repo_root}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(port),
+             str(pid), '2', str(out), 'metrics'],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+        for pid in range(2)
+    ]
+    outputs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outputs.append(stdout)
+    for p, stdout in zip(procs, outputs):
+        assert p.returncode == 0, f'worker failed:\n{stdout[-3000:]}'
+
+    from distributed_kfac_pytorch_tpu.observability import sink as obs_sink
+
+    # read_jsonl schema-validates every line (a torn/interleaved write
+    # would fail json parsing or validation).
+    records = obs_sink.read_jsonl(str(out))
+    steps = [r for r in records if r['kind'] == 'step']
+    assert len(steps) == 3
+    assert steps[0]['metrics'].get('kfac/factor_updates') == 1
+    assert any(k.startswith('kfac/bucket_norm/')
+               for k in steps[0]['metrics'])
+    metas = [r for r in records if r['kind'] == 'meta']
+    assert [m['meta']['process_index'] for m in metas] == [0]
+    # rank-0 gating: exactly one file, no temp/per-rank leftovers.
+    assert sorted(f.name for f in tmp_path.iterdir()) == ['metrics.jsonl']
+
+
+@pytest.mark.slow
 def test_two_process_run_matches_single_process(tmp_path):
     # Reference: same training, one process, the 8-device test mesh.
     ref_params, ref_losses = multihost_worker.run_training()
