@@ -89,6 +89,31 @@ def _detected_world_size() -> int:
     return 1
 
 
+def replicate_on_mesh(mesh, tree):
+    """Commit a pytree REPLICATED over the mesh (multi-host safe).
+
+    Model/optimizer init leaves arrive uncommitted on one device; the
+    jitted step would replicate them lazily, but the r8 resume path
+    builds its orbax restore template (``like=``) from the live state
+    *before* any step runs — an uncommitted template makes a pod
+    checkpoint restore single-device (caught by the multihost kill
+    test). Single-process: a plain ``device_put``. Multi-process: a
+    global replicated array assembled from each host's (identical)
+    copy — ``device_put`` cannot target non-addressable shardings.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(mesh, PartitionSpec())
+
+    def put(x):
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(
+                sharding, np.asarray(x))
+        return jax.device_put(x, sharding)
+
+    return jax.tree.map(put, tree)
+
+
 def host_local_batch_to_global(mesh, batch, pspec):
     """Assemble a global sharded batch from per-host local arrays.
 

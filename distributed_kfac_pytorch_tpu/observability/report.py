@@ -12,7 +12,9 @@ Prints, from the recorded stream alone (no live process needed):
   - K-FAC health: factor/inverse firing counts, non-finite skips,
     eigenvalue-floor clips, damping/ν trajectory, grad vs
     preconditioned-grad norm ratio;
-  - per precondition-bucket norms (last recorded step).
+  - per precondition-bucket norms (last recorded step);
+  - resilience events (r8): preemption / checkpoint-save / restore
+    counts with checkpoint-save latency stats.
 
 Exit status is non-zero when the file fails schema validation, so the
 CI smoke can gate on it directly.
@@ -78,7 +80,22 @@ def summarize(records: list[dict]) -> dict:
     for r in records:
         monitor.observe(r)
 
+    # Resilience events (r8): counts per kind plus checkpoint-save
+    # latency stats (the forced preemption save is the one that gates
+    # process exit — its latency is the grace budget consumed).
+    events = [r for r in records if r.get('kind') == 'event']
+    event_counts: dict[str, int] = {}
+    for r in events:
+        event_counts[r['event']] = event_counts.get(r['event'], 0) + 1
+    save_lat = [_num(r.get('data', {}).get('latency_ms'))
+                for r in events if r['event'] == 'checkpoint_save']
+    save_lat = [v for v in save_lat if not math.isnan(v)]
+
     return {
+        'events': events,
+        'event_counts': event_counts,
+        'save_latency_ms': ((sum(save_lat) / len(save_lat),
+                             max(save_lat)) if save_lat else None),
         'meta': meta,
         'n_records': len(records),
         'n_steps': len(steps),
@@ -147,6 +164,20 @@ def print_report(s: dict, out=None) -> None:
         w('-- precondition buckets (last step, |v| per shape) --')
         for k in sorted(s['bucket_norms']):
             w(f'{k:<16} {_fmt(s["bucket_norms"][k])}')
+    if s['event_counts']:
+        w()
+        w('-- resilience events --')
+        for name in sorted(s['event_counts']):
+            w(f'{name:<18} x{s["event_counts"][name]}')
+        if s['save_latency_ms']:
+            mean, worst = s['save_latency_ms']
+            w(f'checkpoint save latency: mean {_fmt(mean, " ms")}  '
+              f'max {_fmt(worst, " ms")}')
+        for r in s['events']:
+            if r['event'] in ('preemption', 'restore'):
+                detail = ', '.join(f'{k}={v}' for k, v in
+                                   sorted(r.get('data', {}).items()))
+                w(f'  ! {r["event"]}: {detail}')
     w()
     if s['health_events']:
         w(f"-- {len(s['health_events'])} health event(s) --")

@@ -17,13 +17,20 @@ Design constraints (ISSUE r7):
     a torn/interleaved line. Rotation bounds the rewrite cost:
     a full segment is renamed to ``<path>.<n>`` and a fresh one starts.
 
-Record schema (``schema`` = :data:`SCHEMA_VERSION`):
+Record schema (``schema`` = :data:`SCHEMA_VERSION`; the reader accepts
+v1 files too — v2 only *adds* the ``event`` kind, for the r8
+resilience subsystem):
 
-  {"schema": 1, "kind": "step",  "step": int, "wall_time": float,
+  {"schema": 2, "kind": "step",  "step": int, "wall_time": float,
    "host_step_ms": float?, "metrics": {flat name -> float}}
-  {"schema": 1, "kind": "epoch", "epoch": int, "wall_time": float,
+  {"schema": 2, "kind": "epoch", "epoch": int, "wall_time": float,
    "metrics": {...averaged epoch metrics...}, "trace": {stage: {...}}}
-  {"schema": 1, "kind": "meta",  "wall_time": float, "meta": {...}}
+  {"schema": 2, "kind": "meta",  "wall_time": float, "meta": {...}}
+  {"schema": 2, "kind": "event", "event": str, "wall_time": float,
+   "data": {...}}    # resilience: preemption / checkpoint_save (with
+                     # latency_ms) / restore — always kept (no
+                     # interval thinning) and flushed immediately,
+                     # because the runs that emit them tend to die next
 
 ``validate_record`` / ``read_jsonl`` are the single schema authority,
 shared by the report CLI and the tests.
@@ -37,8 +44,9 @@ import os
 import time
 from typing import Any
 
-SCHEMA_VERSION = 1
-RECORD_KINDS = ('meta', 'step', 'epoch')
+SCHEMA_VERSION = 2
+ACCEPTED_SCHEMAS = (1, 2)
+RECORD_KINDS = ('meta', 'step', 'epoch', 'event')
 
 
 def to_float(x) -> float:
@@ -56,9 +64,9 @@ def validate_record(rec: Any) -> None:
     """Raise ValueError unless ``rec`` is a schema-valid record dict."""
     if not isinstance(rec, dict):
         raise ValueError(f'record is not an object: {type(rec).__name__}')
-    if rec.get('schema') != SCHEMA_VERSION:
+    if rec.get('schema') not in ACCEPTED_SCHEMAS:
         raise ValueError(f'unknown schema version {rec.get("schema")!r} '
-                         f'(expected {SCHEMA_VERSION})')
+                         f'(accepted {ACCEPTED_SCHEMAS})')
     kind = rec.get('kind')
     if kind not in RECORD_KINDS:
         raise ValueError(f'unknown record kind {kind!r}')
@@ -68,6 +76,11 @@ def validate_record(rec: Any) -> None:
         raise ValueError('step record missing integer step')
     if kind == 'epoch' and not isinstance(rec.get('epoch'), int):
         raise ValueError('epoch record missing integer epoch')
+    if kind == 'event':
+        if not isinstance(rec.get('event'), str) or not rec['event']:
+            raise ValueError('event record missing event name')
+        if 'data' in rec and not isinstance(rec['data'], dict):
+            raise ValueError('event record data is not an object')
     if kind in ('step', 'epoch'):
         metrics = rec.get('metrics')
         if not isinstance(metrics, dict):
@@ -173,11 +186,21 @@ class JsonlMetricsSink:
             return
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
-        # A fresh sink owns its path: clear the previous run's live file
-        # and rotated segments, otherwise ``read_jsonl`` would stitch
-        # two runs' (individually schema-valid) records into one
-        # chimeric stream — e.g. on the CLIs' default <log-dir> path.
-        for stale in (path, *_rotated_segments(path)):
+        # A fresh sink owns its path: clear the previous run's rotated
+        # segments, otherwise ``read_jsonl`` would stitch two runs'
+        # (individually schema-valid) records into one chimeric stream
+        # — e.g. on the CLIs' default <log-dir> path. The previous LIVE
+        # file is preserved as ``<path>.prev`` (outside the rotated
+        # namespace, so the reader never stitches it): a relaunch after
+        # preemption reuses the same path, and that tail segment holds
+        # the dead incarnation's final records — its preemption and
+        # forced-save events included — which is exactly the telemetry
+        # a post-mortem needs (r8).
+        try:
+            os.replace(path, f'{path}.prev')
+        except FileNotFoundError:
+            pass
+        for stale in _rotated_segments(path):
             try:
                 os.unlink(stale)
             except FileNotFoundError:
@@ -232,6 +255,22 @@ class JsonlMetricsSink:
         if trace:
             rec['trace'] = trace
         self._pending.append(rec)
+
+    def event_record(self, name: str, **data) -> None:
+        """Record a resilience/lifecycle event (preemption, checkpoint
+        save + latency, restore — r8). Events bypass interval thinning
+        and are flushed IMMEDIATELY: they mark moments where the
+        process is about to exit (preemption) or just came back
+        (restore), exactly when pending telemetry must not be lost.
+        ``data`` values must be JSON-serializable scalars/strings.
+        """
+        if not self.enabled:
+            return
+        self._pending.append({'schema': SCHEMA_VERSION, 'kind': 'event',
+                              'event': str(name),
+                              'wall_time': time.time(),
+                              'data': dict(data)})
+        self.flush()
 
     # -- drain / write (off the step path) -----------------------------
 

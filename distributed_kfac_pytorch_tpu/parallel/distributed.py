@@ -987,7 +987,46 @@ class DistributedKFAC:
                                            state['grouped_inv'])}
         else:
             state = self.recompute_inverses(state, damping=damping)
-        return state
+        return self._commit_host_leaves(state)
+
+    def _commit_host_leaves(self, state: dict) -> dict:
+        """Device-put host or mis-placed leaves to their proper mesh
+        shardings (row-sharded stacks included).
+
+        A checkpoint restored WITHOUT ``like=`` (or against a template
+        whose leaves were uncommitted init arrays) hands back host or
+        single-device arrays with the proper shardings lost (see
+        ``CheckpointManager.restore``); spliced into the state
+        uncommitted they would be re-sharded lazily on first jitted
+        use — and row-sharded inverse stacks would transit as full
+        replicated arrays first, which on multi-host is an outright
+        placement error. Leaves already carrying their target sharding
+        pass through untouched, so a fully-placed like= restore costs
+        nothing. Single-process: a plain ``device_put`` per mis-placed
+        leaf. Multi-host: a mis-placed-but-addressable leaf is a full
+        per-process copy (the restore template carried global shapes),
+        so the global array is rebuilt from it per device shard via
+        ``make_array_from_callback`` — ``device_put`` cannot target
+        non-addressable shardings; a NON-addressable leaf with a
+        merely different layout is left for the step to reshard.
+        """
+        specs = self.state_pspecs(state)
+        multiprocess = jax.process_count() > 1
+
+        def place(x, spec):
+            target = NamedSharding(self.mesh, spec)
+            if isinstance(x, jax.Array) and \
+                    x.sharding.is_equivalent_to(target, x.ndim):
+                return x
+            if multiprocess:
+                if not getattr(x, 'is_fully_addressable', True):
+                    return x
+                arr = np.asarray(x)
+                return jax.make_array_from_callback(
+                    arr.shape, target, lambda idx: arr[idx])
+            return jax.device_put(jnp.asarray(x), target)
+
+        return jax.tree.map(place, state, specs)
 
     def _degenerate_stacks(self, inv_stacks: dict) -> bool:
         """True if any stored eigenbasis stack is unusable (all-zero).

@@ -1,0 +1,54 @@
+"""Resilience: preemption-safe checkpointing, mid-epoch resume, chaos.
+
+The subsystem that makes interrupted training a normal, tested path
+(ISSUE r8). Four parts, one discipline — *a preempted run loses at most
+the in-flight step, and a resumed run replays the exact remaining batch
+sequence*:
+
+  - :mod:`preemption` — SIGTERM/SIGINT (and pluggable, e.g. TPU
+    maintenance-event) grace-period handling the train loop polls once
+    per step; on trigger the loop forces a *blocking* checkpoint save
+    and exits with :data:`preemption.RELAUNCH_EXIT_CODE` so a
+    supervisor relaunch-loop restarts the job.
+  - :mod:`policy` — global-step-indexed checkpoints on top of
+    ``training.checkpoint.CheckpointManager``: step-interval and
+    wall-clock-interval knobs plus on-preemption forcing
+    (:class:`policy.StepCheckpointer`).
+  - :mod:`dataiter` — the data-stream state (seed, epoch, step offset)
+    captured in every checkpoint bundle; with the seeded pipelines in
+    ``training.datasets`` (``skip_batches=``) a resumed run replays the
+    remaining batches bit-identically.
+  - :mod:`faults` + :mod:`chaos` — fault injectors (simulated
+    preemption at step *k*, NaN batches, hard crashes, crash during
+    checkpoint write) driven by the ``KFAC_CHAOS`` env var, and the
+    ``python -m ...resilience.chaos`` harness that runs a training
+    command under them with an optional relaunch loop.
+  - :mod:`cli` — the shared flag surface (``--checkpoint-steps``,
+    ``--checkpoint-secs``, ``--preemption-grace``, ``--resume-step``)
+    and the unified newest-of-step-or-epoch resume helper used by all
+    three example CLIs (mirrors ``observability.cli``).
+
+Resilience events (preemption, forced/interval saves with latency,
+restores) ride in the schema-versioned observability metrics JSONL
+(``kind='event'``) and are summarized by ``observability.report``.
+
+Everything loads lazily so importing the package costs nothing on the
+hot path (same pattern as ``observability``).
+"""
+
+from __future__ import annotations
+
+import importlib
+
+_LAZY = ('preemption', 'policy', 'dataiter', 'faults', 'chaos', 'cli')
+
+__all__ = list(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        mod = importlib.import_module(
+            f'distributed_kfac_pytorch_tpu.resilience.{name}')
+        globals()[name] = mod
+        return mod
+    raise AttributeError(name)

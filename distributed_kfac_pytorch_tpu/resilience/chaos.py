@@ -1,0 +1,84 @@
+"""Chaos harness: run a training command under injected faults.
+
+    python -m distributed_kfac_pytorch_tpu.resilience.chaos \\
+        'preempt@2' -- python examples/train_cifar10_resnet.py ...
+
+Sets ``KFAC_CHAOS`` to the (validated) fault spec and execs the
+command. With ``--relaunch N`` it also plays supervisor: while the
+child exits with :data:`preemption.RELAUNCH_EXIT_CODE` (preempted,
+checkpoint saved) it relaunches — up to N times — with the fault spec
+CLEARED for relaunches (faults are one-shot; pass ``--keep-faults`` to
+re-inject every launch). This is the one-command form of the
+kill-and-resume smoke (scripts/resilience_smoke.sh) and doubles as the
+documented relaunch-loop shape for real supervisors
+(scripts/tpu_pod_setup.md §5).
+
+Exit status: the final child's exit code (so CI can gate on it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+from distributed_kfac_pytorch_tpu.resilience import faults
+from distributed_kfac_pytorch_tpu.resilience.preemption import (
+    RELAUNCH_EXIT_CODE,
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog='python -m distributed_kfac_pytorch_tpu.resilience.chaos',
+        description='Run a training command under injected faults '
+                    '(sets KFAC_CHAOS), optionally relaunching while '
+                    f'it exits {RELAUNCH_EXIT_CODE} (preempted).')
+    p.add_argument('spec',
+                   help="fault spec 'kind@step[,kind@step...]'; kinds: "
+                        'preempt, crash, nan-batch, crash-in-save '
+                        "(use '-' for no faults: pure relaunch loop)")
+    p.add_argument('--relaunch', type=int, default=0, metavar='N',
+                   help='relaunch the command up to N times while it '
+                        f'exits {RELAUNCH_EXIT_CODE}')
+    p.add_argument('--keep-faults', action='store_true',
+                   help='re-inject the fault spec on every relaunch '
+                        '(default: faults fire on the first launch '
+                        'only)')
+    if argv is None:
+        argv = sys.argv[1:]
+    # Split at the first '--' ourselves: argparse REMAINDER would start
+    # swallowing at the first positional and eat our own options.
+    cmd: list[str] = []
+    if '--' in argv:
+        split = argv.index('--')
+        argv, cmd = argv[:split], argv[split + 1:]
+    args = p.parse_args(argv)
+    if not cmd:
+        p.error('no command given (append: -- python examples/...)')
+    spec = None if args.spec == '-' else args.spec
+    plan = faults.parse_spec(spec)  # validate before launching anything
+
+    env = dict(os.environ)
+    if plan is not None:
+        env[faults.ENV_VAR] = spec
+    else:
+        env.pop(faults.ENV_VAR, None)
+
+    launches = 0
+    while True:
+        rc = subprocess.run(cmd, env=env).returncode
+        launches += 1
+        if rc != RELAUNCH_EXIT_CODE or launches > args.relaunch:
+            break
+        print(f'chaos: launch {launches} exited {rc} (preempted) — '
+              f'relaunching ({launches}/{args.relaunch})',
+              file=sys.stderr)
+        if not args.keep_faults:
+            env.pop(faults.ENV_VAR, None)
+    return rc
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,188 @@
+"""Shared CLI wiring for the resilience flags (mirrors
+``observability.cli``).
+
+All three example entry points expose the same resilience surface;
+this module is its single implementation:
+
+    add_resilience_args(parser)     # --checkpoint-steps /
+                                    # --checkpoint-secs /
+                                    # --preemption-grace / --resume-step
+    handler = install_preemption(args)          # SIGTERM/SIGINT + env
+    step_mgr = make_step_manager(args)
+    ckpt = make_step_checkpointer(args, step_mgr, bundle_fn,
+                                  preemption=handler, sink=sink,
+                                  start_step=0)
+    resumed = resume(args, epoch_mgr, step_mgr, like, sink=sink)
+
+``resume`` unifies the two checkpoint trees: epoch-indexed checkpoints
+(the pre-r8 format, still written at ``--checkpoint-freq``) and
+global-step-indexed ones under ``<checkpoint-dir>/steps/``. Both bundle
+kinds carry the resume point in their scalars (``epoch`` = the epoch to
+(re)enter, offset by ``step_in_epoch`` batches — see
+``resilience.dataiter``); the newest point wins, so a stale step
+checkpoint left behind by an old preemption can never resume training
+backwards past a newer epoch checkpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import traceback
+
+from distributed_kfac_pytorch_tpu.resilience import faults as faults_lib
+from distributed_kfac_pytorch_tpu.resilience import (
+    policy as policy_lib,
+    preemption as preemption_lib,
+)
+from distributed_kfac_pytorch_tpu.training import checkpoint as ckpt_lib
+
+STEP_SUBDIR = 'steps'
+
+
+def add_resilience_args(p) -> None:
+    """Resilience flags (r8; see README "Fault tolerance")."""
+    p.add_argument('--checkpoint-steps', type=int, default=0,
+                   metavar='N',
+                   help='save a global-step-indexed checkpoint every N '
+                        'optimizer steps into <checkpoint-dir>/steps '
+                        '(0 = epoch checkpoints only) — bounds '
+                        'preemption loss for long epochs')
+    p.add_argument('--checkpoint-secs', type=float, default=0.0,
+                   metavar='S',
+                   help='also step-checkpoint when S wall-clock seconds '
+                        'have passed since the last one (0 = off; on a '
+                        "pod, rank 0's clock decides and the verdict "
+                        'is broadcast so the collective save stays in '
+                        'lockstep)')
+    p.add_argument('--preemption-grace', type=float, default=30.0,
+                   metavar='S',
+                   help='grace budget after SIGTERM/SIGINT (or a '
+                        'KFAC_PREEMPT_FILE sentinel): finish the '
+                        'in-flight step, force a blocking step '
+                        'checkpoint, exit with code '
+                        f'{preemption_lib.RELAUNCH_EXIT_CODE} so a '
+                        'relaunch loop restarts the run (a second '
+                        'signal kills immediately)')
+    p.add_argument('--resume-step', type=int, default=None, metavar='G',
+                   help='resume from this exact global-step checkpoint '
+                        'in <checkpoint-dir>/steps (default: the '
+                        'newest of step/epoch checkpoints)')
+
+
+def install_preemption(args) -> preemption_lib.PreemptionHandler:
+    """Install the signal handler (plus the ``KFAC_PREEMPT_FILE``
+    sentinel source when set). Call EARLY in main() — a preemption
+    notice arriving before installation kills the process with the
+    default disposition."""
+    handler = preemption_lib.PreemptionHandler(
+        grace_secs=args.preemption_grace).install()
+    sentinel = os.environ.get('KFAC_PREEMPT_FILE')
+    if sentinel:
+        handler.add_source(preemption_lib.file_source(sentinel))
+    return handler
+
+
+def make_step_manager(args) -> ckpt_lib.CheckpointManager:
+    """The global-step-indexed manager under ``<checkpoint-dir>/steps``
+    (orbax ignores the non-integer subdirectory when scanning the
+    parent epoch tree)."""
+    return ckpt_lib.CheckpointManager(
+        os.path.join(args.checkpoint_dir, STEP_SUBDIR), max_to_keep=2)
+
+
+def make_step_checkpointer(args, step_mgr, bundle_fn, *,
+                           preemption=None, sink=None,
+                           start_step: int = 0
+                           ) -> policy_lib.StepCheckpointer:
+    """Assemble the per-step hook: interval policy + preemption forcing
+    + any ``KFAC_CHAOS`` fault plan. Always constructed (even with both
+    intervals at 0) because preemption must be able to force a save."""
+    pol = policy_lib.CheckpointPolicy(
+        every_steps=args.checkpoint_steps,
+        every_secs=args.checkpoint_secs, start_step=start_step)
+    return policy_lib.StepCheckpointer(
+        step_mgr, pol, bundle_fn, preemption=preemption, sink=sink,
+        plan=faults_lib.plan_from_env())
+
+
+def resume(args, epoch_mgr, step_mgr, like, *, sink=None,
+           verbose: bool = False):
+    """Restore the newest checkpoint (step or epoch tree), if any.
+
+    Returns ``(restored_tree, start_epoch, start_offset, source)`` or
+    None when there is nothing to resume (or ``--no-resume``).
+    ``like`` must be a live-state bundle template: restore always goes
+    through ``like=`` so sharded SPMD state comes back with its
+    committed shardings (restore without ``like`` yields host arrays —
+    see ``CheckpointManager.restore``).
+    """
+    if getattr(args, 'no_resume', False):
+        return None
+    # Known tradeoff: picking the winner needs the step bundle's
+    # scalars, and orbax StandardRestore is whole-tree, so a stale step
+    # checkpoint costs one discarded full restore before the epoch one
+    # loads. That only happens on the first relaunch after an old
+    # preemption was overtaken by epoch checkpoints — accepted over
+    # maintaining a second scalars-only manifest.
+    candidates = []  # ((epoch, offset), tree, source, label)
+    step_label = (args.resume_step if args.resume_step is not None
+                  else step_mgr.latest_epoch())
+    if args.resume_step is not None or step_label is not None:
+        tree = _restore(step_mgr, step_label, like, args,
+                        what=f'step checkpoint {step_label}')
+        sc = tree['scalars']
+        candidates.append(((int(sc['epoch']), int(sc['step_in_epoch'])),
+                           tree, 'step', step_label))
+    if args.resume_step is None:
+        e = epoch_mgr.latest_epoch()
+        if e is not None:
+            # Epoch bundles record their resume point too ((e+1, 0) —
+            # the epoch completed); restore only if it could win.
+            if not candidates or (e + 1, 0) > candidates[0][0]:
+                tree = _restore(epoch_mgr, e, like, args,
+                                what=f'epoch checkpoint {e}')
+                sc = tree['scalars']
+                candidates.append(
+                    ((int(sc['epoch']), int(sc['step_in_epoch'])),
+                     tree, 'epoch', e))
+    if not candidates:
+        return None
+    (start_epoch, offset), tree, source, label = max(
+        candidates, key=lambda c: c[0])
+    # The bundle's data_seed is part of the data-stream position
+    # (resilience.dataiter): adopt it, or a supervisor that relaunches
+    # without --seed would skip `offset` batches of a DIFFERENT
+    # permutation — silently double-training some samples and never
+    # seeing others.
+    saved_seed = tree['scalars'].get('data_seed')
+    if saved_seed is not None and hasattr(args, 'seed'):
+        saved_seed = int(saved_seed)
+        if saved_seed != args.seed:
+            if verbose:
+                print(f'resume: adopting checkpoint data_seed '
+                      f'{saved_seed} (relaunch passed --seed '
+                      f'{args.seed}) to keep the batch replay exact')
+            args.seed = saved_seed
+    if sink is not None:
+        sink.event_record('restore', source=source, label=int(label),
+                          global_step=int(tree['scalars']['step']),
+                          epoch=start_epoch, step_in_epoch=offset)
+    if verbose:
+        at = f', mid-epoch offset {offset}' if offset else ''
+        print(f'resumed from {source} checkpoint {label} '
+              f'(epoch {start_epoch}{at})')
+    return tree, start_epoch, offset, source
+
+
+def _restore(mgr, label, like, args, *, what: str):
+    try:
+        return mgr.restore(label, like=like)
+    except Exception as e:
+        traceback.print_exc()  # keep the real cause diagnosable
+        raise SystemExit(
+            f'cannot resume from {what} under {args.checkpoint_dir}: '
+            f'{e}\nThe checkpoint was likely written with a different '
+            'model/K-FAC configuration, or by a version predating the '
+            'resilience checkpoint-format extension (see MIGRATION.md '
+            '"Checkpoint format") — pass --no-resume or a fresh '
+            '--checkpoint-dir.')

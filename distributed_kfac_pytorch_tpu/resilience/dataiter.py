@@ -1,0 +1,61 @@
+"""Data-stream state capture for deterministic mid-epoch resume.
+
+The training pipelines in ``training.datasets`` are seeded and
+epoch-indexed: ``epoch_batches(seed=s, epoch=e)`` derives its shuffle
+permutation and augmentation RNG from ``SeedSequence([s, e])``, and
+``bptt_batches`` draws its window offset once per epoch from
+``SeedSequence([s, e])``. The full stream position is therefore three
+integers — ``(seed, epoch, step_in_epoch)`` — and resuming is
+*replay*: rebuild the epoch-``e`` iterator and skip the first ``k``
+batches while consuming exactly the RNG draws the skipped batches
+would have consumed (``skip_batches=`` in the dataset helpers;
+``consume_augment_rng`` keeps the augmentation stream aligned). The
+resumed run then yields bit-identical batches to the uninterrupted one
+— pinned by tests/test_resilience.py and the kill-and-resume smoke.
+
+:class:`DataStreamState` is the checkpoint-bundle representation:
+plain int scalars (``data_seed`` / ``epoch`` / ``step_in_epoch`` in
+``bundle_state(**scalars)``) so orbax round-trips them untouched.
+
+Limits: the replay guarantee covers the numpy pipelines (CIFAR,
+synthetic ImageNet, the LM corpus). The real-data ``tf.data`` ImageNet
+path reshuffles per *iterator creation*, not per epoch index, so a
+relaunch sees a different order there — resume still restores model
+state exactly but the remaining-batch sequence is best-effort
+(``train_imagenet_resnet`` skips at batch granularity via
+``Dataset.skip``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class DataStreamState:
+    """Position of a seeded training stream (see module docstring)."""
+    seed: int
+    epoch: int
+    step_in_epoch: int
+
+    def scalars(self) -> dict:
+        """The checkpoint-bundle scalar fields for this position."""
+        return {'data_seed': int(self.seed), 'epoch': int(self.epoch),
+                'step_in_epoch': int(self.step_in_epoch)}
+
+    @classmethod
+    def from_scalars(cls, scalars: dict, *,
+                     default_seed: int = 0) -> 'DataStreamState':
+        """Rebuild from a restored bundle's ``scalars`` tree (device or
+        host scalars both coerce through int())."""
+        return cls(seed=int(scalars.get('data_seed', default_seed)),
+                   epoch=int(scalars.get('epoch', 0)),
+                   step_in_epoch=int(scalars.get('step_in_epoch', 0)))
+
+
+def resume_offset(state: DataStreamState | None, epoch: int) -> int:
+    """Batches to skip when starting ``epoch``: the saved offset for
+    the interrupted epoch, 0 for every later one."""
+    if state is not None and epoch == state.epoch:
+        return state.step_in_epoch
+    return 0

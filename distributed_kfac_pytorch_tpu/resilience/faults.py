@@ -1,0 +1,129 @@
+"""Fault injectors: the chaos half of the resilience subsystem.
+
+A :class:`FaultPlan` names *where* (global optimizer step) each fault
+fires; the plan rides in the ``KFAC_CHAOS`` env var so the real CLIs
+run unmodified under injected failure (the ``chaos`` harness sets it,
+tests set it directly). Spec grammar — comma-separated ``kind@step``::
+
+    preempt@K         trigger the preemption handler after step K
+                      completes (graceful drain: forced blocking save,
+                      exit RELAUNCH_EXIT_CODE) — the simulated
+                      TPU-eviction path
+    crash@K           os._exit(137) after step K: an UNCLEAN kill (no
+                      save, no atexit) — the killed-worker path; resume
+                      falls back to the last interval/epoch checkpoint
+    nan-batch@K       poison the batch consumed at step K with a NaN —
+                      exercises the on-device non-finite factor guard
+                      (observability r7 ``nonfinite_guard``)
+    crash-in-save@K   die between kicking off the (async) checkpoint
+                      snapshot for step K and its finalize — the torn
+                      checkpoint write; orbax's write-to-tmp-then-rename
+                      atomicity must keep ``latest_epoch()`` from ever
+                      surfacing the torn step
+
+Faults are one-shot by design: a relaunch (fresh process) re-reads the
+env, so the chaos harness clears ``KFAC_CHAOS`` for relaunches unless
+told otherwise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+ENV_VAR = 'KFAC_CHAOS'
+_KINDS = ('preempt', 'crash', 'nan-batch', 'crash-in-save')
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Global-step-indexed fault schedule (None = fault not armed)."""
+    preempt_at: int | None = None
+    crash_at: int | None = None
+    nan_batch_at: int | None = None
+    crash_in_save_at: int | None = None
+
+    def any(self) -> bool:
+        return any(v is not None for v in dataclasses.astuple(self))
+
+
+def parse_spec(spec: str | None) -> FaultPlan | None:
+    """Parse a ``kind@step[,kind@step...]`` spec; None/'' -> None."""
+    if not spec:
+        return None
+    fields = {}
+    for part in spec.split(','):
+        part = part.strip()
+        if not part:
+            continue
+        kind, sep, at = part.partition('@')
+        if not sep or kind not in _KINDS or not at.lstrip('-').isdigit():
+            raise ValueError(
+                f'bad {ENV_VAR} fault spec {part!r}: expected '
+                f"'<kind>@<step>' with kind in {_KINDS}")
+        fields[kind.replace('-', '_') + '_at'] = int(at)
+    return FaultPlan(**fields) if fields else None
+
+
+def plan_from_env() -> FaultPlan | None:
+    """The process's fault plan per ``$KFAC_CHAOS`` (None = no chaos)."""
+    return parse_spec(os.environ.get(ENV_VAR))
+
+
+def hard_crash(code: int = 137) -> None:
+    """Die NOW: no save, no atexit, no orbax finalize — the moral
+    equivalent of SIGKILL (137 = 128+9), from inside the process."""
+    os._exit(code)
+
+
+# ---------------------------------------------------------------------------
+# NaN-batch injection (iterator level, before device transfer)
+# ---------------------------------------------------------------------------
+
+def poison_batch(batch):
+    """Copy of ``batch`` with one NaN planted in its first float leaf
+    (the model input) — the minimal poison that propagates to every
+    gradient and factor capture."""
+    out = list(batch)
+    for i, leaf in enumerate(out):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating):
+            arr = arr.copy()
+            arr.reshape(-1)[0] = np.nan
+            out[i] = arr
+            return tuple(out)
+    raise ValueError('nan-batch fault: batch has no float leaf to poison')
+
+
+def poison_at(batches, plan: FaultPlan | None, *, first_step: int = 0):
+    """Wrap a batch iterator, poisoning the batch consumed at global
+    step ``plan.nan_batch_at`` (``first_step`` = the global step the
+    first yielded batch will be consumed at). Passthrough when the plan
+    has no nan-batch fault."""
+    if plan is None or plan.nan_batch_at is None:
+        yield from batches
+        return
+    for i, batch in enumerate(batches):
+        if first_step + i == plan.nan_batch_at:
+            batch = poison_batch(batch)
+        yield batch
+
+
+# ---------------------------------------------------------------------------
+# Torn-checkpoint emulation (what a killed writer leaves on disk)
+# ---------------------------------------------------------------------------
+
+def torn_step_dir(directory: str, step: int) -> str:
+    """Create the on-disk state a writer killed between snapshot and
+    finalize leaves behind: an *uncommitted* orbax temp directory
+    (``<step>.orbax-checkpoint-tmp-<ts>``). Finalize is an atomic
+    rename to the bare ``<step>`` name, so this is exactly the torn
+    state — ``CheckpointManager.latest_epoch()`` must never surface it
+    (tests/test_resilience.py pins that)."""
+    path = os.path.join(directory, f'{step}.orbax-checkpoint-tmp-0')
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, '_partial_write'), 'w') as f:
+        f.write('torn')
+    return path

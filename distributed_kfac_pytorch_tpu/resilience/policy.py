@@ -1,0 +1,197 @@
+"""Step-level checkpoint policy on top of ``CheckpointManager``.
+
+Epoch checkpoints (the CLIs' ``--checkpoint-freq``) lose up to an epoch
+of work on preemption — hours at ImageNet/LM scale. The
+:class:`StepCheckpointer` adds **global-step-indexed** checkpoints in a
+``steps/`` subdirectory of the run's checkpoint tree, driven by:
+
+  - a step interval (``--checkpoint-steps N``),
+  - a wall-clock interval (``--checkpoint-secs S``), and
+  - on-preemption forcing: when the polled
+    :class:`preemption.PreemptionHandler` has triggered, a *blocking*
+    save runs regardless of the intervals and :class:`Preempted` is
+    raised so the CLI can exit with the relaunch code.
+
+Saves are async by default (orbax snapshots and writes behind the
+loop); the forced preemption save blocks, because durability before
+process exit is the whole point. Each bundle carries the resume point
+(``epoch``, ``step_in_epoch``, ``data_seed`` scalars — see
+:mod:`dataiter`) so a relaunch replays the exact remaining batches.
+
+Multihost: saves are collective (every process calls ``save``; orbax
+coordinates the shard writes), so decisions must agree across hosts —
+rank 0 is the single decision authority and its verdict is broadcast
+each step (see :meth:`StepCheckpointer._agree`; signals and wall
+clocks can otherwise tip different hosts into one-sided collective
+saves). Fault injection (:mod:`faults`) is polled here too: the
+injectors fire at the same once-per-step point the real failures
+would.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from distributed_kfac_pytorch_tpu.resilience import faults as faults_lib
+from distributed_kfac_pytorch_tpu.resilience.preemption import (
+    Preempted,
+    PreemptionHandler,
+)
+
+
+class CheckpointPolicy:
+    """Pure decision logic: is a step checkpoint due?
+
+    ``every_steps`` counts *global optimizer steps since the last step
+    save* (robust across resumes, unlike modulo-of-global-step);
+    ``every_secs`` is wall-clock since the last step save. Either knob
+    at 0 disables it; both at 0 means only forced (preemption) saves.
+    """
+
+    def __init__(self, every_steps: int = 0, every_secs: float = 0.0,
+                 *, start_step: int = 0, clock=time.monotonic):
+        if every_steps < 0 or every_secs < 0:
+            raise ValueError('checkpoint intervals must be >= 0, got '
+                             f'{every_steps=} {every_secs=}')
+        self.every_steps = int(every_steps)
+        self.every_secs = float(every_secs)
+        self._clock = clock
+        self._last_step = int(start_step)
+        self._last_time = clock()
+
+    def should_save(self, global_step: int) -> bool:
+        if self.every_steps and \
+                global_step - self._last_step >= self.every_steps:
+            return True
+        if self.every_secs and \
+                self._clock() - self._last_time >= self.every_secs:
+            return True
+        return False
+
+    def note_saved(self, global_step: int) -> None:
+        self._last_step = int(global_step)
+        self._last_time = self._clock()
+
+
+class StepCheckpointer:
+    """Per-step checkpoint + preemption + fault-injection hook.
+
+    ``train_epoch`` calls :meth:`after_step` once per completed step;
+    the CLIs call :meth:`poll` between epochs (preemption can arrive
+    during eval). ``bundle_fn(state, step_in_epoch) -> tree`` assembles
+    the checkpoint bundle (the CLI closes over its model/optimizer
+    specifics); ``sink`` (an ``observability.JsonlMetricsSink`` or
+    None) receives ``kind='event'`` records for every save (with
+    latency) and preemption.
+    """
+
+    def __init__(self, mgr, policy: CheckpointPolicy | None, bundle_fn,
+                 *, preemption: PreemptionHandler | None = None,
+                 sink=None, plan: faults_lib.FaultPlan | None = None,
+                 always_block: bool = False):
+        self.mgr = mgr
+        self.policy = policy
+        self.bundle_fn = bundle_fn
+        self.preemption = preemption
+        self.sink = sink
+        self.plan = plan
+        self.always_block = always_block
+
+    # -- the once-per-step hook ----------------------------------------
+
+    def after_step(self, state, step_in_epoch: int) -> None:
+        """Called by ``train_epoch`` after each completed step with the
+        number of steps finished in the current epoch (skip offset
+        included). May raise :class:`Preempted` — the checkpoint is
+        durable before it propagates."""
+        gstep = int(state.step)
+        if self.plan is not None:
+            if self.plan.crash_at == gstep:
+                faults_lib.hard_crash()
+            if self.plan.preempt_at == gstep and \
+                    self.preemption is not None:
+                self.preemption.trigger('injected preemption')
+        preempted = (self.preemption is not None
+                     and self.preemption.triggered())
+        due = self.policy is not None and self.policy.should_save(gstep)
+        preempted, due = self._agree(preempted, due)
+        if preempted:
+            self.save(state, step_in_epoch, blocking=True, forced=True)
+            reason = ((self.preemption.reason if self.preemption
+                       else None) or 'preempted')
+            self._event('preemption', global_step=gstep, reason=reason,
+                        grace_remaining_s=round(
+                            self.preemption.remaining_grace(), 3)
+                        if self.preemption else None)
+            raise Preempted(gstep, reason)
+        if due:
+            self.save(state, step_in_epoch)
+
+    @staticmethod
+    def _agree(preempted: bool, due: bool) -> tuple[bool, bool]:
+        """Make the save decision identical on every host.
+
+        ``mgr.save`` is COLLECTIVE, so a decision any host takes alone
+        wedges the pod: a SIGTERM can land between different hosts'
+        polls (one forces the save at step k, another at k+1), and the
+        wall-clock interval can tip over one step apart across hosts
+        (``time.monotonic`` is process-relative — clock sync cannot
+        fix it). Rank 0 is therefore the single decision authority:
+        its (preempted, due) bits are broadcast each step and every
+        host acts on those. Pod preemption reaches all workers within
+        the same step, so deferring to rank 0's observation costs at
+        most one step of grace; a signal that reaches only a non-zero
+        rank is the killed-worker case (relaunch loop), not a drain.
+        Single-process: the local bits, no collective.
+        """
+        import jax
+
+        if jax.process_count() == 1:
+            return preempted, due
+        from jax.experimental import multihost_utils
+
+        bits = (1 if preempted else 0) | (2 if due else 0)
+        agreed = int(multihost_utils.broadcast_one_to_all(
+            np.int32(bits if jax.process_index() == 0 else 0)))
+        return bool(agreed & 1), bool(agreed & 2)
+
+    def poll(self, state, step_in_epoch: int = 0) -> None:
+        """Epoch-boundary preemption check (no interval logic): the CLI
+        calls this between epochs so a signal that lands during eval or
+        checkpointing still drains within one epoch turn."""
+        if self.preemption is not None and self.preemption.triggered():
+            self.after_step(state, step_in_epoch)
+
+    # -- saving ---------------------------------------------------------
+
+    def save(self, state, step_in_epoch: int, *, blocking: bool = False,
+             forced: bool = False) -> None:
+        """Save a global-step-indexed bundle (async unless blocking)."""
+        blocking = blocking or self.always_block
+        gstep = int(state.step)
+        t0 = time.perf_counter()
+        self.mgr.save(gstep, self.bundle_fn(state, int(step_in_epoch)),
+                      force=True)
+        if self.plan is not None and self.plan.crash_in_save_at == gstep:
+            # Die between the snapshot (save() returned: arrays are
+            # captured, the background write is in flight) and the
+            # finalize rename — the torn-write window.
+            faults_lib.hard_crash()
+        if blocking:
+            self.mgr.wait_until_finished()
+        if self.policy is not None:
+            self.policy.note_saved(gstep)
+        self._event('checkpoint_save', global_step=gstep,
+                    step_in_epoch=int(step_in_epoch),
+                    latency_ms=round(
+                        (time.perf_counter() - t0) * 1000.0, 3),
+                    blocking=bool(blocking), forced=bool(forced))
+
+    def _event(self, name: str, **data) -> None:
+        if self.sink is not None:
+            self.sink.event_record(name, **data)
+
+    def close(self) -> None:
+        self.mgr.close()

@@ -1,0 +1,162 @@
+"""Grace-period preemption handling for the train loop.
+
+TPU pods (and most preemptible fleets) announce eviction with a signal
+— SIGTERM from the GCE preemption notice, SIGINT from an operator — a
+short grace window before the kill. The handler here converts that
+asynchronous notice into a flag the train loop polls **once per step**
+(`PreemptionHandler.triggered`); on trigger the loop forces a *blocking*
+checkpoint save (`policy.StepCheckpointer`) and the CLI exits with
+:data:`RELAUNCH_EXIT_CODE`, which a supervisor relaunch-loop treats as
+"restart me" (see scripts/tpu_pod_setup.md §5) while any other exit
+code means done/failed.
+
+Beyond signals the handler is pluggable: ``add_source(fn)`` registers a
+zero-argument callable polled alongside the flag — the hook for a TPU
+maintenance-event watcher (GCE metadata server
+``instance/maintenance-event``) or an orchestration sidecar. A
+file-based source ships built in (``file_source``): touching the
+sentinel path requests a graceful drain, which is also how the
+``KFAC_CHAOS`` fault injector and ops runbooks drive it without
+signals.
+
+Multihost note: the flag is LOCAL; acting on it independently would
+let a signal that lands between different hosts' polls force the
+collective save at different steps and wedge the pod. The
+``StepCheckpointer`` therefore treats rank 0's flag as the single
+decision authority and broadcasts its verdict each step
+(``policy.StepCheckpointer._agree``) — pod preemption reaches every
+worker within the same step, so this costs at most one step of grace.
+A *single* failing host (signal never reaches rank 0) is the other
+failure mode — handled by the relaunch loop restarting all workers
+from the last durable checkpoint (tests/test_multihost.py kill test),
+not by this handler.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Callable
+
+# Distinct "preempted with checkpoint saved — relaunch me" exit code
+# (EX_TEMPFAIL from sysexits.h: temporary failure, retry). Supervisors
+# loop `while rc == 75`; anything else is success or a real failure.
+RELAUNCH_EXIT_CODE = 75
+
+
+class Preempted(Exception):
+    """Raised out of the train loop after the forced preemption save.
+
+    Carries where training stopped so the CLI can log it; the
+    checkpoint is already durable when this propagates
+    (``StepCheckpointer`` saves *blocking* before raising).
+    """
+
+    def __init__(self, global_step: int, reason: str = 'preempted'):
+        super().__init__(f'{reason} at global step {global_step}')
+        self.global_step = global_step
+        self.reason = reason
+
+
+def file_source(path: str) -> Callable[[], str | None]:
+    """A trigger source that fires when ``path`` exists.
+
+    Ops (or the chaos harness) request a graceful drain with
+    ``touch <path>``; wired from the ``KFAC_PREEMPT_FILE`` env var by
+    ``resilience.cli.install_preemption``.
+    """
+
+    def check():
+        return f'sentinel file {path}' if os.path.exists(path) else None
+
+    return check
+
+
+class PreemptionHandler:
+    """Signal-driven (and pluggable) preemption flag with a grace budget.
+
+    Usage::
+
+        handler = PreemptionHandler(grace_secs=30.0).install()
+        ...
+        if handler.triggered():          # polled once per step
+            <blocking checkpoint save>
+            raise Preempted(step, handler.reason)
+
+    Semantics:
+
+    - First SIGTERM/SIGINT: set the flag and start the grace clock;
+      training finishes the in-flight step, saves, exits 75.
+    - Second signal of the same kind: escalate — the previous handler
+      (usually the default, i.e. terminate) is restored and the signal
+      re-raised, so a save wedged past the operator's patience can
+      still be killed.
+    - ``add_source``: extra zero-arg callables polled by
+      ``triggered()``; returning a truthy value (used as the reason)
+      triggers exactly like a signal.
+    """
+
+    def __init__(self, grace_secs: float = 30.0,
+                 signals=(signal.SIGTERM, signal.SIGINT)):
+        self.grace_secs = float(grace_secs)
+        self.signals = tuple(signals)
+        self.reason: str | None = None
+        self._triggered = False
+        self._deadline: float | None = None
+        self._prev: dict[int, object] = {}
+        self._sources: list[Callable[[], str | None]] = []
+
+    # -- installation ---------------------------------------------------
+
+    def install(self) -> 'PreemptionHandler':
+        for sig in self.signals:
+            self._prev[sig] = signal.signal(sig, self._on_signal)
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev.clear()
+
+    def _on_signal(self, signum, frame) -> None:
+        if self._triggered:
+            self._escalate(signum)
+            return
+        self.trigger(f'signal {signal.Signals(signum).name}')
+
+    def _escalate(self, signum) -> None:
+        """Second signal: restore the prior disposition and re-raise."""
+        signal.signal(signum, self._prev.get(signum, signal.SIG_DFL))
+        os.kill(os.getpid(), signum)
+
+    # -- triggering / polling ------------------------------------------
+
+    def add_source(self, fn: Callable[[], str | None]) -> None:
+        """Register an extra trigger source (e.g. a TPU
+        maintenance-event poller); polled by :meth:`triggered`."""
+        self._sources.append(fn)
+
+    def trigger(self, reason: str = 'preempted') -> None:
+        """Request a graceful drain (signal handler, source, or chaos)."""
+        if not self._triggered:
+            self._triggered = True
+            self.reason = reason
+            self._deadline = time.monotonic() + self.grace_secs
+
+    def triggered(self) -> bool:
+        """Poll point for the train loop — cheap (no syscalls unless
+        sources are registered)."""
+        if not self._triggered:
+            for src in self._sources:
+                why = src()
+                if why:
+                    self.trigger(str(why))
+                    break
+        return self._triggered
+
+    def remaining_grace(self) -> float:
+        """Seconds left in the grace budget (inf before triggering)."""
+        if self._deadline is None:
+            return float('inf')
+        return self._deadline - time.monotonic()

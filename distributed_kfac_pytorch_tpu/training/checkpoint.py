@@ -70,6 +70,22 @@ class CheckpointManager:
 
         ``like`` provides the target pytree structure/shardings; restored
         arrays adopt its placements (replicated vs row-sharded state).
+
+        WITHOUT ``like``, orbax falls back to the checkpoint's own
+        recorded metadata: host-staged arrays laid out for the
+        topology that SAVED them (orbax itself warns this is UNSAFE).
+        That only works when the restoring world exactly matches the
+        saving world — resuming a pod checkpoint at a different
+        process/device count, or an SPMD checkpoint on one chip, gets
+        wrong or failing placements. Engine/CLI resume paths therefore
+        ALWAYS pass ``like`` (a live-state bundle of the same
+        structure — ``resilience.cli.resume`` enforces this): restored
+        arrays adopt the LIVE state's committed shardings regardless
+        of what wrote the checkpoint, and
+        ``DistributedKFAC.load_state_dict`` re-commits stray host
+        leaves as a second line of defense. Regression-tested in
+        tests/test_resilience.py (like= adopts the live placements;
+        sharded SPMD kill-and-resume).
         """
         self._mgr.wait_until_finished()  # join any pending async save
         if epoch is None:
@@ -80,7 +96,10 @@ class CheckpointManager:
             abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
             return self._mgr.restore(
                 epoch, args=ocp.args.StandardRestore(abstract))
-        return self._mgr.restore(epoch)
+        # Explicit StandardRestore: a manager that has not saved in this
+        # process has no handler registered for the step yet (a resumed
+        # fresh process always starts this way).
+        return self._mgr.restore(epoch, args=ocp.args.StandardRestore())
 
     def close(self):
         self._mgr.wait_until_finished()
@@ -94,6 +113,13 @@ def bundle_state(params, opt_state, kfac_state_dict, extra_vars,
 
     Mirrors the reference's checkpoint dict {model, optimizer,
     preconditioner, schedulers} (examples/utils.py:10-19).
+
+    ``scalars`` carries the resume point (r8 resilience format, see
+    MIGRATION.md "Checkpoint format"): ``step`` (global optimizer
+    step), ``epoch`` (the epoch to (re)enter on resume), and
+    ``step_in_epoch`` + ``data_seed`` (the data-stream position,
+    ``resilience.dataiter.DataStreamState``) — epoch-boundary bundles
+    record ``step_in_epoch=0``.
     """
     tree = {'params': params,
             'opt_state': opt_state,

@@ -214,13 +214,17 @@ def get_lm_corpus(data_dir: str | None = None, *,
 
 def bptt_batches(ids: np.ndarray, batch_size: int, bptt: int, *,
                  shuffle_offset: bool = False, seed: int = 0,
-                 epoch: int = 0):
+                 epoch: int = 0, skip_batches: int = 0):
     """(inputs, targets) BPTT chunks of shape (batch, bptt).
 
     The stream is folded into ``batch_size`` parallel contiguous tracks
     (reference rnn_utils/utils.py:7-73 batchify + BPTT sampler); targets
     are inputs shifted by one. Hidden state can be carried across
     consecutive chunks of the same epoch.
+
+    ``skip_batches`` drops the first K windows — mid-epoch resume
+    (resilience r8): the per-epoch offset draw happens up front, so the
+    remaining windows are bit-identical to the uninterrupted epoch's.
     """
     n = ids.shape[0]
     off = 0
@@ -231,11 +235,29 @@ def bptt_batches(ids: np.ndarray, batch_size: int, bptt: int, *,
     x = ids[off:off + batch_size * track].reshape(batch_size, track)
     t = ids[off + 1:off + 1 + batch_size * track].reshape(batch_size,
                                                           track)
-    for start in range(0, track - 1, bptt):
+    for bi, start in enumerate(range(0, track - 1, bptt)):
         stop = min(start + bptt, track)
         if stop - start < bptt:
             break  # keep shapes static for jit
+        if bi < skip_batches:
+            continue
         yield x[:, start:stop], t[:, start:stop]
+
+
+def consume_augment_rng(rng: np.random.Generator, n: int) -> None:
+    """Advance ``rng`` exactly as :func:`augment_cifar` would for a
+    batch of ``n`` images, without the pixel work.
+
+    Mid-epoch resume (resilience r8) skips already-trained batches but
+    must leave the augmentation stream where the uninterrupted epoch
+    would have it — otherwise every batch after the resume point draws
+    different crops/flips and the replay is no longer bit-identical.
+    MUST mirror augment_cifar's draw sequence (crop ys, crop xs, flip);
+    tests/test_resilience.py pins the equivalence.
+    """
+    rng.integers(0, 9, size=n)
+    rng.integers(0, 9, size=n)
+    rng.random(n)
 
 
 def augment_cifar(x: np.ndarray, rng: np.random.Generator) -> np.ndarray:
@@ -244,6 +266,8 @@ def augment_cifar(x: np.ndarray, rng: np.random.Generator) -> np.ndarray:
     Random draws happen here (numpy), then the per-pixel work runs in the
     native C++ kernel (``native.augment_batch``, threaded) when the
     toolchain built it, else in the numpy fallback — both bit-identical.
+    The draw sequence is mirrored by :func:`consume_augment_rng` for
+    mid-epoch resume; change one, change both.
     """
     from distributed_kfac_pytorch_tpu import native
 
@@ -264,7 +288,8 @@ def augment_cifar(x: np.ndarray, rng: np.random.Generator) -> np.ndarray:
 
 def epoch_batches(x: np.ndarray, y: np.ndarray, batch_size: int, *,
                   shuffle: bool = True, seed: int = 0, epoch: int = 0,
-                  augment: bool = False, drop_last: bool = True
+                  augment: bool = False, drop_last: bool = True,
+                  skip_batches: int = 0
                   ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
     """Global-batch iterator (the mesh shards each batch on device).
 
@@ -273,13 +298,23 @@ def epoch_batches(x: np.ndarray, y: np.ndarray, batch_size: int, *,
     seeded like ``sampler.set_epoch`` for reproducibility. Truncate with
     ``itertools.islice`` when only a few batches are needed (e.g. the
     precise-BN recalibration pass).
+
+    ``skip_batches`` fast-forwards past the first K batches for
+    mid-epoch resume (resilience r8): skipped batches are not built,
+    but their augmentation RNG draws ARE consumed
+    (:func:`consume_augment_rng`), so batch K+1 onward is bit-identical
+    to the uninterrupted epoch's sequence.
     """
     n = x.shape[0]
     rng = np.random.default_rng(np.random.SeedSequence([seed, epoch]))
     idx = rng.permutation(n) if shuffle else np.arange(n)
     end = n - (n % batch_size) if drop_last else n
-    for start in range(0, end, batch_size):
+    for bi, start in enumerate(range(0, end, batch_size)):
         sel = idx[start:start + batch_size]
+        if bi < skip_batches:
+            if augment:
+                consume_augment_rng(rng, len(sel))
+            continue
         xb = x[sel]
         if augment:
             xb = augment_cifar(xb, rng)

@@ -40,7 +40,8 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
                 hyper: dict, *, log_writer=None, verbose: bool = False,
                 epoch_len: int | None = None,
                 static_cadence: tuple[int, int] | str | None = 'auto',
-                metrics_sink=None) -> dict[str, float]:
+                metrics_sink=None, checkpointer=None,
+                start_step_in_epoch: int = 0) -> dict[str, float]:
     """One training epoch; returns averaged metrics.
 
     ``hyper`` holds this epoch's dynamic hyperparameters ('lr', 'damping',
@@ -67,6 +68,20 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
     the averaged metrics and a host trace-table snapshot is appended and
     the sink flushed at epoch end (the only point the host blocks on
     metric values, where it already blocks for the epoch summary).
+
+    ``checkpointer``: a ``resilience.policy.StepCheckpointer`` (or
+    None). Its ``after_step(state, step_in_epoch)`` is called once per
+    completed step — the single poll point for step-interval /
+    wall-clock checkpoints, preemption drains, and fault injection. It
+    may raise ``resilience.preemption.Preempted`` AFTER a blocking
+    save; the exception propagates to the CLI, which exits with the
+    relaunch code. ``start_step_in_epoch`` is the mid-epoch resume
+    offset (how many batches of this epoch were already trained before
+    ``batches``, which the caller built with a matching
+    ``skip_batches=``) so checkpoint bundles record the true position.
+    A resumed run whose offset already covers the whole epoch (the
+    preemption landed on the final step) yields zero batches — that is
+    treated as a completed epoch, not an error.
     """
     if static_cadence == 'auto':
         import inspect
@@ -135,8 +150,24 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
         n_batches += 1
         for k, v in metrics.items():
             meters.setdefault(k, Metric(k)).update(v)
+        if checkpointer is not None:
+            # May raise Preempted (after a blocking save). Flush the
+            # sink first so the completed steps' records are durable
+            # alongside the checkpoint the relaunch resumes from.
+            try:
+                checkpointer.after_step(
+                    state, start_step_in_epoch + n_batches)
+            except BaseException:
+                if metrics_sink is not None:
+                    metrics_sink.flush()
+                raise
     elapsed = time.perf_counter() - t0
     if n_batches == 0:
+        if start_step_in_epoch > 0:
+            # Resumed exactly at the epoch boundary: nothing left to
+            # replay; count the epoch as completed.
+            state.epoch += 1
+            return {'time_s': elapsed, 'ms_per_iter': 0.0}
         raise ValueError(
             'train_epoch: the batch iterator yielded ZERO batches — '
             'usually batch_size larger than the dataset (full batches '
@@ -166,7 +197,8 @@ def _replicated_specs(tree):
 
 
 def build_sgd_train_step(model, loss_fn, tx, mesh=None, *,
-                         model_args_fn=None, metrics_fn=None,
+                         model_args_fn=None, model_kwargs_fn=None,
+                         metrics_fn=None,
                          mutable_cols=(), batch_spec=None,
                          grad_accum_steps: int = 1,
                          donate: bool = True):
@@ -185,6 +217,12 @@ def build_sgd_train_step(model, loss_fn, tx, mesh=None, *,
     The batch is sharded over the K-FAC data axes (same default as
     ``DistributedKFAC.build_train_step``); extra mesh axes are still
     averaged over so the step stays correct on any ``make_kfac_mesh``.
+
+    ``model_kwargs_fn`` mirrors the K-FAC builder's parameter: a
+    ``batch -> kwargs`` callable evaluated inside the (sharded) step,
+    so it may use ``jax.lax.axis_index`` — e.g. the LM CLI's per-device
+    dropout key fold (its SGD baseline needs the same dropout semantics
+    as the K-FAC step it is compared against).
     """
     import optax
     from jax.sharding import PartitionSpec as P
@@ -202,9 +240,11 @@ def build_sgd_train_step(model, loss_fn, tx, mesh=None, *,
         raise ValueError(f'{grad_accum_steps=} must be >= 1')
 
     def fwd_bwd(params, extra_vars, batch):
+        kwargs = model_kwargs_fn(batch) if model_kwargs_fn else {}
+
         def wrapped(params):
             out = model.apply({'params': params, **extra_vars},
-                              *model_args_fn(batch),
+                              *model_args_fn(batch), **kwargs,
                               mutable=list(mutable_cols) or False)
             out, updated = out if mutable_cols else (out, {})
             extra = metrics_fn(out, batch) if metrics_fn else {}

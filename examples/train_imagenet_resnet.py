@@ -30,6 +30,7 @@ from distributed_kfac_pytorch_tpu import capture as capture_lib
 from distributed_kfac_pytorch_tpu import fp16 as fp16_lib
 from distributed_kfac_pytorch_tpu import launch
 from distributed_kfac_pytorch_tpu import observability as obs
+from distributed_kfac_pytorch_tpu import resilience as resil
 from distributed_kfac_pytorch_tpu.models import imagenet_resnet, vit
 from distributed_kfac_pytorch_tpu.parallel import distributed as D
 from distributed_kfac_pytorch_tpu.training import (
@@ -153,11 +154,15 @@ def parse_args(argv=None):
                         'native half mode and needs no scaler; --fp16 '
                         'exists for exact reference-recipe parity.')
     obs.cli.add_observability_args(p)
+    resil.cli.add_resilience_args(p)
     return p.parse_args(argv)
 
 
 def main(argv=None):
     args = parse_args(argv)
+    # Preemption handling installs FIRST: a SIGTERM during bring-up
+    # should still drain gracefully (r8).
+    preemption = resil.cli.install_preemption(args)
     # Multi-host init BEFORE any backend use (reference analogue:
     # init_process_group at torch_imagenet_resnet.py:113, driven by
     # scripts/launch_tpu_pod.sh; single-host no-op).
@@ -175,9 +180,12 @@ def main(argv=None):
     batches_local = False  # True: iterators yield per-process shards
     if isinstance(data[0], tuple):
         (train_x, train_y), (val_x, val_y) = data
-        train_iter_fn = lambda epoch: datasets.epoch_batches(
+        # skip= is the mid-epoch resume offset (resilience r8): the
+        # seeded numpy pipeline replays the remaining batches
+        # bit-identically (see resilience.dataiter).
+        train_iter_fn = lambda epoch, skip=0: datasets.epoch_batches(
             train_x, train_y, args.batch_size, seed=args.seed,
-            epoch=epoch)
+            epoch=epoch, skip_batches=skip)
         val_iter_fn = lambda: datasets.epoch_batches(
             val_x, val_y, args.val_batch_size, shuffle=False)
     else:
@@ -196,9 +204,14 @@ def main(argv=None):
             val_ds = val_ds.shard(nproc, info['process_index'])
             tb, vb = tb // nproc, vb // nproc
             batches_local = True
-        train_iter_fn = lambda epoch: (
+        # tf.data path: mid-epoch resume is BEST-EFFORT — the model
+        # state restores exactly, but shuffle order is per iterator
+        # creation (not epoch-seeded), so the skipped-batch replay is
+        # not bit-identical here (resilience.dataiter documents this;
+        # the numpy pipelines above carry the replay guarantee).
+        train_iter_fn = lambda epoch, skip=0: (
             (x.numpy(), y.numpy()) for x, y in
-            train_ds.batch(tb, drop_remainder=True))
+            train_ds.batch(tb, drop_remainder=True).skip(skip))
         val_iter_fn = lambda: (
             (x.numpy(), y.numpy()) for x, y in
             val_ds.batch(vb, drop_remainder=True))
@@ -283,6 +296,11 @@ def main(argv=None):
     mesh = D.make_kfac_mesh(
         comm_method=optimizers.COMM_METHODS[args.comm_method],
         grad_worker_fraction=args.grad_worker_fraction)
+    # Commit params/extra replicated on the mesh up front: the resume
+    # path builds its restore template (like=) from live state, and an
+    # uncommitted single-device init would restore a pod checkpoint
+    # onto one device (caught by the r8 multihost kill test).
+    params, extra = launch.replicate_on_mesh(mesh, (params, extra))
     opt_state = tx.init(params)
 
     def loss_fn(out, batch):
@@ -321,88 +339,101 @@ def main(argv=None):
         # (the state trees differ, so cross-mode resume cannot work).
         args.checkpoint_dir += '-sgd'
     mgr = ckpt_lib.CheckpointManager(args.checkpoint_dir)
-    start_epoch = 0
-    if not args.no_resume and mgr.latest_epoch() is not None:
+    step_mgr = resil.cli.make_step_manager(args)
+
+    def bundle_fn(st, step_in_epoch):
         # Must match the SAVED structure exactly (orbax StandardRestore
-        # is strict): include scheduler states and the step scalar.
-        like = ckpt_lib.bundle_state(
-            state.params, state.opt_state,
-            dkfac.state_dict(kstate) if dkfac else {},
-            state.extra_vars,
+        # is strict): scheduler states + the resume-point scalars
+        # (MIGRATION.md "Checkpoint format").
+        return ckpt_lib.bundle_state(
+            st.params, st.opt_state,
+            dkfac.state_dict(st.kfac_state) if dkfac else {},
+            st.extra_vars,
             schedulers={'kfac': kfac_sched} if kfac_sched else None,
-            step=0)
-        try:
-            restored = mgr.restore(like=like)
-        except Exception as e:
-            import traceback
-            traceback.print_exc()  # keep the real cause diagnosable
-            raise SystemExit(
-                f'cannot resume from {args.checkpoint_dir}: {e}\n'
-                'The checkpoint was likely written with a different '
-                'K-FAC configuration, or by a version predating the '
-                'scalars/scheduler checkpoint-format extension (see '
-                'MIGRATION.md "Checkpoint format") — pass --no-resume '
-                'or a fresh --checkpoint-dir.')
+            step=st.step, epoch=st.epoch, step_in_epoch=step_in_epoch,
+            data_seed=args.seed)
+
+    start_epoch, start_offset = 0, 0
+    resumed = resil.cli.resume(args, mgr, step_mgr, bundle_fn(state, 0),
+                               sink=metrics_sink, verbose=is_main)
+    if resumed is not None:
+        restored, start_epoch, start_offset, _src = resumed
         state.params = restored['params']
         state.opt_state = restored['opt_state']
         if dkfac:
-            state.kfac_state = dkfac.load_state_dict(restored['kfac'],
-                                                     params)
+            state.kfac_state = dkfac.load_state_dict(
+                restored['kfac'], state.params)
         state.extra_vars = restored['extra_vars']
-        start_epoch = mgr.latest_epoch() + 1
         state.epoch = start_epoch
-        state.step = int(restored['scalars'].get('step', 0))
+        state.step = int(restored['scalars']['step'])
         if kfac_sched:
             kfac_sched.step(start_epoch)
-        if is_main:
-            print(f'resumed from epoch {mgr.latest_epoch()}')
+    step_ckpt = resil.cli.make_step_checkpointer(
+        args, step_mgr, bundle_fn, preemption=preemption,
+        sink=metrics_sink, start_step=state.step)
 
     writer = engine.TensorBoardWriter(args.log_dir) if is_main else None
     bn_steps = (engine.make_precise_bn_steps(model, mesh)
                 if args.precise_bn_batches > 0 else None)
     t_start = time.perf_counter()
-    for epoch in range(start_epoch, args.epochs):
-        lr = lr_schedule(epoch)
-        state.opt_state = optimizers.set_lr(state.opt_state, lr)
-        hyper = {'lr': lr,
-                 **(kfac_sched.params() if kfac_sched else {})}
-        with obs.cli.profile_epoch(args, info, epoch, start_epoch):
-            train_m = engine.train_epoch(
-                step_fn, state,
-                launch.global_batches(mesh, train_iter_fn(epoch),
+    try:
+        for epoch in range(start_epoch, args.epochs):
+            skip = start_offset if epoch == start_epoch else 0
+            # Drain a preemption notice that landed during eval/
+            # checkpointing of the previous epoch (forced save + exit).
+            step_ckpt.poll(state, skip)
+            lr = lr_schedule(epoch)
+            state.opt_state = optimizers.set_lr(state.opt_state, lr)
+            hyper = {'lr': lr,
+                     **(kfac_sched.params() if kfac_sched else {})}
+            raw = resil.faults.poison_at(train_iter_fn(epoch, skip),
+                                         step_ckpt.plan,
+                                         first_step=state.step)
+            with obs.cli.profile_epoch(args, info, epoch, start_epoch):
+                train_m = engine.train_epoch(
+                    step_fn, state,
+                    launch.global_batches(mesh, raw,
+                                          already_sharded=batches_local),
+                    hyper, log_writer=writer, verbose=is_main,
+                    metrics_sink=metrics_sink, checkpointer=step_ckpt,
+                    start_step_in_epoch=skip)
+            if args.precise_bn_batches > 0:
+                # Precise-BN: eval with stats re-estimated at the current
+                # weights; the training EWMA state is restored afterwards.
+                import itertools
+                recal = engine.precise_bn_recalibrate(
+                    model, state.params, state.extra_vars,
+                    launch.global_batches(
+                        mesh,
+                        itertools.islice(train_iter_fn(epoch),
+                                         args.precise_bn_batches),
+                        already_sharded=batches_local),
+                    mesh, steps=bn_steps)
+                train_extra, state.extra_vars = state.extra_vars, recal
+            engine.evaluate(
+                eval_step, state,
+                launch.global_batches(mesh, val_iter_fn(),
                                       already_sharded=batches_local),
-                hyper, log_writer=writer, verbose=is_main,
-                metrics_sink=metrics_sink)
-        if args.precise_bn_batches > 0:
-            # Precise-BN: eval with stats re-estimated at the current
-            # weights; the training EWMA state is restored afterwards.
-            import itertools
-            recal = engine.precise_bn_recalibrate(
-                model, state.params, state.extra_vars,
-                launch.global_batches(
-                    mesh,
-                    itertools.islice(train_iter_fn(epoch),
-                                     args.precise_bn_batches),
-                    already_sharded=batches_local),
-                mesh, steps=bn_steps)
-            train_extra, state.extra_vars = state.extra_vars, recal
-        engine.evaluate(
-            eval_step, state,
-            launch.global_batches(mesh, val_iter_fn(),
-                                  already_sharded=batches_local),
-            log_writer=writer, verbose=is_main)
-        if args.precise_bn_batches > 0:
-            state.extra_vars = train_extra
-        if kfac_sched:
-            kfac_sched.step(epoch + 1)
-        if (epoch + 1) % args.checkpoint_freq == 0 or \
-                epoch == args.epochs - 1:
-            mgr.save(epoch, ckpt_lib.bundle_state(
-                state.params, state.opt_state,
-                dkfac.state_dict(state.kfac_state) if dkfac else {},
-                state.extra_vars,
-                schedulers={'kfac': kfac_sched} if kfac_sched else None,
-                step=state.step))
+                log_writer=writer, verbose=is_main)
+            if args.precise_bn_batches > 0:
+                state.extra_vars = train_extra
+            if kfac_sched:
+                kfac_sched.step(epoch + 1)
+            if (epoch + 1) % args.checkpoint_freq == 0 or \
+                    epoch == args.epochs - 1:
+                mgr.save(epoch, bundle_fn(state, 0))
+    except resil.preemption.Preempted as p:
+        # The step checkpoint is already durable (blocking save).
+        step_ckpt.close()
+        mgr.wait_until_finished()
+        if metrics_sink is not None:
+            metrics_sink.close()
+        if is_main:
+            print(f'preempted ({p.reason}) at global step '
+                  f'{p.global_step}; checkpoint saved — exiting '
+                  f'{resil.preemption.RELAUNCH_EXIT_CODE} for relaunch')
+        return resil.preemption.RELAUNCH_EXIT_CODE
+    step_ckpt.close()
     mgr.wait_until_finished()  # async saves: durable before exit
     if metrics_sink is not None:
         metrics_sink.close()
@@ -410,7 +441,8 @@ def main(argv=None):
         writer.flush()
     if is_main:
         print(f'total: {time.perf_counter() - t_start:.1f}s')
+    return 0
 
 
 if __name__ == '__main__':
-    main()
+    sys.exit(main())

@@ -39,6 +39,7 @@ from jax.sharding import PartitionSpec as P
 from distributed_kfac_pytorch_tpu import fp16 as fp16_lib
 from distributed_kfac_pytorch_tpu import launch
 from distributed_kfac_pytorch_tpu import observability as obs
+from distributed_kfac_pytorch_tpu import resilience as resil
 from distributed_kfac_pytorch_tpu.models import lstm_lm, transformer_lm
 from distributed_kfac_pytorch_tpu.parallel import distributed as D
 from distributed_kfac_pytorch_tpu.parallel import sequence as seq
@@ -149,6 +150,7 @@ def parse_args(argv=None):
                         'TPU, bf16 is the native half mode and needs no '
                         'scaler.')
     obs.cli.add_observability_args(p)
+    resil.cli.add_resilience_args(p)
     return p.parse_args(argv)
 
 
@@ -172,6 +174,9 @@ def build_model(args, vocab_size, seq_axis=None, dtype=None):
 
 def main(argv=None):
     args = parse_args(argv)
+    # Preemption handling installs FIRST: a SIGTERM during bring-up
+    # should still drain gracefully (r8).
+    preemption = resil.cli.install_preemption(args)
     # Multi-host init BEFORE any backend use (single-host no-op; see
     # launch.initialize_multihost / scripts/launch_tpu_pod.sh).
     info = launch.initialize_multihost()
@@ -232,7 +237,18 @@ def main(argv=None):
         nonfinite_guard=obs.cli.wants_guard(args))
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(model, cfg)
     if kfac is None:
-        raise SystemExit('use --kfac-update-freq >= 1')
+        # --kfac-update-freq 0: plain SGD baseline (reference
+        # optimizers.py:28) — same fallback the CNN CLIs expose.
+        if sp > 1:
+            raise SystemExit('--seq-parallel requires the K-FAC step '
+                             '(--kfac-update-freq > 0)')
+        if args.kfac_metrics:
+            raise SystemExit('--kfac-metrics requires the K-FAC step '
+                             '(--kfac-update-freq > 0)')
+        if args.fp16:
+            raise SystemExit('--fp16 requires the K-FAC step '
+                             '(--kfac-update-freq > 0); the SGD baseline '
+                             'path does not wire the loss scaler.')
     metrics_sink = obs.cli.make_metrics_sink(
         args, info, meta={'cli': 'train_language_model',
                           'arch': args.arch,
@@ -246,15 +262,27 @@ def main(argv=None):
     ids0 = jnp.zeros((2, args.bptt), jnp.int32)
     twin = (build_model(args, vocab_size, seq_axis=None)
             if seq_axis else None)
-    variables, _ = kfac.init(jax.random.PRNGKey(args.seed), ids0,
-                             train=False, init_model=twin)
+    if kfac is not None:
+        variables, _ = kfac.init(jax.random.PRNGKey(args.seed), ids0,
+                                 train=False, init_model=twin)
+    else:
+        variables = model.init(jax.random.PRNGKey(args.seed), ids0,
+                               train=False)
     params = variables['params']
 
     mesh = D.make_kfac_mesh(
         comm_method=optimizers.COMM_METHODS[args.comm_method],
         grad_worker_fraction=args.grad_worker_fraction, seq_parallel=sp)
-    dkfac = D.DistributedKFAC(kfac, mesh, params)
-    kstate = dkfac.init_state(params)
+    # Commit params replicated on the mesh up front: the resume path
+    # builds its restore template (like=) from live state, and an
+    # uncommitted single-device init would restore a pod checkpoint
+    # onto one device (caught by the r8 multihost kill test).
+    params = launch.replicate_on_mesh(mesh, params)
+    if kfac is not None:
+        dkfac = D.DistributedKFAC(kfac, mesh, params)
+        kstate = dkfac.init_state(params)
+    else:
+        dkfac, kstate = None, None
     opt_state = tx.init(params)
 
     def logits_of(out):
@@ -265,12 +293,15 @@ def main(argv=None):
             logits_of(out), batch[1]).mean()
 
     t_local = args.bptt // sp
+    data_axes = (dkfac.data_axes if dkfac is not None
+                 else tuple(a for a in D.KFAC_AXES
+                            if a in mesh.axis_names))
 
     def model_kwargs_fn(batch):
         # Per-device dropout key: fold the step key with the device's
         # linear mesh index so masks decorrelate across shards.
-        idx = jax.lax.axis_index(D.INV_GROUP_AXIS)
-        for ax in dkfac.data_axes[1:]:
+        idx = jax.lax.axis_index(data_axes[0])
+        for ax in data_axes[1:]:
             idx = idx * jax.lax.psum(1, ax) + jax.lax.axis_index(ax)
         kwargs = {'train': True,
                   'rngs': {'dropout': jax.random.fold_in(batch[2], idx)}}
@@ -281,10 +312,17 @@ def main(argv=None):
 
     data_spec = (P(D.KFAC_AXES, seq.SEQ_AXIS) if seq_axis
                  else P(D.KFAC_AXES))
-    step_fn = dkfac.build_train_step(
-        loss_fn, tx, model_kwargs_fn=model_kwargs_fn,
-        batch_spec=(data_spec, data_spec, P()),
-        loss_scale='dynamic' if args.fp16 else None)
+    if dkfac is not None:
+        step_fn = dkfac.build_train_step(
+            loss_fn, tx, model_kwargs_fn=model_kwargs_fn,
+            batch_spec=(data_spec, data_spec, P()),
+            loss_scale='dynamic' if args.fp16 else None)
+    else:  # --kfac-update-freq 0: plain SGD (reference optimizers.py:28)
+        step_fn = engine.build_sgd_train_step(
+            model, loss_fn, tx, mesh,
+            model_kwargs_fn=model_kwargs_fn,
+            batch_spec=(data_spec, data_spec, P()),
+            metrics_fn=lambda out, b: {})
 
     def eval_loss(out, batch):
         return optax.softmax_cross_entropy_with_integer_labels(
@@ -301,77 +339,111 @@ def main(argv=None):
                                   {'loss_scale':
                                    fp16_lib.init_loss_scale()}
                                   if args.fp16 else {}))
+    if dkfac is None and args.checkpoint_dir == './checkpoints/lm':
+        # Keep the SGD comparison's checkpoints apart from a K-FAC run's
+        # (the state trees differ, so cross-mode resume cannot work).
+        args.checkpoint_dir += '-sgd'
     mgr = ckpt_lib.CheckpointManager(args.checkpoint_dir)
-    start_epoch = 0
-    if not args.no_resume and mgr.latest_epoch() is not None:
+    step_mgr = resil.cli.make_step_manager(args)
+
+    def bundle_fn(st, step_in_epoch):
         # Must match the SAVED structure exactly (orbax StandardRestore
-        # is strict): include scheduler states and the step scalar.
-        like = ckpt_lib.bundle_state(
-            state.params, state.opt_state, dkfac.state_dict(kstate), {},
-            schedulers={'kfac': kfac_sched}, step=0)
-        try:
-            restored = mgr.restore(like=like)
-        except Exception as e:
-            import traceback
-            traceback.print_exc()  # keep the real cause diagnosable
-            raise SystemExit(
-                f'cannot resume from {args.checkpoint_dir}: {e}\n'
-                'The checkpoint was likely written with a different '
-                'model/K-FAC configuration, or by a version predating '
-                'the scalars/scheduler checkpoint-format extension (see '
-                'MIGRATION.md "Checkpoint format") — pass --no-resume '
-                'or a fresh --checkpoint-dir.')
+        # is strict): scheduler states + the resume-point scalars
+        # (MIGRATION.md "Checkpoint format").
+        return ckpt_lib.bundle_state(
+            st.params, st.opt_state,
+            dkfac.state_dict(st.kfac_state) if dkfac else {},
+            st.extra_vars,
+            schedulers={'kfac': kfac_sched} if kfac_sched else None,
+            step=st.step, epoch=st.epoch, step_in_epoch=step_in_epoch,
+            data_seed=args.seed)
+
+    start_epoch, start_offset = 0, 0
+    resumed = resil.cli.resume(args, mgr, step_mgr, bundle_fn(state, 0),
+                               sink=metrics_sink, verbose=is_main)
+    if resumed is not None:
+        restored, start_epoch, start_offset, _src = resumed
         state.params = restored['params']
         state.opt_state = restored['opt_state']
-        state.kfac_state = dkfac.load_state_dict(restored['kfac'], params)
-        start_epoch = mgr.latest_epoch() + 1
+        if dkfac:
+            state.kfac_state = dkfac.load_state_dict(
+                restored['kfac'], state.params)
+        state.extra_vars = restored['extra_vars']
         state.epoch = start_epoch
         # Restore the host step counter: the engine's static cadence is
         # driven by it, so it must stay in phase with kstate['step'].
-        state.step = int(restored['scalars'].get('step', 0))
-        kfac_sched.step(start_epoch)
-        if is_main:
-            print(f'resumed from epoch {mgr.latest_epoch()}')
+        state.step = int(restored['scalars']['step'])
+        if kfac_sched:
+            kfac_sched.step(start_epoch)
+    step_ckpt = resil.cli.make_step_checkpointer(
+        args, step_mgr, bundle_fn, preemption=preemption,
+        sink=metrics_sink, start_step=state.step)
 
-    def batches(epoch):
+    def batches(epoch, skip=0):
+        # skip= is the mid-epoch resume offset; the per-step dropout
+        # keys fold the ABSOLUTE window index so the replayed tail is
+        # bit-identical to the uninterrupted epoch's.
         root = jax.random.PRNGKey(args.seed * 1000 + epoch)
         for i, (x, y) in enumerate(datasets.bptt_batches(
                 train_ids, args.batch_size, args.bptt,
-                shuffle_offset=True, seed=args.seed, epoch=epoch)):
+                shuffle_offset=True, seed=args.seed, epoch=epoch,
+                skip_batches=skip), start=skip):
             yield x, y, jax.random.fold_in(root, i)
 
     writer = engine.TensorBoardWriter(args.log_dir) if is_main else None
     t_start = time.perf_counter()
-    for epoch in range(start_epoch, args.epochs):
-        lr = lr_schedule(epoch)
-        state.opt_state = optimizers.set_lr(state.opt_state, lr)
-        hyper = {'lr': lr, **kfac_sched.params()}
-        with obs.cli.profile_epoch(args, info, epoch, start_epoch):
-            train_m = engine.train_epoch(
-                step_fn, state,
+    try:
+        for epoch in range(start_epoch, args.epochs):
+            skip = start_offset if epoch == start_epoch else 0
+            # Drain a preemption notice that landed during eval/
+            # checkpointing of the previous epoch (forced save + exit).
+            step_ckpt.poll(state, skip)
+            lr = lr_schedule(epoch)
+            state.opt_state = optimizers.set_lr(state.opt_state, lr)
+            hyper = {'lr': lr,
+                     **(kfac_sched.params() if kfac_sched else {})}
+            raw = resil.faults.poison_at(batches(epoch, skip),
+                                         step_ckpt.plan,
+                                         first_step=state.step)
+            with obs.cli.profile_epoch(args, info, epoch, start_epoch):
+                train_m = engine.train_epoch(
+                    step_fn, state,
+                    launch.global_batches(
+                        mesh, raw,
+                        batch_spec=(data_spec, data_spec, P())),
+                    hyper, log_writer=writer, verbose=is_main,
+                    metrics_sink=metrics_sink, checkpointer=step_ckpt,
+                    start_step_in_epoch=skip)
+            val_m = engine.evaluate(
+                eval_step, state,
                 launch.global_batches(
-                    mesh, batches(epoch),
-                    batch_spec=(data_spec, data_spec, P())),
-                hyper, log_writer=writer, verbose=is_main,
-                metrics_sink=metrics_sink)
-        val_m = engine.evaluate(
-            eval_step, state,
-            launch.global_batches(
-                mesh,
-                datasets.bptt_batches(val_ids, args.batch_size, args.bptt),
-                batch_spec=(data_spec, data_spec)),
-            log_writer=writer, verbose=is_main)
+                    mesh,
+                    datasets.bptt_batches(val_ids, args.batch_size,
+                                          args.bptt),
+                    batch_spec=(data_spec, data_spec)),
+                log_writer=writer, verbose=is_main)
+            if is_main and 'loss' in train_m:
+                print(f'epoch {epoch}: train ppl '
+                      f'{math.exp(min(train_m["loss"], 20)):.2f}, '
+                      f'val ppl '
+                      f'{math.exp(min(val_m["loss"], 20)):.2f}')
+            if kfac_sched:
+                kfac_sched.step(epoch + 1)
+            if (epoch + 1) % args.checkpoint_freq == 0 or \
+                    epoch == args.epochs - 1:
+                mgr.save(epoch, bundle_fn(state, 0))
+    except resil.preemption.Preempted as p:
+        # The step checkpoint is already durable (blocking save).
+        step_ckpt.close()
+        mgr.wait_until_finished()
+        if metrics_sink is not None:
+            metrics_sink.close()
         if is_main:
-            print(f'epoch {epoch}: train ppl '
-                  f'{math.exp(min(train_m["loss"], 20)):.2f}, val ppl '
-                  f'{math.exp(min(val_m["loss"], 20)):.2f}')
-        kfac_sched.step(epoch + 1)
-        if (epoch + 1) % args.checkpoint_freq == 0 or \
-                epoch == args.epochs - 1:
-            mgr.save(epoch, ckpt_lib.bundle_state(
-                state.params, state.opt_state,
-                dkfac.state_dict(state.kfac_state), {},
-                schedulers={'kfac': kfac_sched}, step=state.step))
+            print(f'preempted ({p.reason}) at global step '
+                  f'{p.global_step}; checkpoint saved — exiting '
+                  f'{resil.preemption.RELAUNCH_EXIT_CODE} for relaunch')
+        return resil.preemption.RELAUNCH_EXIT_CODE
+    step_ckpt.close()
     mgr.wait_until_finished()  # async saves: durable before exit
     if metrics_sink is not None:
         metrics_sink.close()
@@ -379,7 +451,8 @@ def main(argv=None):
         writer.flush()
     if is_main:
         print(f'total: {time.perf_counter() - t_start:.1f}s')
+    return 0
 
 
 if __name__ == '__main__':
-    main()
+    sys.exit(main())

@@ -30,7 +30,8 @@ def _configure(n_local_devices=4):
     return jax
 
 
-def run_training(n_steps=3, metrics_path=None, process_index=0):
+def run_training(n_steps=3, metrics_path=None, process_index=0,
+                 checkpoint_dir=None, kill_at=None, resume=False):
     """Build a small conv net + DistributedKFAC on the global mesh and
     train ``n_steps`` deterministic steps through ``global_batches``.
 
@@ -43,6 +44,17 @@ def run_training(n_steps=3, metrics_path=None, process_index=0):
     (plus atomic write-then-rename) is what keeps a multi-process run
     from interleaving or tearing lines, and that is exactly what
     test_multihost asserts on the result.
+
+    The r8 resilience path: with ``checkpoint_dir`` every process joins
+    a collective, *blocking* per-step checkpoint save (orbax
+    coordinates the shard writes across hosts — the restore-with-
+    committed-shardings contract under test). ``kill_at=k`` hard-kills
+    process 1 (``os._exit``) right after the step-``k`` save is
+    durable — the killed-multihost-worker fault; the surviving worker
+    must then fail its next collective rather than hang forever.
+    ``resume=True`` restores the newest step checkpoint (``like=`` the
+    live sharded state) and replays only the remaining global batches,
+    so a relaunched world must reproduce the uninterrupted run.
     """
     import jax
     import jax.numpy as jnp
@@ -79,6 +91,10 @@ def run_training(n_steps=3, metrics_path=None, process_index=0):
     params = variables['params']
     mesh = D.make_kfac_mesh(comm_method=CommMethod.HYBRID_OPT,
                             grad_worker_fraction=0.5)
+    # Commit params replicated on the global mesh: the r8 resume path
+    # builds its restore template from live state, and an uncommitted
+    # single-device init would restore the checkpoint onto one device.
+    params = launch.replicate_on_mesh(mesh, params)
     dkfac = D.DistributedKFAC(kfac, mesh, params)
     kstate = dkfac.init_state(params)
     tx = optax.sgd(0.05, momentum=0.9)
@@ -101,6 +117,23 @@ def run_training(n_steps=3, metrics_path=None, process_index=0):
             meta={'mode': 'multihost-metrics',
                   'process_index': process_index})
 
+    mgr, start = None, 0
+    if checkpoint_dir is not None:
+        from distributed_kfac_pytorch_tpu.training import (
+            checkpoint as ckpt_lib,
+        )
+        mgr = ckpt_lib.CheckpointManager(checkpoint_dir,
+                                         max_to_keep=None)
+        if resume:
+            like = {'params': params, 'opt_state': opt_state,
+                    'kfac': dkfac.state_dict(kstate),
+                    'scalars': {'step': 0}}
+            restored = mgr.restore(like=like)
+            params = restored['params']
+            opt_state = restored['opt_state']
+            kstate = dkfac.load_state_dict(restored['kfac'], params)
+            start = int(restored['scalars']['step'])
+
     rng = np.random.default_rng(0)
     raw = [(rng.normal(size=(32, 8, 8, 3)).astype(np.float32),
             rng.integers(0, 10, 32).astype(np.int32))
@@ -108,15 +141,28 @@ def run_training(n_steps=3, metrics_path=None, process_index=0):
 
     losses = []
     extra = {}
-    for i, batch in enumerate(launch.global_batches(mesh, iter(raw))):
+    for i, batch in enumerate(
+            launch.global_batches(mesh, iter(raw[start:])), start=start):
         params, opt_state, kstate, extra, metrics = step(
             params, opt_state, kstate, extra, batch, hyper,
             factor_update=True, inv_update=(i % 2 == 0))
         if sink is not None:
             sink.step_record(i, metrics)
         losses.append(float(jax.device_get(metrics['loss'])))
+        if mgr is not None:
+            # Collective blocking save: every process participates;
+            # durable before the kill fault below can fire.
+            mgr.save(i + 1, {'params': params, 'opt_state': opt_state,
+                             'kfac': dkfac.state_dict(kstate),
+                             'scalars': {'step': i + 1}}, force=True,
+                     blocking=True)
+            if kill_at == i + 1 and process_index == 1:
+                import os
+                os._exit(1)  # the killed worker: no cleanup, no goodbye
     if sink is not None:
         sink.close()
+    if mgr is not None:
+        mgr.close()
     params_host = jax.tree.map(
         lambda a: np.asarray(jax.device_get(a)), params)
     return params_host, losses
@@ -256,6 +302,28 @@ def main():
         # same path; only rank 0 writes (the gating under test).
         run_training(metrics_path=out_path,
                      process_index=info['process_index'])
+        print(f'worker {pid} done', flush=True)
+        return
+    if mode == 'resilience':
+        # r8: collective per-step checkpoints; optionally kill worker 1
+        # after step KILL_AT's save, or resume from the newest step.
+        # argv: ... OUT.npz resilience CKPT_DIR KILL_AT RESUME(0|1)
+        ckpt_dir, kill_at, resume = sys.argv[6:9]
+        n_steps = int(sys.argv[9]) if len(sys.argv) > 9 else 4
+        params, losses = run_training(
+            n_steps=n_steps, process_index=info['process_index'],
+            checkpoint_dir=ckpt_dir,
+            kill_at=None if kill_at == '-' else int(kill_at),
+            resume=resume == '1')
+        if info['process_index'] == 0:
+            import numpy as np
+
+            import jax
+            flat = {'/'.join(map(str, path)): leaf
+                    for path, leaf in
+                    jax.tree_util.tree_flatten_with_path(params)[0]}
+            np.savez(out_path, losses=np.asarray(losses),
+                     **{k: v for k, v in flat.items()})
         print(f'worker {pid} done', flush=True)
         return
     if mode in ('comm', 'comm_flagship'):

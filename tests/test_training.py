@@ -423,6 +423,56 @@ class TestCheckpoint:
             dkfac.load_state_dict(sd, state.params)
 
 
+class TestBundleStateRoundtrip:
+    def test_roundtrip_with_schedulers_and_scalars(self, tmp_path):
+        """bundle_state incl. schedulers + the r8 resume-point scalars
+        round-trips exactly through save/restore (previously only
+        exercised implicitly via CLI smokes)."""
+        from distributed_kfac_pytorch_tpu.scheduler import (
+            KFACParamScheduler,
+        )
+
+        class _KFACStub:
+            damping = 0.003
+            factor_update_freq = 1
+            inv_update_freq = 10
+
+        def make_sched():
+            return KFACParamScheduler(
+                _KFACStub(), damping_alpha=0.5,
+                damping_schedule=[2, 4], update_freq_alpha=2.0,
+                update_freq_schedule=[3])
+
+        sched = make_sched()
+        sched.step(3)  # advance past schedule points -> nontrivial state
+        params = {'w': jnp.arange(6.0)}
+        tree = ckpt_lib.bundle_state(
+            params, {'momentum': jnp.ones(6)}, {}, {'extra': jnp.ones(2)},
+            schedulers={'kfac': sched},
+            step=37, epoch=3, step_in_epoch=5, data_seed=42)
+        assert tree['schedulers']['kfac'] == sched.state_dict()
+        mgr = ckpt_lib.CheckpointManager(str(tmp_path / 'ck'))
+        mgr.save(0, tree, blocking=True)
+        restored = mgr.restore(0, like=tree)
+        sc = restored['scalars']
+        assert {k: int(v) for k, v in sc.items()} == {
+            'step': 37, 'epoch': 3, 'step_in_epoch': 5, 'data_seed': 42}
+        np.testing.assert_array_equal(restored['params']['w'],
+                                      np.arange(6.0))
+        np.testing.assert_array_equal(restored['opt_state']['momentum'],
+                                      np.ones(6))
+        np.testing.assert_array_equal(restored['extra_vars']['extra'],
+                                      np.ones(2))
+        # scheduler state restores into a fresh scheduler and the
+        # derived params match the saved scheduler's exactly
+        fresh = make_sched()
+        fresh.load_state_dict(jax.tree.map(
+            lambda x: x.item() if hasattr(x, 'item') else x,
+            restored['schedulers']['kfac']))
+        assert fresh.params() == sched.params()
+        mgr.close()
+
+
 class TestAsyncCheckpoint:
     def test_async_save_then_restore_roundtrip(self, tmp_path):
         """save() is async by default (round-2 VERDICT #8): it returns
