@@ -74,6 +74,7 @@ def run_one(use_kfac: bool, args, data):
         warmup_epochs=args.warmup, lr_decay=args.lr_decay,
         workers=1,
         kfac_inv_update_freq=args.kfac_update_freq if use_kfac else 0,
+        inv_pipeline_chunks=args.inv_pipeline_chunks,
         kfac_cov_update_freq=1, damping=args.damping,
         kl_clip=0.001, eigh_method=args.eigh_method,
         eigh_polish_iters=args.eigh_polish_iters,
@@ -268,6 +269,13 @@ def main(argv=None):
     p.add_argument('--warmup', type=float, default=2)
     p.add_argument('--lr-decay', type=int, nargs='+', default=[15, 23])
     p.add_argument('--kfac-update-freq', type=int, default=10)
+    p.add_argument('--inv-pipeline-chunks', type=int, default=1,
+                   help='pipelined inverse firing (r9): fire the '
+                        'inverse work in K cost-balanced chunks across '
+                        'each cadence window — the end-of-window drift '
+                        'A/B arm for the step-time-uniformity knob '
+                        '(chunked firings see fresher factors but '
+                        'layer inverses are no longer simultaneous)')
     p.add_argument('--damping', type=float, default=0.003)
     # KFACParamScheduler knobs (the round-3 analysis prescribed a
     # damping/update-freq schedule for the conv/BN study; VERDICT r3 #6).
@@ -374,6 +382,7 @@ def main(argv=None):
         'batch_size': args.batch_size,
         'label_noise': args.label_noise,
         'damping': args.damping,
+        'inv_pipeline_chunks': args.inv_pipeline_chunks,
         'target_val_acc': round(target, 4),
     }
     if args.only:

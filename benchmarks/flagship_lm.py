@@ -255,8 +255,36 @@ def run_phase(args):
             parts[f'{dim}x{k}_{method}'] = round(ms, 2)
             total_ms += ms
             del stack
-        emit({'phase_result': round(total_ms, 2),
-              'bucket_parts': parts})
+        out = {'phase_result': round(total_ms, 2),
+               'bucket_parts': parts}
+        if args.inv_pipeline_chunks > 1:
+            # Firing-spread leg (r9): project the pipelined per-chunk
+            # firing costs from the MEASURED per-bucket ms — the same
+            # per-matrix granularity + LPT packer the runtime plan
+            # uses (and the 'refinable from measured bucket_parts'
+            # hook: these parts are exactly what inv_pipeline_costs
+            # accepts). max_chunk_ms is the projected residual spike;
+            # spike_reduction is the step-time-uniformity win the
+            # on-chip rerun must confirm (PERF.md r9 decision rule).
+            from distributed_kfac_pytorch_tpu.preconditioner import (
+                plan_inverse_chunks)
+            kc = args.inv_pipeline_chunks
+            items = []
+            for key, part_ms in parts.items():
+                cnt = int(key.rsplit('_', 1)[0].split('x')[1])
+                items += [((key, i), part_ms / cnt)
+                          for i in range(cnt)]
+            plan = plan_inverse_chunks(items, kc)
+            loads = [0.0] * kc
+            for key, cost in items:
+                loads[plan[key]] += cost
+            out['firing_spread'] = {
+                'chunks': kc,
+                'chunk_ms': [round(v, 2) for v in loads],
+                'max_chunk_ms': round(max(loads), 2),
+                'monolithic_ms': round(total_ms, 2),
+                'spike_reduction': round(total_ms / max(loads), 2)}
+        emit(out)
         return
 
     if mode == 'sgd':
@@ -328,6 +356,8 @@ def spawn_phase(args, phase, inverse_method=None):
         cmd += ['--inverse-method', inverse_method]
     if args.attn_block_size:
         cmd += ['--attn-block-size', str(args.attn_block_size)]
+    if args.inv_pipeline_chunks > 1:
+        cmd += ['--inv-pipeline-chunks', str(args.inv_pipeline_chunks)]
     try:
         out = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=2400, cwd=REPO)
@@ -369,7 +399,7 @@ def main(argv=None):
                    help='precondition-contraction operand dtype (KFAC '
                         'precond_compute_dtype; default None = the '
                         'bit-identical legacy fp32-upcast path). bf16 '
-                        'is the r6 A/B leg targeting the +18% '
+                        'is the r6 A/B leg targeting the +18%% '
                         'every-step precondition tax; pair with '
                         '--bf16-inverses for the bf16-resident read.')
     p.add_argument('--attn-block-size', type=int, default=None,
@@ -388,6 +418,13 @@ def main(argv=None):
                         'bf16-resident inverses) — isolates the '
                         'every-step precondition tax per contraction '
                         'dtype without re-measuring the shared legs')
+    p.add_argument('--inv-pipeline-chunks', type=int, default=1,
+                   help='r9 firing-spread leg: with K > 1 the firing '
+                        'phase additionally projects the pipelined '
+                        'per-chunk firing costs from the measured '
+                        'bucket_parts (LPT per-matrix packing, the '
+                        'runtime plan) — max_chunk_ms is the residual '
+                        'spike a pipelined window pays per step')
     p.add_argument('--phase', default=None,
                    help='internal: run one phase in this process')
     args = p.parse_args(argv)

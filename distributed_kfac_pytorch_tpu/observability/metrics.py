@@ -40,11 +40,15 @@ import jax
 import jax.numpy as jnp
 
 # Scalar metric slots (beyond the per-model 'bucket_norms' subtree).
+# 'inv_chunk_firings' counts pipelined chunk firings (r9: a chunk
+# firing covers 1/k of the factor set, so it is tallied separately
+# from the monolithic 'inv_updates' — k chunk firings = one window's
+# worth of inverse work).
 METRIC_KEYS = ('damping', 'nu', 'grad_norm', 'precond_norm',
-               'factor_updates', 'inv_updates', 'nonfinite_skips',
-               'eig_clipped')
-_INT_KEYS = ('factor_updates', 'inv_updates', 'nonfinite_skips',
-             'eig_clipped')
+               'factor_updates', 'inv_updates', 'inv_chunk_firings',
+               'nonfinite_skips', 'eig_clipped')
+_INT_KEYS = ('factor_updates', 'inv_updates', 'inv_chunk_firings',
+             'nonfinite_skips', 'eig_clipped')
 
 
 def shape_key(shape) -> str:
@@ -64,12 +68,14 @@ def init_metrics(bucket_keys) -> dict:
 
 
 def update_metrics(prev: dict, *, damping, stats: dict, did_factor,
-                   did_inv, factor_finite, eig_clipped) -> dict:
+                   did_inv, factor_finite, eig_clipped,
+                   did_chunk=0) -> dict:
     """One traced metrics-state transition (call inside the step).
 
     ``stats`` comes from the preconditioner's ``with_stats`` pass
     (``nu`` / ``grad_norm`` / ``precond_norm`` / ``bucket_norms``);
-    ``did_factor`` / ``did_inv`` are 0/1 cadence indicators and
+    ``did_factor`` / ``did_inv`` / ``did_chunk`` are 0/1 cadence
+    indicators (``did_chunk``: a pipelined chunk firing, r9) and
     ``factor_finite`` the 0/1 finiteness of this step's candidate
     factors (1 on non-factor steps).
     """
@@ -80,6 +86,9 @@ def update_metrics(prev: dict, *, damping, stats: dict, did_factor,
         'precond_norm': stats['precond_norm'].astype(jnp.float32),
         'factor_updates': prev['factor_updates'] + did_factor,
         'inv_updates': prev['inv_updates'] + did_inv,
+        'inv_chunk_firings': (prev.get('inv_chunk_firings',
+                                       jnp.zeros((), jnp.int32))
+                              + did_chunk),
         'nonfinite_skips': (prev['nonfinite_skips']
                             + did_factor * (1 - factor_finite)),
         'eig_clipped': jnp.asarray(eig_clipped, jnp.int32),

@@ -41,6 +41,63 @@ def _fmt(v: float, unit: str = '') -> str:
     return f'{v:.4g}{unit}'
 
 
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Linear-interpolated percentile of an ascending-sorted list."""
+    if not sorted_vals:
+        return float('nan')
+    pos = (len(sorted_vals) - 1) * q / 100.0
+    lo = int(math.floor(pos))
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (
+        pos - lo)
+
+
+def step_time_distribution(records: list[dict]) -> dict | None:
+    """Step-time percentiles + outlier attribution by fired stage.
+
+    Backend-independent (host dispatch wall time per step, recorded by
+    the engine for every step record): p50/p95/p99/max ms/iter, the
+    max/median spike ratio — the step-time-uniformity metric the
+    pipelined inverse firing (r9) targets — and, for outlier steps
+    (> 2x the median, the firing-spike signature), counts and mean ms
+    per fired stage ('factor' / 'inverse' / 'chunk<j>' / plain).
+    """
+    host = [(r['host_step_ms'], r.get('fired', 'plain'))
+            for r in records
+            if r.get('kind') == 'step' and 'host_step_ms' in r]
+    if not host:
+        return None
+    vals = sorted(v for v, _ in host)
+    p50 = _percentile(vals, 50)
+    dist = {
+        'n_steps': len(vals),
+        'p50_ms': p50,
+        'p95_ms': _percentile(vals, 95),
+        'p99_ms': _percentile(vals, 99),
+        'max_ms': vals[-1],
+        'max_over_median': (vals[-1] / p50 if p50 else float('nan')),
+    }
+    threshold = 2.0 * p50
+    dist['outlier_threshold_ms'] = threshold
+    stages: dict[str, dict] = {}
+    for v, f in host:
+        s = stages.setdefault(f, {'count': 0, 'total_ms': 0.0,
+                                  'outliers': 0, 'outlier_ms': 0.0})
+        s['count'] += 1
+        s['total_ms'] += v
+        if v > threshold:
+            s['outliers'] += 1
+            s['outlier_ms'] += v
+    dist['stages'] = {
+        f: {'count': s['count'],
+            'mean_ms': s['total_ms'] / s['count'],
+            'outliers': s['outliers'],
+            'outlier_mean_ms': (s['outlier_ms'] / s['outliers']
+                                if s['outliers'] else float('nan'))}
+        for f, s in stages.items()}
+    return dist
+
+
 def _series(records, key):
     out = []
     for r in records:
@@ -105,12 +162,14 @@ def summarize(records: list[dict]) -> dict:
         'stages': stages,
         'host_step_ms': (sum(host_ms) / len(host_ms) if host_ms
                          else float('nan')),
+        'step_time': step_time_distribution(records),
         'loss': loss,
         'precond_ratio': ratio,
         'damping': damping,
         'nu': nu,
         'factor_updates': _num(last.get('kfac/factor_updates')),
         'inv_updates': _num(last.get('kfac/inv_updates')),
+        'inv_chunk_firings': _num(last.get('kfac/inv_chunk_firings')),
         'nonfinite_skips': _num(last.get('kfac/nonfinite_skips')),
         'eig_clipped': _num(last.get('kfac/eig_clipped')),
         'bucket_norms': buckets,
@@ -132,6 +191,25 @@ def print_report(s: dict, out=None) -> None:
     w()
     w('-- step time --')
     w(f"host dispatch: {_fmt(s['host_step_ms'], ' ms/step')}")
+    d = s.get('step_time')
+    if d:
+        w(f"distribution ({d['n_steps']} steps): "
+          f"p50 {_fmt(d['p50_ms'])}  p95 {_fmt(d['p95_ms'])}  "
+          f"p99 {_fmt(d['p99_ms'])}  max {_fmt(d['max_ms'])} ms/iter  "
+          f"(max/median {_fmt(d['max_over_median'], 'x')})")
+        outliers = {f: v for f, v in d['stages'].items()
+                    if v['outliers']}
+        if outliers:
+            w(f"outlier steps (> {_fmt(d['outlier_threshold_ms'])} ms "
+              '= 2x median), by fired stage:')
+            for f in sorted(outliers):
+                v = outliers[f]
+                w(f'  {f:<10} x{v["outliers"]:<5} '
+                  f'mean {_fmt(v["outlier_mean_ms"], " ms")}  '
+                  f'(stage mean over all its steps: '
+                  f'{_fmt(v["mean_ms"], " ms")})')
+        else:
+            w('no outlier steps (> 2x median).')
     if s['stages']:
         w('stage                              mean ms    total ms  calls')
         for k in sorted(s['stages']):
@@ -145,7 +223,8 @@ def print_report(s: dict, out=None) -> None:
     w()
     w('-- K-FAC health --')
     w(f"factor updates: {_fmt(s['factor_updates'])}   "
-      f"inverse updates: {_fmt(s['inv_updates'])}")
+      f"inverse updates: {_fmt(s['inv_updates'])}   "
+      f"chunk firings: {_fmt(s['inv_chunk_firings'])}")
     w(f"non-finite skips: {_fmt(s['nonfinite_skips'])}   "
       f"eigenvalues at clip floor: {_fmt(s['eig_clipped'])}")
     for name, series in (('loss', s['loss']),
@@ -203,6 +282,22 @@ def main(argv=None) -> int:
         print(f'error: {e}', file=sys.stderr)
         return 1
     print_report(summarize(records))
+    from distributed_kfac_pytorch_tpu.observability.sink import (
+        incarnation_paths,
+        read_incarnation,
+    )
+    prev = incarnation_paths(args.jsonl)
+    if prev:
+        print()
+        print(f'-- {len(prev)} surviving prior incarnation(s) '
+              '(newest first; each readable with this report CLI) --')
+        for path in prev:
+            try:
+                n = len(read_incarnation(path))
+                note = f'{n} records'
+            except (OSError, ValueError) as e:
+                note = f'unreadable: {e}'
+            print(f'  {path}  ({note})')
     return 0
 
 

@@ -18,15 +18,21 @@ Design constraints (ISSUE r7):
     a full segment is renamed to ``<path>.<n>`` and a fresh one starts.
 
 Record schema (``schema`` = :data:`SCHEMA_VERSION`; the reader accepts
-v1 files too — v2 only *adds* the ``event`` kind, for the r8
-resilience subsystem):
+v1/v2 files too — v2 only *added* the ``event`` kind for the r8
+resilience subsystem, v3 only adds the optional step ``fired`` field
+for r9 step-time attribution):
 
-  {"schema": 2, "kind": "step",  "step": int, "wall_time": float,
-   "host_step_ms": float?, "metrics": {flat name -> float}}
-  {"schema": 2, "kind": "epoch", "epoch": int, "wall_time": float,
+  {"schema": 3, "kind": "step",  "step": int, "wall_time": float,
+   "host_step_ms": float?, "fired": str?,
+   "metrics": {flat name -> float}}
+                     # "fired": the heaviest statically-gated K-FAC
+                     # stage this step ran ('factor' / 'inverse' /
+                     # 'chunk<j>'); absent on plain steps. The report's
+                     # step-time outlier attribution keys on it.
+  {"schema": 3, "kind": "epoch", "epoch": int, "wall_time": float,
    "metrics": {...averaged epoch metrics...}, "trace": {stage: {...}}}
-  {"schema": 2, "kind": "meta",  "wall_time": float, "meta": {...}}
-  {"schema": 2, "kind": "event", "event": str, "wall_time": float,
+  {"schema": 3, "kind": "meta",  "wall_time": float, "meta": {...}}
+  {"schema": 3, "kind": "event", "event": str, "wall_time": float,
    "data": {...}}    # resilience: preemption / checkpoint_save (with
                      # latency_ms) / restore — always kept (no
                      # interval thinning) and flushed immediately,
@@ -41,12 +47,16 @@ from __future__ import annotations
 import json
 import math
 import os
+import re
 import time
 from typing import Any
 
-SCHEMA_VERSION = 2
-ACCEPTED_SCHEMAS = (1, 2)
+SCHEMA_VERSION = 3
+ACCEPTED_SCHEMAS = (1, 2, 3)
 RECORD_KINDS = ('meta', 'step', 'epoch', 'event')
+# Dead incarnations kept per metrics path (<path>.prev.1 newest ..
+# .prev.N oldest); older ones are pruned on relaunch.
+PREV_INCARNATIONS_KEPT = 5
 
 
 def to_float(x) -> float:
@@ -72,8 +82,11 @@ def validate_record(rec: Any) -> None:
         raise ValueError(f'unknown record kind {kind!r}')
     if not isinstance(rec.get('wall_time'), (int, float)):
         raise ValueError('missing/invalid wall_time')
-    if kind == 'step' and not isinstance(rec.get('step'), int):
-        raise ValueError('step record missing integer step')
+    if kind == 'step':
+        if not isinstance(rec.get('step'), int):
+            raise ValueError('step record missing integer step')
+        if 'fired' in rec and not isinstance(rec['fired'], str):
+            raise ValueError('step record fired is not a string')
     if kind == 'epoch' and not isinstance(rec.get('epoch'), int):
         raise ValueError('epoch record missing integer epoch')
     if kind == 'event':
@@ -109,6 +122,97 @@ def _rotated_segments(path: str) -> list[str]:
     return out
 
 
+def incarnation_paths(path: str) -> list[str]:
+    """Surviving dead incarnations ``<path>.prev.1 .. .N``, newest
+    first (``.prev.1`` is the most recently deceased run). Legacy
+    single-slot ``<path>.prev`` files (pre-r9 layout) are listed last.
+    Read entries with :func:`read_incarnation` — chained entries are
+    complete ``read_jsonl`` streams (rotated segments ride along as
+    ``<path>.prev.<n>.<m>``), but a legacy ``.prev`` entry must be
+    read as a single file (see ``read_incarnation``).
+    """
+    out = []
+    n = 1
+    while os.path.exists(f'{path}.prev.{n}'):
+        out.append(f'{path}.prev.{n}')
+        n += 1
+    if os.path.exists(f'{path}.prev'):
+        out.append(f'{path}.prev')
+    return out
+
+
+def _move_incarnation(src: str, dst: str) -> None:
+    """Move one incarnation (live file + its rotated segments)."""
+    for seg in _rotated_segments(dst):
+        os.unlink(seg)
+    for seg in _rotated_segments(src):
+        m = re.match(re.escape(src) + r'\.(\d+)$', seg)
+        os.replace(seg, f'{dst}.{m.group(1)}')
+    os.replace(src, dst)
+
+
+def _unlink_incarnation(path: str) -> None:
+    for seg in _rotated_segments(path):
+        os.unlink(seg)
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
+
+
+def _chain_incarnation(path: str) -> None:
+    """Push the existing stream at ``path`` onto the incarnation chain.
+
+    ``<path>.prev.n`` shifts to ``.prev.n+1`` (newest-first chain, each
+    with its rotated segments), the live ``path`` (+ its segments)
+    becomes ``.prev.1``, and incarnations beyond
+    :data:`PREV_INCARNATIONS_KEPT` are pruned oldest-first. A legacy
+    single-slot ``<path>.prev`` (pre-r9 layout) is folded into the
+    chain first so a second relaunch can no longer destroy the first
+    dead incarnation's tail (the r8 layout overwrote it with one
+    ``os.replace``).
+    """
+    if os.path.exists(f'{path}.prev'):
+        # Legacy slot: adopt it as the newest chained incarnation
+        # before the live file claims .prev.1.
+        n = 1
+        while os.path.exists(f'{path}.prev.{n}'):
+            n += 1
+        for i in range(n - 1, 0, -1):
+            _move_incarnation(f'{path}.prev.{i}', f'{path}.prev.{i + 1}')
+        os.replace(f'{path}.prev', f'{path}.prev.1')
+    segs = _rotated_segments(path)
+    if not os.path.exists(path) and not segs:
+        return
+    n = 1
+    while os.path.exists(f'{path}.prev.{n}'):
+        n += 1
+    for i in range(n - 1, 0, -1):
+        _move_incarnation(f'{path}.prev.{i}', f'{path}.prev.{i + 1}')
+    if os.path.exists(path):
+        for seg in segs:
+            m = re.match(re.escape(path) + r'\.(\d+)$', seg)
+            os.replace(seg, f'{path}.prev.1.{m.group(1)}')
+        os.replace(path, f'{path}.prev.1')
+    else:
+        # Crash window: the dead run rotated its live segment away
+        # (flush() renames live -> <path>.N before republishing a
+        # fresh live file) and died in between, leaving rotated
+        # segments with no live file. Those segments alone ARE the
+        # dead incarnation — chain them (newest segment becomes the
+        # chained live slot so read order stays oldest-segments-then-
+        # live) instead of leaving them behind, where the new run's
+        # ``read_jsonl`` would stitch them into a chimeric stream.
+        for seg in segs[:-1]:
+            m = re.match(re.escape(path) + r'\.(\d+)$', seg)
+            os.replace(seg, f'{path}.prev.1.{m.group(1)}')
+        os.replace(segs[-1], f'{path}.prev.1')
+    n = PREV_INCARNATIONS_KEPT + 1
+    while os.path.exists(f'{path}.prev.{n}'):
+        _unlink_incarnation(f'{path}.prev.{n}')
+        n += 1
+
+
 def read_jsonl(path: str, validate: bool = True) -> list[dict]:
     """Load (and by default schema-validate) every record of a run.
 
@@ -122,20 +226,42 @@ def read_jsonl(path: str, validate: bool = True) -> list[dict]:
         raise FileNotFoundError(path)
     records = []
     for p in paths:
-        with open(p) as f:
-            for i, line in enumerate(f):
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError as e:
-                    raise ValueError(f'{p}:{i + 1}: torn/invalid JSON '
-                                     f'line: {e}') from e
-                if validate:
-                    validate_record(rec)
-                records.append(rec)
+        records.extend(_read_jsonl_file(p, validate))
     return records
+
+
+def _read_jsonl_file(p: str, validate: bool) -> list[dict]:
+    records = []
+    with open(p) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f'{p}:{i + 1}: torn/invalid JSON '
+                                 f'line: {e}') from e
+            if validate:
+                validate_record(rec)
+            records.append(rec)
+    return records
+
+
+def read_incarnation(path: str, validate: bool = False) -> list[dict]:
+    """Read one entry of :func:`incarnation_paths`.
+
+    Chained incarnations (``<path>.prev.<n>``) read like any run —
+    their ``.prev.<n>.<m>`` rotated segments stitch in oldest-first. A
+    LEGACY single-slot ``<path>.prev`` (pre-r9 layout) must read the
+    exact file only: r8 never preserved rotated segments, and its
+    ``<path>.prev.<n>`` *neighbors* are chain entries — different
+    runs — that ``read_jsonl``'s segment stitching would wrongly
+    concatenate into the legacy stream.
+    """
+    if path.endswith('.prev'):
+        return _read_jsonl_file(path, validate)
+    return read_jsonl(path, validate)
 
 
 class JsonlMetricsSink:
@@ -186,25 +312,21 @@ class JsonlMetricsSink:
             return
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
-        # A fresh sink owns its path: clear the previous run's rotated
-        # segments, otherwise ``read_jsonl`` would stitch two runs'
-        # (individually schema-valid) records into one chimeric stream
-        # — e.g. on the CLIs' default <log-dir> path. The previous LIVE
-        # file is preserved as ``<path>.prev`` (outside the rotated
-        # namespace, so the reader never stitches it): a relaunch after
-        # preemption reuses the same path, and that tail segment holds
-        # the dead incarnation's final records — its preemption and
-        # forced-save events included — which is exactly the telemetry
-        # a post-mortem needs (r8).
-        try:
-            os.replace(path, f'{path}.prev')
-        except FileNotFoundError:
-            pass
-        for stale in _rotated_segments(path):
-            try:
-                os.unlink(stale)
-            except FileNotFoundError:
-                pass
+        # A fresh sink owns its path: the previous run's stream must
+        # not be stitched into this one (``read_jsonl`` would build a
+        # chimeric stream from two runs' individually-valid records —
+        # e.g. on the CLIs' default <log-dir> path), but it must not be
+        # destroyed either: a relaunch after preemption reuses the same
+        # path, and the dead incarnation's tail holds its final records
+        # — preemption and forced-save events included — exactly the
+        # telemetry a post-mortem needs (r8). The whole prior stream
+        # (live segment + rotations) therefore moves onto the
+        # incarnation chain ``<path>.prev.1`` (newest) .. ``.prev.N``,
+        # bounded at PREV_INCARNATIONS_KEPT with the oldest pruned —
+        # the r8 single-slot ``<path>.prev`` let a SECOND relaunch
+        # silently overwrite the first incarnation (r9 satellite fix).
+        # ``observability.report`` lists the surviving incarnations.
+        _chain_incarnation(path)
         if meta is not None:
             self._pending.append({'schema': SCHEMA_VERSION,
                                   'kind': 'meta',
@@ -214,11 +336,16 @@ class JsonlMetricsSink:
     # -- enqueue (step path: no syncs) ---------------------------------
 
     def step_record(self, step: int, metrics: dict,
-                    host_step_ms: float | None = None) -> None:
+                    host_step_ms: float | None = None,
+                    fired: str | None = None) -> None:
         """Enqueue one step's metrics (every ``interval``-th kept).
 
         ``metrics`` values may be device scalars; an async copy to host
         is kicked off here and the float conversion happens at drain.
+        ``fired`` labels the heaviest statically-gated K-FAC stage the
+        step ran ('factor' / 'inverse' / 'chunk<j>', see
+        ``engine.fired_stage``) — the report's step-time outlier
+        attribution keys on it.
         """
         self._step_seen += 1
         if not self.enabled or (self._step_seen - 1) % self.interval:
@@ -228,6 +355,8 @@ class JsonlMetricsSink:
                'metrics': dict(metrics)}
         if host_step_ms is not None:
             rec['host_step_ms'] = float(host_step_ms)
+        if fired is not None:
+            rec['fired'] = str(fired)
         for v in rec['metrics'].values():
             copy_async = getattr(v, 'copy_to_host_async', None)
             if copy_async is not None:

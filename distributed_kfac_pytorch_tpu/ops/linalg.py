@@ -21,6 +21,22 @@ import jax.numpy as jnp
 from distributed_kfac_pytorch_tpu.observability import profiling
 
 
+def decomposition_cost(dim: int, count: int = 1) -> float:
+    """Cost proxy for decomposing ``count`` SPD matrices of ``dim``.
+
+    The classic ``dim^3`` FLOP scaling every dense factorization here
+    shares (Cholesky, Newton–Schulz, the warm-polish matmuls, eigh) —
+    the same proxy the KAISA work balancer uses
+    (``assignment_strategy='compute'``, reference
+    preconditioner.py:625-628). Used by the pipelined-firing chunk
+    planner (``KFAC.inverse_chunk_plan``) to bin-pack same-dim bucket
+    stacks into cost-balanced chunks; per-dim *measured* firing costs
+    (the ``bucket_parts`` ms of a flagship firing leg) refine it via
+    ``KFAC(inv_pipeline_costs={dim: ms})``.
+    """
+    return float(count) * float(dim) ** 3
+
+
 def get_eigendecomp(x: jax.Array, clip: float | None = 0.0
                     ) -> tuple[jax.Array, jax.Array]:
     """Symmetric eigendecomposition in fp32 with eigenvalue clipping.

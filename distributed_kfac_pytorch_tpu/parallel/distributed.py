@@ -359,6 +359,88 @@ class DistributedKFAC:
                      for (name, _w) in plan.slot)
             for dim, plan in self.assignment.buckets.items()
             if kfac.method_for_dim(dim) == 'eigen'}
+        # Pipelined inverse firing (inv_pipeline_chunks > 1): static
+        # chunk plan over within-slice slot offsets; None at k == 1.
+        self._chunk_plan = self._plan_firing_chunks()
+
+    def _plan_firing_chunks(self) -> dict | None:
+        """Static SPMD chunk plan for pipelined inverse firing.
+
+        The SPMD work unit is one *within-slice slot offset* ``m`` of a
+        dim bucket: every device decomposes the slot at its own
+        ``col * slots_per_col + m`` position, so firing offset ``m``
+        costs each device exactly one dim^3 decomposition and the
+        in-group all_gather moves exactly the fired slots — per-device
+        load (the spike the pipelining smears) splits in these units.
+        Greedy LPT (``preconditioner.plan_inverse_chunks``, the same
+        balancer as the single-chip per-matrix plan) packs the offsets
+        plus the grouped/diagonal items into ``k`` chunks. Returns
+        ``{'offsets': {dim: {chunk: (m, ...)}}, 'diag': {name: chunk},
+        'grouped': {name: chunk}}``; ``None`` when ``k == 1``.
+        """
+        kfac = self.kfac
+        k = kfac.inv_pipeline_chunks
+        if k == 1:
+            return None
+        from distributed_kfac_pytorch_tpu.ops.linalg import (
+            decomposition_cost,
+        )
+        from distributed_kfac_pytorch_tpu.preconditioner import (
+            measured_unit_scale,
+            plan_inverse_chunks,
+        )
+        measured = kfac.inv_pipeline_costs or {}
+        # Same unit discipline as KFAC.inverse_chunk_items (shared
+        # helper): a measurement dict must cover every bucket dim, and
+        # the tiny grouped/diagonal proxy costs rescale into the
+        # measured unit. The SPMD work unit is a slot offset, so the
+        # per-dim unit count is slots_per_col.
+        proxy_scale = measured_unit_scale(
+            measured,
+            {dim: plan.slots_per_col
+             for dim, plan in self.assignment.buckets.items()},
+            'inverse bucket dim of this mesh layout')
+        items: list[tuple[tuple, float]] = []
+        for dim in sorted(self.assignment.buckets):
+            plan = self.assignment.buckets[dim]
+            unit = (float(measured[dim]) / plan.slots_per_col
+                    if dim in measured else decomposition_cost(dim))
+            for m in range(plan.slots_per_col):
+                items.append((('slot', dim, m), unit))
+        for name in self.assignment.diag_layers:
+            items.append((('diag', name),
+                          proxy_scale
+                          * float(self._factor_dims[name][0])))
+        for name in self.assignment.grouped_layers:
+            ng = kfac.specs[name].feature_group_count
+            a_dim, g_dim = self._factor_dims[name]
+            items.append((('grouped', name),
+                          proxy_scale
+                          * (ng * decomposition_cost(a_dim)
+                             + ng * decomposition_cost(g_dim))))
+        if k > len(items):
+            raise ValueError(
+                f'inv_pipeline_chunks={k} exceeds the {len(items)} '
+                'inverse work items of this mesh layout (bucket slot '
+                'offsets + grouped/diagonal layers); lower it to at '
+                f'most {len(items)}')
+        assignment = plan_inverse_chunks(items, k)
+        offsets: dict[int, dict[int, tuple[int, ...]]] = {
+            dim: {} for dim in self.assignment.buckets}
+        diag: dict[str, int] = {}
+        grouped: dict[str, int] = {}
+        for key, j in assignment.items():
+            if key[0] == 'slot':
+                offsets[key[1]].setdefault(j, [])
+                offsets[key[1]][j].append(key[2])
+            elif key[0] == 'diag':
+                diag[key[1]] = j
+            else:
+                grouped[key[1]] = j
+        offsets = {dim: {j: tuple(sorted(ms))
+                         for j, ms in per.items()}
+                   for dim, per in offsets.items()}
+        return {'offsets': offsets, 'diag': diag, 'grouped': grouped}
 
     def _layer_is_mixed(self, name: str) -> bool:
         """Dense layer with exactly one eigen side ('auto' straddle)."""
@@ -457,7 +539,10 @@ class DistributedKFAC:
                        for name in self.assignment.grouped_layers}
         state = {'step': base['step'], 'factors': base['factors'],
                  'inv_stacks': stacks, 'diag_inv': diag_inv,
-                 'grouped_inv': grouped_inv}
+                 'grouped_inv': grouped_inv,
+                 # Pipelined-firing position (next chunk due; constant 0
+                 # under inv_pipeline_chunks=1) — see KFAC.init_state.
+                 'inv_chunk_phase': base['inv_chunk_phase']}
         if self.kfac.collect_metrics:
             # Replicated on-device metrics scalars (the single-chip
             # slot; state_pspecs' default P() covers them).
@@ -560,8 +645,42 @@ class DistributedKFAC:
         eye = jnp.eye(plan.dim, dtype=jnp.float32)
         return jnp.stack([eye if m is None else m for m in mats])
 
+    def _build_bucket_substack(self, factors, plan: BucketPlan,
+                               offs) -> jax.Array:
+        """Fired-offsets-only factor stack for a partial chunk firing.
+
+        A chunk that fires ``offs`` ⊂ [0, slots_per_col) of a bucket
+        needs only those slots' matrices; stacking the whole bucket
+        (``_build_bucket_stack``) would pay the full O(n_slots · dim²)
+        assembly on every chunk phase — k× the monolithic build cost
+        per window (measured as the dominant share of the pipelined
+        legs' per-firing overhead on the CPU bench). Layout is
+        ``[(row, col, m ∈ offs)]`` so a device's fired slots are the
+        contiguous ``(row · n_cols + col) · len(offs)`` slice — the
+        same dynamic_slice program shape as the whole-slice path.
+        Across one window every slot is built exactly once, matching
+        the monolithic firing's total assembly work.
+        """
+        S = plan.slots_per_row
+        s = plan.slots_per_col
+        by_global = {}
+        for (name, which), slot_idx in plan.slot.items():
+            g = self.assignment.layer_row[name] * S + slot_idx
+            by_global[g] = factors[name][which]
+        eye = jnp.eye(plan.dim, dtype=jnp.float32)
+        mats = []
+        for r in range(self.n_rows):
+            for c in range(self.n_cols):
+                for m in offs:
+                    mat = by_global.get(r * S + c * s + int(m))
+                    mats.append(eye if mat is None
+                                else mat.astype(jnp.float32))
+        return jnp.stack(mats)
+
     @profiling.scope('kfac/inverses')
-    def _spmd_update_inverses(self, factors, damping, prev_stacks=None):
+    def _spmd_update_inverses(self, factors, damping, prev_stacks=None,
+                              chunk: int | None = None,
+                              prev_diag=None, prev_grouped=None):
         """Sharded batched inverse computation + in-group all_gather.
 
         Each device decomposes its ``slots_per_col`` slice of its row's
@@ -576,65 +695,179 @@ class DistributedKFAC:
         ``kfac_ig``-sharded and slot layout is static, so the slice
         aligns with the factors being decomposed) and runs the
         warm-start polish instead of a cold eigh (eigh_method 'auto').
+
+        ``chunk``: pipelined firing — decompose only the slot offsets /
+        diag / grouped items ``_plan_firing_chunks`` assigns to this
+        chunk, passing everything else through from ``prev_stacks`` /
+        ``prev_diag`` / ``prev_grouped`` unchanged (local row shards
+        in, local row shards out). A bucket whose offsets are split
+        across chunks fires partially: each device decomposes only its
+        fired slots (a static-offset gather), the in-group all_gather
+        moves only those slots, and the results scatter into the
+        stored stack at static indices — no collective ever touches a
+        non-fired slot, so the amortized COMM_OPT gather shrinks by
+        exactly the chunk fraction.
+
+        Scope of the per-chunk-group program shape: it applies to the
+        in-run firing path (``prev_stacks`` present), where it makes a
+        frozen-factor pipelined window bit-identical to a monolithic
+        firing WITHIN this SPMD path. The eager rebuild
+        (``prev_stacks=None`` — ``recompute_inverses`` after a
+        factor-only/layout-mismatch restore) keeps the historical
+        whole-slice program even at ``inv_pipeline_chunks > 1``: there
+        are no stored shards to merge into, and no bitwise contract
+        spans a rebuild — a rebuilt basis differs from the in-run one
+        by the same slice-instability ulps regardless (single-chip vs
+        SPMD were never bitwise-comparable either; their stacks batch
+        different layer sets by construction). Each slot is simply
+        overwritten next time its chunk fires.
         """
         kfac = self.kfac
+        chunk_plan = self._chunk_plan
         row = jax.lax.axis_index(INV_GROUP_AXIS)
         col = jax.lax.axis_index(GRAD_WORKER_AXIS)
         eigh_method = resolve_eigh_method(kfac.eigh_method)
         stacks = {}
         for dim, plan in self.assignment.buckets.items():
-            full = self._build_bucket_stack(factors, plan)
             s = plan.slots_per_col
-            local = jax.lax.dynamic_slice(
-                full, (row * plan.slots_per_row + col * s, 0, 0),
-                (s, dim, dim))
+            # Offset groups to fire this call. Pipelined mode (k > 1)
+            # ALWAYS decomposes per chunk group — a monolithic firing
+            # runs every group, a chunk firing exactly one — so the
+            # per-slot computation is the same trace fragment either
+            # way and the frozen-window bit-identity contract is
+            # structural (the backend's batched kernels are NOT
+            # slice-stable across batch sizes: a different vmap width
+            # rotates Q by O(1) within near-degenerate clusters,
+            # observed on CPU). The eager rebuild path (no prev stacks
+            # to merge into, ``recompute_inverses``) and k == 1 keep
+            # the historical whole-slice program.
+            if chunk_plan is None or prev_stacks is None:
+                groups = [None] if chunk is None else None
+            else:
+                per = chunk_plan['offsets'][dim]
+                if chunk is not None:
+                    fired = per.get(chunk, ())
+                    groups = [fired] if fired else []
+                else:
+                    groups = [per[j] for j in sorted(per)]
+            if groups is None:
+                raise ValueError(
+                    'inv_chunk requires inv_pipeline_chunks > 1 and '
+                    'stored inverse stacks')
+            if not groups:
+                # Not this chunk's work: the stored (row-local) stack
+                # passes through untouched — no decomposition, no
+                # in-group all_gather.
+                stacks[str(dim)] = prev_stacks[str(dim)]
+                continue
+            # The whole-bucket stack is built ONLY for whole-slice
+            # groups (the historical program shape); partial groups
+            # assemble just their fired slots (_build_bucket_substack),
+            # so a window's k chunk firings pay the monolithic firing's
+            # total assembly cost, not k times it.
+            full = (self._build_bucket_stack(factors, plan)
+                    if any(g is None or len(g) == s for g in groups)
+                    else None)
             bucket_method = kfac.method_for_dim(dim)
-            if bucket_method == 'eigen':
-                q_prev = None
-                if prev_stacks is not None and eigh_method == 'auto':
-                    # Inside shard_map the stored stack is the *local*
-                    # row shard (slots_per_row, dim, dim): index by the
-                    # in-row column offset only.
-                    q_prev = jax.lax.dynamic_slice(
-                        prev_stacks[str(dim)]['Q'].astype(jnp.float32),
-                        (col * s, 0, 0), (s, dim, dim))
-                q, d = linalg.batched_eigh(
-                    local, eigh_method, clip=0.0, q_prev=q_prev,
-                    polish_iters=kfac.eigh_polish_iters)
-                entry = {}
-                if self._bucket_mixed.get(dim):
-                    # Bake this firing's damping into the mixed layers'
-                    # eigen sides (whole bucket for vmap uniformity —
-                    # the extra d^3 per pure-eigen slot is noise next to
-                    # the polish). Same λ as the baked big-side
-                    # inverses: the split operator stays symmetric
-                    # under damping schedules.
-                    inv = jax.vmap(
-                        lambda qi, di: linalg.eigen_side_inverse(
-                            qi, di, damping))(q, d)
+            prev_entry = (prev_stacks[str(dim)]
+                          if prev_stacks is not None else None)
+            # A group of all s offsets is the whole contiguous slice —
+            # encode as offs=None (dynamic_slice + full replace, the
+            # historical program shape).
+            cur = dict(prev_entry) if prev_entry is not None else {}
+            for group in groups:
+                offs = (None if group is None or len(group) == s
+                        else np.asarray(group, np.int32))
+
+                def fired_factors(offs=offs):
+                    """This device's fired factor matrices (contiguous
+                    dynamic_slice of the whole-bucket stack for a
+                    whole-slice group, or of the fired-only substack
+                    when partial)."""
+                    if offs is None:
+                        return jax.lax.dynamic_slice(
+                            full,
+                            (row * plan.slots_per_row + col * s, 0, 0),
+                            (s, plan.dim, plan.dim))
+                    sub = self._build_bucket_substack(
+                        factors, plan, offs)
+                    u = len(offs)
+                    return jax.lax.dynamic_slice(
+                        sub, ((row * self.n_cols + col) * u, 0, 0),
+                        (u, plan.dim, plan.dim))
+
+                def local_slots(src, offs=offs):
+                    """This device's fired slots of a ROW-LOCAL stored
+                    stack (contiguous slice for a whole-slice group;
+                    static-offset gather when partial)."""
+                    base = col * s
+                    if offs is None:
+                        start = (base,) + (0,) * (src.ndim - 1)
+                        return jax.lax.dynamic_slice(
+                            src, start, (s,) + src.shape[1:])
+                    return jnp.take(src, base + jnp.asarray(offs),
+                                    axis=0)
+
+                def merge(computed, key, offs=offs):
+                    """all_gather this group's slots over the grad
+                    workers and merge into the stored row stack (full
+                    replace for a whole-slice group; static-index
+                    scatter when partial)."""
                     with profiling.annotate(
                             'kfac/comm/inverse_allgather'):
-                        entry['inv'] = jax.lax.all_gather(
-                            inv, GRAD_WORKER_AXIS,
-                            tiled=True).astype(kfac.inv_dtype)
-                with profiling.annotate('kfac/comm/inverse_allgather'):
-                    q = jax.lax.all_gather(
-                        q, GRAD_WORKER_AXIS, tiled=True)
-                    d = jax.lax.all_gather(
-                        d, GRAD_WORKER_AXIS, tiled=True)
-                stacks[str(dim)] = {'Q': q.astype(kfac.inv_dtype),
-                                    'd': d.astype(kfac.inv_dtype),
-                                    **entry}
-            else:
-                inv = pallas_kernels.damped_inverse_stack(
-                    local, damping, bucket_method,
-                    iters=kfac.newton_iters)
-                with profiling.annotate('kfac/comm/inverse_allgather'):
-                    inv = jax.lax.all_gather(
-                        inv, GRAD_WORKER_AXIS, tiled=True)
-                stacks[str(dim)] = {'inv': inv.astype(kfac.inv_dtype)}
+                        g = jax.lax.all_gather(
+                            computed, GRAD_WORKER_AXIS, tiled=True)
+                    g = g.astype(kfac.inv_dtype)
+                    if offs is None:
+                        cur[key] = g
+                        return
+                    # Gathered layout: col c's fired slots sit at
+                    # g[c*u:(c+1)*u] — their in-row slot indices
+                    # c*s + offs are static, so the merge is one
+                    # static scatter into the stored shard.
+                    idx = np.concatenate(
+                        [c * s + offs for c in range(self.n_cols)])
+                    cur[key] = cur[key].at[idx].set(g)
+
+                local = fired_factors()
+                if bucket_method == 'eigen':
+                    q_prev = None
+                    if prev_entry is not None and eigh_method == 'auto':
+                        # Inside shard_map the stored stack is the
+                        # *local* row shard (slots_per_row, dim, dim):
+                        # index by the in-row column offset only
+                        # (local_slots does).
+                        q_prev = local_slots(
+                            prev_entry['Q'].astype(jnp.float32))
+                    q, d = linalg.batched_eigh(
+                        local, eigh_method, clip=0.0, q_prev=q_prev,
+                        polish_iters=kfac.eigh_polish_iters)
+                    if self._bucket_mixed.get(dim):
+                        # Bake this firing's damping into the mixed
+                        # layers' eigen sides (whole group for vmap
+                        # uniformity — the extra d^3 per pure-eigen
+                        # slot is noise next to the polish). Same λ as
+                        # the baked big-side inverses: the split
+                        # operator stays symmetric under damping
+                        # schedules.
+                        inv = jax.vmap(
+                            lambda qi, di: linalg.eigen_side_inverse(
+                                qi, di, damping))(q, d)
+                        merge(inv, 'inv')
+                    merge(q, 'Q')
+                    merge(d, 'd')
+                else:
+                    inv = pallas_kernels.damped_inverse_stack(
+                        local, damping, bucket_method,
+                        iters=kfac.newton_iters)
+                    merge(inv, 'inv')
+            stacks[str(dim)] = cur
         diag_inv = {}
         for name in self.assignment.diag_layers:
+            if chunk is not None and \
+                    chunk_plan['diag'][name] != chunk:
+                diag_inv[name] = prev_diag[name]
+                continue
             diag_inv[name] = linalg.get_elementwise_inverse(
                 factors[name]['A'].astype(jnp.float32),
                 damping=damping).astype(kfac.inv_dtype)
@@ -642,8 +875,11 @@ class DistributedKFAC:
         # beats any sharding bookkeeping); shared helper with the
         # single-chip path so the two cannot drift.
         grouped_inv = {
-            name: grouped_block_inverses(factors[name], damping,
-                                         kfac.inv_dtype)
+            name: (prev_grouped[name]
+                   if chunk is not None
+                   and chunk_plan['grouped'][name] != chunk
+                   else grouped_block_inverses(factors[name], damping,
+                                               kfac.inv_dtype))
             for name in self.assignment.grouped_layers}
         return stacks, diag_inv, grouped_inv
 
@@ -840,7 +1076,8 @@ class DistributedKFAC:
                   damping=None, lr=None, factor_decay=None,
                   factor_update_freq=None, inv_update_freq=None,
                   factor_update: bool | None = None,
-                  inv_update: bool | None = None) -> tuple[dict, dict]:
+                  inv_update: bool | None = None,
+                  inv_chunk: int | None = None) -> tuple[dict, dict]:
         """One distributed K-FAC update; call inside ``shard_map``.
 
         Same contract and cadence semantics as :meth:`KFAC.step`
@@ -861,6 +1098,12 @@ class DistributedKFAC:
         Python bools bake the schedule into the trace (the fast path on
         TPU — a cond whose branch holds the decompositions costs 10-18x
         in XLA layout/copy pathologies around it, measured on v5e).
+
+        ``inv_chunk``: pipelined inverse firing (static, mutually
+        exclusive with ``inv_update=True``): recompute only chunk
+        ``j``'s buckets this step, pass the rest of the (row-sharded)
+        stacks through untouched — see :meth:`KFAC.step` and
+        :meth:`_spmd_update_inverses`.
         """
         kfac = self.kfac
         damping = kfac.damping if damping is None else damping
@@ -900,19 +1143,43 @@ class DistributedKFAC:
             # Metrics/guard off: the historical program, untouched.
             factors = cadence_gate(factor_update, step, f_freq,
                                    do_factors, lambda: state['factors'])
-        inv_stacks, diag_inv, grouped_inv = cadence_gate(
-            inv_update, step, i_freq,
-            lambda: self._spmd_update_inverses(
-                factors, damping, prev_stacks=state['inv_stacks']),
-            lambda: (state['inv_stacks'], state['diag_inv'],
-                     state.get('grouped_inv', {})))
+        if inv_chunk is not None:
+            k = kfac.inv_pipeline_chunks
+            if inv_update:
+                raise ValueError(
+                    'inv_chunk is mutually exclusive with '
+                    'inv_update=True (a monolithic firing already '
+                    'covers every chunk)')
+            if not 0 <= inv_chunk < k:
+                raise ValueError(
+                    f'{inv_chunk=} out of range for '
+                    f'inv_pipeline_chunks={k}')
+            with profiling.annotate(f'kfac/inverse/chunk{inv_chunk}'):
+                inv_stacks, diag_inv, grouped_inv = (
+                    self._spmd_update_inverses(
+                        factors, damping,
+                        prev_stacks=state['inv_stacks'],
+                        chunk=inv_chunk,
+                        prev_diag=state['diag_inv'],
+                        prev_grouped=state.get('grouped_inv', {})))
+            chunk_phase = jnp.asarray((inv_chunk + 1) % k, jnp.int32)
+        else:
+            inv_stacks, diag_inv, grouped_inv = cadence_gate(
+                inv_update, step, i_freq,
+                lambda: self._spmd_update_inverses(
+                    factors, damping, prev_stacks=state['inv_stacks']),
+                lambda: (state['inv_stacks'], state['diag_inv'],
+                         state.get('grouped_inv', {})))
+            chunk_phase = (jnp.zeros((), jnp.int32) if inv_update
+                           else state['inv_chunk_phase'])
 
         if not kfac.collect_metrics:
             precond = self._spmd_precondition(
                 inv_stacks, diag_inv, grouped_inv, grads, damping, lr)
             new_state = {'step': step + 1, 'factors': factors,
                          'inv_stacks': inv_stacks, 'diag_inv': diag_inv,
-                         'grouped_inv': grouped_inv}
+                         'grouped_inv': grouped_inv,
+                         'inv_chunk_phase': chunk_phase}
             return precond, new_state
 
         precond, stats = self._spmd_precondition(
@@ -921,7 +1188,9 @@ class DistributedKFAC:
         one = lambda: jnp.ones((), jnp.int32)
         zero = lambda: jnp.zeros((), jnp.int32)
         did_f = cadence_gate(factor_update, step, f_freq, one, zero)
-        did_i = cadence_gate(inv_update, step, i_freq, one, zero)
+        did_i = (zero() if inv_chunk is not None
+                 else cadence_gate(inv_update, step, i_freq, one, zero))
+        did_c = one() if inv_chunk is not None else zero()
         # Row-local clip counts summed over inverse groups: each row's
         # stacks hold only its own layers' spectra (columns agree after
         # the in-group all_gather), so one psum yields the global count.
@@ -931,9 +1200,11 @@ class DistributedKFAC:
         new_state = {'step': step + 1, 'factors': factors,
                      'inv_stacks': inv_stacks, 'diag_inv': diag_inv,
                      'grouped_inv': grouped_inv,
+                     'inv_chunk_phase': chunk_phase,
                      'metrics': obs_metrics.update_metrics(
                          state['metrics'], damping=damping, stats=stats,
                          did_factor=did_f, did_inv=did_i,
+                         did_chunk=did_c,
                          factor_finite=finite_f,
                          eig_clipped=eig_clipped)}
         return precond, new_state
@@ -952,7 +1223,9 @@ class DistributedKFAC:
         factor-only checkpoints, then call :meth:`recompute_inverses`
         after restoring.
         """
-        out = {'step': state['step'], 'factors': state['factors']}
+        out = {'step': state['step'], 'factors': state['factors'],
+               'inv_chunk_phase': state.get(
+                   'inv_chunk_phase', jnp.zeros((), jnp.int32))}
         if include_inverses:
             out['inv_stacks'] = state['inv_stacks']
             out['diag_inv'] = state['diag_inv']
@@ -972,7 +1245,12 @@ class DistributedKFAC:
                 'checkpoint layers do not match registered layers: '
                 f'{sorted(sd["factors"])} vs {sorted(state["factors"])}')
         state = {**state, 'step': jnp.asarray(sd['step'], jnp.int32),
-                 'factors': sd['factors']}
+                 'factors': sd['factors'],
+                 # Pre-r9 checkpoints: default the pipeline position to
+                 # 0 — always safe, the engine re-derives the chunk
+                 # schedule from the step counter (MIGRATION.md).
+                 'inv_chunk_phase': jnp.asarray(
+                     sd.get('inv_chunk_phase', 0), jnp.int32)}
         # Layout compatibility: a checkpoint written under a different
         # inverse dispatch (e.g. 'eigen' stacks loaded into an 'auto'
         # config whose large buckets are 'inv'-typed) is rebuilt from
@@ -1258,7 +1536,7 @@ class DistributedKFAC:
             return (mean(loss_sum), mean(extras_sum), mean(grads_sum),
                     contribs, updated)
 
-        def make_local_step(factor_update, inv_update):
+        def make_local_step(factor_update, inv_update, inv_chunk):
             def local_step(params, opt_state, kstate, extra_vars, batch,
                            hyper):
                 if dynamic_ls:
@@ -1302,7 +1580,8 @@ class DistributedKFAC:
                     factor_decay=hyper.get('factor_decay'),
                     factor_update_freq=hyper.get('factor_update_freq'),
                     inv_update_freq=hyper.get('inv_update_freq'),
-                    factor_update=factor_update, inv_update=inv_update)
+                    factor_update=factor_update, inv_update=inv_update,
+                    inv_chunk=inv_chunk)
                 updates, new_opt_state = tx.update(precond, opt_state,
                                                    params)
                 new_params = jax.tree.map(
@@ -1375,9 +1654,16 @@ class DistributedKFAC:
                         metrics)
             return local_step
 
-        def make_step_impl(factor_update, inv_update):
+        def make_step_impl(factor_update, inv_update, inv_chunk):
+            key = (factor_update, inv_update, inv_chunk)
+
             def step_impl(params, opt_state, kstate, extra_vars, batch,
                           hyper):
+                # Host-side trace tally: this body re-executes exactly
+                # when jax retraces the variant, so the count pins
+                # PERF.md pitfall 3 (one compile per flag combination,
+                # ever) — asserted by the retrace-guard test.
+                trace_counts[key] = trace_counts.get(key, 0) + 1
                 kspecs = self.state_pspecs(kstate)
                 rep = P()
                 batch_specs = normalize_batch_specs(batch_spec, batch)
@@ -1399,37 +1685,49 @@ class DistributedKFAC:
                     rep,  # metrics dict: P() prefix covers any keys
                 )
                 fn = jax.shard_map(
-                    make_local_step(factor_update, inv_update),
+                    make_local_step(factor_update, inv_update,
+                                    inv_chunk),
                     mesh=self.mesh, in_specs=in_specs,
                     out_specs=out_specs, check_vma=False)
                 return fn(params, opt_state, kstate, extra_vars, batch,
                           hyper)
             return step_impl
 
-        # One separately-jitted callable per cadence-flag combination,
-        # built lazily and kept for the builder's lifetime. Passing the
-        # flags through one jit via static_argnums retraced + recompiled
-        # on EVERY flag flip (observed on jax 0.8: the tracing cache kept
-        # only the most recent static-arg variant — ~15-45 s per flip on
-        # TPU); distinct jit callables have independent caches, so each
-        # variant compiles exactly once.
+        # One separately-jitted callable per cadence-flag combination
+        # (factor_update, inv_update, inv_chunk), built lazily and kept
+        # for the builder's lifetime. Passing the flags through one jit
+        # via static_argnums retraced + recompiled on EVERY flag flip
+        # (observed on jax 0.8: the tracing cache kept only the most
+        # recent static-arg variant — ~15-45 s per flip on TPU);
+        # distinct jit callables have independent caches, so each
+        # variant compiles exactly once. With pipelined firing each
+        # chunk phase is one more variant (k-1 extra compiles per run,
+        # zero retraces — pinned by the trace_counts guard test).
         donate_argnums = (0, 1, 2, 3) if donate else ()
         variants: dict[tuple, Any] = {}
+        trace_counts: dict[tuple, int] = {}
 
         def step(params, opt_state, kstate, extra_vars, batch, hyper,
                  factor_update: bool | None = None,
-                 inv_update: bool | None = None):
+                 inv_update: bool | None = None,
+                 inv_chunk: int | None = None):
             """``factor_update`` / ``inv_update``: static cadence flags
             (see :meth:`KFAC.step`). ``None`` = dynamic on-device conds;
             host-driven bools select one of the statically-compiled
-            program variants (the TPU fast path)."""
-            key = (factor_update, inv_update)
+            program variants (the TPU fast path). ``inv_chunk``: fire
+            only pipelined chunk ``j`` of the inverse work (static int;
+            requires ``inv_update`` falsy — see ``KFAC.step``)."""
+            key = (factor_update, inv_update, inv_chunk)
             if key not in variants:
                 variants[key] = jax.jit(make_step_impl(*key),
                                         donate_argnums=donate_argnums)
             return variants[key](params, opt_state, kstate, extra_vars,
                                  batch, hyper)
 
+        # Introspection for the engine's chunk scheduler and the
+        # retrace-guard test (host-side, no runtime cost).
+        step.inv_pipeline_chunks = self.kfac.inv_pipeline_chunks
+        step.trace_counts = trace_counts
         return step
 
 

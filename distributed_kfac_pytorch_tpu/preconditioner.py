@@ -239,6 +239,43 @@ class KFAC:
         engine's JSONL sink). Default False is bit-identical to the
         pre-observability step — the same discipline as
         ``precond_compute_dtype=None`` (test-pinned).
+      inv_pipeline_chunks: pipeline the per-firing inverse work across
+        the cadence window (default 1 = reference parity, bit-identical:
+        the whole factor set decomposes in one firing step). With
+        ``k > 1`` the inverse work items (the same-shape bucket stacks
+        the precondition/linalg paths already form, plus the grouped/
+        diagonal layers) are greedy-bin-packed into ``k`` cost-balanced
+        chunks on a dim^3 proxy (:meth:`inverse_chunk_plan`), and the
+        engine fires chunk ``j`` on step ``t = j * inv_update_freq/k``
+        of each window instead of firing everything at the window head —
+        smearing the decomposition spike (measured 4x the non-factor
+        step on the xl LM flagship, PERF.md r5) into ``k`` smaller ones.
+        Each chunk phase is its own statically-compiled program variant
+        (``KFAC.step(inv_chunk=j)`` /
+        ``DistributedKFAC.build_train_step``'s variant cache) — cadence
+        stays static program structure, no retraces (PERF.md pitfalls
+        2-3). Semantics: every factor still refires every
+        ``inv_update_freq`` steps; chunks fired mid-window see factors
+        up to ``inv_update_freq * (k-1)/k`` steps FRESHER than the
+        window head (strictly less stale than the reference), but
+        layer inverses are no longer simultaneous across chunks — with
+        factors frozen across a window, one full pipelined window is
+        bit-identical to a monolithic firing (test-pinned). The eigen
+        warm-start carry is unaffected: each factor's previous basis is
+        per-factor state updated only when its own chunk fires, so
+        chunking is NOT rejected under ``inverse_method='eigen'`` /
+        warm polish (documented decision, ISSUE r9). Constraints:
+        ``k >= 1``, ``k`` must divide ``inv_update_freq``, and ``k``
+        may not exceed the model's inverse work-item count (validated
+        at registration).
+      inv_pipeline_costs: optional ``{factor_dim: measured_ms}``
+        refinement for the chunk bin-packing — the per-bucket
+        ``bucket_parts`` ms of a flagship firing leg
+        (FLAGSHIP_LM_*.jsonl) in place of the default
+        ``count * dim^3`` proxy. Must cover EVERY dense factor dim of
+        the model (validated at plan time): ms and the dim^3 proxy are
+        different units and a partial dict would silently un-balance
+        the packing.
       nonfinite_guard: skip the factor EWMA update when the candidate
         factors are non-finite (a NaN/Inf gradient/capture batch would
         otherwise poison the running averages forever — EWMA keeps
@@ -275,6 +312,8 @@ class KFAC:
                  inv_dtype: Any = jnp.float32,
                  precond_compute_dtype: Any = None,
                  precond_bucketing: bool = True,
+                 inv_pipeline_chunks: int = 1,
+                 inv_pipeline_costs: dict | None = None,
                  skip_layers: str | Sequence[str] | None = None,
                  trainable: Any = None,
                  symmetry_aware_comm: bool = False,
@@ -291,6 +330,23 @@ class KFAC:
                 'inv_update_freq is not a multiple of factor_update_freq: '
                 'some inverse updates will reuse stale factors '
                 f'({inv_update_freq=} {factor_update_freq=})')
+        if inv_pipeline_chunks < 1:
+            raise ValueError(
+                f'{inv_pipeline_chunks=} must be >= 1')
+        if inv_pipeline_chunks > 1:
+            if inv_update_freq % inv_pipeline_chunks != 0:
+                raise ValueError(
+                    'inv_pipeline_chunks must divide inv_update_freq '
+                    'so chunk phases land on whole steps '
+                    f'({inv_pipeline_chunks=} {inv_update_freq=})')
+            stride = inv_update_freq // inv_pipeline_chunks
+            if stride % factor_update_freq != 0:
+                warnings.warn(
+                    'inv_update_freq/inv_pipeline_chunks is not a '
+                    'multiple of factor_update_freq: some chunk '
+                    'firings will reuse stale factors '
+                    f'({inv_update_freq=} {inv_pipeline_chunks=} '
+                    f'{factor_update_freq=})')
         if assignment_strategy not in ('compute', 'memory'):
             raise ValueError("assignment_strategy must be 'compute' or "
                              "'memory'")
@@ -353,6 +409,9 @@ class KFAC:
         self.inv_dtype = inv_dtype
         self.precond_compute_dtype = precond_compute_dtype
         self.precond_bucketing = precond_bucketing
+        self.inv_pipeline_chunks = inv_pipeline_chunks
+        self.inv_pipeline_costs = (dict(inv_pipeline_costs)
+                                   if inv_pipeline_costs else None)
         self.symmetry_aware_comm = symmetry_aware_comm
         self.assignment_strategy = assignment_strategy
         self.comm_method = comm_method
@@ -372,6 +431,7 @@ class KFAC:
                   'factor_batch_fraction', 'factor_dtype',
                   'factor_compute_dtype', 'inv_dtype',
                   'precond_compute_dtype', 'precond_bucketing',
+                  'inv_pipeline_chunks',
                   'symmetry_aware_comm',
                   'assignment_strategy', 'comm_method',
                   'grad_worker_fraction', 'collect_metrics',
@@ -413,6 +473,104 @@ class KFAC:
         ma = (None if spec.kind == EMBEDDING
               else self.method_for_dim(a_dim))
         return ma, self.method_for_dim(g_dim)
+
+    # ------------------------------------------------------------------
+    # Pipelined inverse firing: chunk planning
+    # ------------------------------------------------------------------
+
+    def inverse_chunk_items(self, factors: dict
+                            ) -> list[tuple[tuple, float]]:
+        """Cost-weighted inverse work items for pipelined firing.
+
+        One item per dense factor matrix (``('mat', layer, 'A'|'G')``
+        — the finest unit the bucketed eigh/inverse paths can regroup:
+        within a chunk, same-dim fired matrices still stack into one
+        vmapped kernel via ``_size_buckets``, so chunking never changes
+        a matrix's decomposition, only when it runs), one per
+        grouped-conv layer (its per-group block stacks), one per
+        diagonal-A embedding layer. Matrix granularity — rather than
+        whole same-dim buckets — is what lets the bin-packer hit the
+        <= 1.5x-of-ideal balance bound on factor sets whose largest
+        bucket alone exceeds ``total/k`` (the xl LM's 18x4096^2 bucket
+        is 1.9x the k=4 ideal; test-pinned in
+        tests/test_inv_pipeline.py). Costs use the ``linalg``
+        decomposition proxy (``dim^3``), or — when
+        ``inv_pipeline_costs`` is given — measured per-bucket
+        ``bucket_parts`` ms split evenly over each bucket's matrices.
+        Measured ms and the dim^3 proxy are DIFFERENT UNITS, so a
+        measurement dict must cover **every dense factor dim** (a
+        partial one raises: mixing a measured 531.8 ms next to a
+        proxied 1024^3 would weight the genuinely heaviest bucket
+        ~1e7x too cheap and silently un-balance the plan); the tiny
+        grouped/diagonal proxy costs are rescaled into the measured
+        unit by the fitted ms-per-dim^3 factor.
+        """
+        from distributed_kfac_pytorch_tpu.ops.linalg import (
+            decomposition_cost,
+        )
+        dense_count: dict[int, int] = {}
+        for name, spec in self.specs.items():
+            if spec.kind in (CONV2D_GROUPED,):
+                continue
+            f = factors[name]
+            if spec.kind != EMBEDDING:
+                a = int(f['A'].shape[-1])
+                dense_count[a] = dense_count.get(a, 0) + 1
+            g = int(f['G'].shape[-1])
+            dense_count[g] = dense_count.get(g, 0) + 1
+        measured = self.inv_pipeline_costs or {}
+        # One global cost unit: proxy dim^3, or measured ms when a
+        # complete measurement is supplied. proxy_scale converts the
+        # non-dense proxy costs into the measured unit.
+        proxy_scale = measured_unit_scale(measured, dense_count,
+                                          'dense factor dim')
+
+        def unit_cost(dim: int) -> float:
+            if dim in measured:
+                return float(measured[dim]) / dense_count[dim]
+            return decomposition_cost(dim)
+
+        items: list[tuple[tuple, float]] = []
+        for name, spec in self.specs.items():
+            f = factors[name]
+            a_dim = int(f['A'].shape[-1])
+            g_dim = int(f['G'].shape[-1])
+            if spec.kind == CONV2D_GROUPED:
+                ng = int(f['A'].shape[0])
+                items.append((('grouped', name),
+                              proxy_scale
+                              * (ng * decomposition_cost(a_dim)
+                                 + ng * decomposition_cost(g_dim))))
+                continue
+            if spec.kind == EMBEDDING:
+                # Elementwise reciprocal: O(dim), negligible next to any
+                # dense decomposition but still a schedulable item.
+                items.append((('diag', name), proxy_scale * a_dim))
+            else:
+                items.append((('mat', name, 'A'), unit_cost(a_dim)))
+            items.append((('mat', name, 'G'), unit_cost(g_dim)))
+        return items
+
+    def inverse_chunk_plan(self, factors: dict) -> dict[tuple, int]:
+        """Static item -> chunk assignment for ``inv_pipeline_chunks``.
+
+        Greedy LPT bin-packing (``parallel.placement.load_balance``, the
+        same balancer the KAISA work assignment uses) of the
+        :meth:`inverse_chunk_items` onto ``k`` chunks. Deterministic
+        (registration order + sorted dims), so every trace — and the
+        single-chip vs SPMD paths — sees the identical plan. Raises if
+        ``k`` exceeds the item count (more chunks than schedulable
+        buckets cannot balance anything).
+        """
+        items = self.inverse_chunk_items(factors)
+        k = self.inv_pipeline_chunks
+        if k > len(items):
+            raise ValueError(
+                f'inv_pipeline_chunks={k} exceeds the {len(items)} '
+                'inverse work items of this model (dense factor '
+                'matrices + grouped/diagonal layers); lower it to at '
+                f'most {len(items)}')
+        return plan_inverse_chunks(items, k)
 
     # ------------------------------------------------------------------
     # Registration / state init
@@ -508,7 +666,20 @@ class KFAC:
                 entry['G_inv'] = jnp.zeros((g_dim, g_dim), idt)
             inverses[name] = entry
         state = {'step': jnp.zeros((), jnp.int32),
-                 'factors': factors, 'inverses': inverses}
+                 'factors': factors, 'inverses': inverses,
+                 # Pipelined-firing position: the next chunk index due
+                 # (always 0 at init and after a monolithic firing;
+                 # constant 0 under inv_pipeline_chunks=1). Checkpointed
+                 # so resumed runs report where the pipeline stood;
+                 # restore of pre-r9 bundles defaults it to 0
+                 # (MIGRATION.md).
+                 'inv_chunk_phase': jnp.zeros((), jnp.int32)}
+        if self.inv_pipeline_chunks > 1:
+            # Eager validation: the chunk count must not exceed the
+            # model's inverse work buckets (raises with the bucket
+            # count); the plan itself is recomputed statically at trace
+            # time from the same shapes.
+            self.inverse_chunk_plan(factors)
         if self.collect_metrics:
             state['metrics'] = obs_metrics.init_metrics(
                 self.metric_bucket_keys(params))
@@ -626,7 +797,8 @@ class KFAC:
 
     @profiling.scope('kfac/inverses')
     def update_inverses(self, state: dict, damping, *,
-                        warm: bool = True) -> dict:
+                        warm: bool = True,
+                        chunk: int | None = None) -> dict:
         """Recompute inverses/eigendecompositions from current factors.
 
         Reference: compute_inverses (preconditioner.py:555-564,
@@ -636,7 +808,24 @@ class KFAC:
         eigh_method='auto' fast path); pass ``warm=False`` where the
         stored bases are untrustworthy (e.g. rebuilding from a
         factor-only checkpoint, where inverse slots are fresh identity).
+
+        ``chunk``: pipelined firing — recompute only the work items the
+        :meth:`inverse_chunk_plan` assigns to this chunk index, passing
+        every other slot through from ``state['inverses']`` unchanged.
+        ``None`` (monolithic, the default) fires everything. Per-bucket
+        decompositions are identical either way (chunking selects whole
+        same-dim buckets, never splits one), which is what makes a
+        frozen-factor pipelined window bit-identical to one monolithic
+        firing (test-pinned).
         """
+        plan = (self.inverse_chunk_plan(state['factors'])
+                if self.inv_pipeline_chunks > 1 else None)
+        if chunk is not None and plan is None:
+            raise ValueError('inv_chunk requires inv_pipeline_chunks > 1')
+
+        def fires(key: tuple) -> bool:
+            return chunk is None or plan[key] == chunk
+
         # Split the dense factors by per-dim method ('auto' mixes both
         # groups; global modes put everything in one). Prev-basis warm
         # starts apply only to the eigen group.
@@ -649,8 +838,12 @@ class KFAC:
             ma, mg = self._side_methods(spec, f['A'].shape[-1],
                                         f['G'].shape[-1])
             sides[name] = (ma, mg)
+            if spec.kind == CONV2D_GROUPED:
+                continue
             for which, m in (('A', ma), ('G', mg)):
                 if m is None:
+                    continue
+                if not fires(('mat', name, which)):
                     continue
                 key = f'{name}/{which}'
                 if m == 'eigen':
@@ -660,14 +853,42 @@ class KFAC:
                 else:
                     inv_mats[key] = f[which]
 
-        eigs = self._bucketed_eigh(eigen_mats, prev if warm else None)
-        invs = self._bucketed_inverse(inv_mats, damping)
+        if plan is None:
+            eigs = self._bucketed_eigh(eigen_mats, prev if warm else None)
+            invs = self._bucketed_inverse(inv_mats, damping)
+        else:
+            # Pipelined mode (k > 1): decompose in the SAME per-chunk
+            # sub-stacks whether this is a monolithic firing (all
+            # groups) or one chunk's firing (its group alone). The
+            # frozen-window bit-identity contract is then structural —
+            # it does not rest on the backend's batched kernels being
+            # slice-stable across batch sizes, which they are NOT
+            # (observed on CPU: a 1-matrix vs 6-matrix vmapped polish
+            # rotates Q by O(1) within near-degenerate eigenvalue
+            # clusters; same amplification class as PERF.md's
+            # static-vs-dynamic fusion note).
+            def by_chunk(mats: dict) -> dict[int, dict]:
+                out: dict[int, dict] = {}
+                for key, m in mats.items():
+                    name, which = key.rsplit('/', 1)
+                    out.setdefault(plan[('mat', name, which)],
+                                   {})[key] = m
+                return out
+
+            eigs, invs = {}, {}
+            for _j, mats in sorted(by_chunk(eigen_mats).items()):
+                eigs.update(self._bucketed_eigh(
+                    mats, prev if warm else None))
+            for _j, mats in sorted(by_chunk(inv_mats).items()):
+                invs.update(self._bucketed_inverse(mats, damping))
 
         new_inv = {}
         for name, spec in self.specs.items():
+            old = state['inverses'][name]
             if spec.kind == CONV2D_GROUPED:
-                new_inv[name] = grouped_block_inverses(
+                new_inv[name] = (grouped_block_inverses(
                     state['factors'][name], damping, self.inv_dtype)
+                    if fires(('grouped', name)) else old)
                 continue
             ma, mg = sides[name]
             # A dense layer with exactly one eigen side is *mixed*: its
@@ -677,31 +898,38 @@ class KFAC:
             # carry the same firing-time λ — the reference non-eigen
             # timing semantics — and precondition does no per-step
             # eigen-side reconstruction. Q/d stay stored for the next
-            # firing's warm start.
+            # firing's warm start. (Under chunked firing the two sides
+            # may bake at different phase steps' λ — the same situation
+            # a damping schedule already creates across firings.)
             mixed = (spec.kind != EMBEDDING
                      and (ma == 'eigen') != (mg == 'eigen'))
-            entry: dict[str, Any] = {}
+            # Chunked firing: start from the stored entry and overwrite
+            # only the sides whose bucket fires this chunk.
+            entry: dict[str, Any] = dict(old) if chunk is not None else {}
             if spec.kind == EMBEDDING:
-                entry['A_inv'] = linalg.get_elementwise_inverse(
-                    state['factors'][name]['A'].astype(jnp.float32),
-                    damping=damping).astype(self.inv_dtype)
+                if fires(('diag', name)):
+                    entry['A_inv'] = linalg.get_elementwise_inverse(
+                        state['factors'][name]['A'].astype(jnp.float32),
+                        damping=damping).astype(self.inv_dtype)
             elif ma == 'eigen':
-                qa, da = eigs[f'{name}/A']
-                entry['QA'] = qa.astype(self.inv_dtype)
-                entry['dA'] = da.astype(self.inv_dtype)
-                if mixed:
-                    entry['A_inv'] = linalg.eigen_side_inverse(
-                        qa, da, damping).astype(self.inv_dtype)
-            else:
+                if fires(('mat', name, 'A')):
+                    qa, da = eigs[f'{name}/A']
+                    entry['QA'] = qa.astype(self.inv_dtype)
+                    entry['dA'] = da.astype(self.inv_dtype)
+                    if mixed:
+                        entry['A_inv'] = linalg.eigen_side_inverse(
+                            qa, da, damping).astype(self.inv_dtype)
+            elif fires(('mat', name, 'A')):
                 entry['A_inv'] = invs[f'{name}/A'].astype(self.inv_dtype)
             if mg == 'eigen':
-                qg, dg = eigs[f'{name}/G']
-                entry['QG'] = qg.astype(self.inv_dtype)
-                entry['dG'] = dg.astype(self.inv_dtype)
-                if mixed:
-                    entry['G_inv'] = linalg.eigen_side_inverse(
-                        qg, dg, damping).astype(self.inv_dtype)
-            else:
+                if fires(('mat', name, 'G')):
+                    qg, dg = eigs[f'{name}/G']
+                    entry['QG'] = qg.astype(self.inv_dtype)
+                    entry['dG'] = dg.astype(self.inv_dtype)
+                    if mixed:
+                        entry['G_inv'] = linalg.eigen_side_inverse(
+                            qg, dg, damping).astype(self.inv_dtype)
+            elif fires(('mat', name, 'G')):
                 entry['G_inv'] = invs[f'{name}/G'].astype(self.inv_dtype)
             new_inv[name] = entry
         return new_inv
@@ -835,7 +1063,8 @@ class KFAC:
              damping=None, lr=None, factor_decay=None,
              factor_update_freq=None, inv_update_freq=None,
              factor_update: bool | None = None,
-             inv_update: bool | None = None) -> tuple[dict, dict]:
+             inv_update: bool | None = None,
+             inv_chunk: int | None = None) -> tuple[dict, dict]:
         """One K-FAC update: returns (preconditioned_grads, new_state).
 
         The analogue of reference KFAC.step() (preconditioner.py:472-523).
@@ -855,6 +1084,17 @@ class KFAC:
             XLA layout/copy pathologies around the cond — so training
             loops should prefer the static form (the engine and
             ``DistributedKFAC.build_train_step`` do).
+
+        ``inv_chunk``: pipelined inverse firing (static cadence only —
+        a Python int, mutually exclusive with ``inv_update=True``):
+        recompute only chunk ``j``'s share of the inverse work this
+        step (see ``inv_pipeline_chunks`` / :meth:`update_inverses`).
+        The engine fires chunk ``j`` on phase step
+        ``j * inv_update_freq / k`` of each cadence window; each chunk
+        value is its own statically-compiled program variant. The
+        dynamic (``None``-flag) path always fires monolithically —
+        chunking is a static-program-structure feature by design
+        (PERF.md pitfall 2).
         """
         damping = self.damping if damping is None else damping
         lr = self.lr if lr is None else lr
@@ -882,11 +1122,34 @@ class KFAC:
                 lambda: state['factors'])
         state_f = {**state, 'factors': factors}
 
-        inverses = cadence_gate(
-            inv_update, step, i_freq,
-            lambda: self.update_inverses(state_f, damping),
-            lambda: state['inverses'])
-        state_i = {**state_f, 'inverses': inverses}
+        if inv_chunk is not None:
+            k = self.inv_pipeline_chunks
+            if inv_update:
+                raise ValueError(
+                    'inv_chunk is mutually exclusive with '
+                    'inv_update=True (a monolithic firing already '
+                    'covers every chunk)')
+            if not 0 <= inv_chunk < k:
+                raise ValueError(
+                    f'{inv_chunk=} out of range for '
+                    f'inv_pipeline_chunks={k}')
+            with profiling.annotate(f'kfac/inverse/chunk{inv_chunk}'):
+                inverses = self.update_inverses(state_f, damping,
+                                                chunk=inv_chunk)
+            chunk_phase = jnp.asarray((inv_chunk + 1) % k, jnp.int32)
+        else:
+            inverses = cadence_gate(
+                inv_update, step, i_freq,
+                lambda: self.update_inverses(state_f, damping),
+                lambda: state['inverses'])
+            # Static monolithic firing resets the pipeline position;
+            # otherwise (no firing, or the dynamic cond path — which
+            # only ever fires monolithically from phase 0) the stored
+            # phase passes through untouched.
+            chunk_phase = (jnp.zeros((), jnp.int32) if inv_update
+                           else state['inv_chunk_phase'])
+        state_i = {**state_f, 'inverses': inverses,
+                   'inv_chunk_phase': chunk_phase}
 
         if not self.collect_metrics:
             precond = self.precondition(state_i, grads, damping, lr)
@@ -898,11 +1161,14 @@ class KFAC:
         one = lambda: jnp.ones((), jnp.int32)
         zero = lambda: jnp.zeros((), jnp.int32)
         did_f = cadence_gate(factor_update, step, f_freq, one, zero)
-        did_i = cadence_gate(inv_update, step, i_freq, one, zero)
+        did_i = (zero() if inv_chunk is not None
+                 else cadence_gate(inv_update, step, i_freq, one, zero))
+        did_c = one() if inv_chunk is not None else zero()
         new_state = {**state_i, 'step': step + 1,
                      'metrics': obs_metrics.update_metrics(
                          state['metrics'], damping=damping, stats=stats,
                          did_factor=did_f, did_inv=did_i,
+                         did_chunk=did_c,
                          factor_finite=finite_f,
                          eig_clipped=obs_metrics.count_clipped_eigvals(
                              inverses))}
@@ -928,7 +1194,9 @@ class KFAC:
         reference's checkpoint policy (preconditioner.py:294-353,
         README.md:222-223).
         """
-        out = {'step': state['step'], 'factors': state['factors']}
+        out = {'step': state['step'], 'factors': state['factors'],
+               'inv_chunk_phase': state.get(
+                   'inv_chunk_phase', jnp.zeros((), jnp.int32))}
         if include_inverses:
             out['inverses'] = state['inverses']
         return out
@@ -946,7 +1214,12 @@ class KFAC:
                 'checkpoint layers do not match registered layers: '
                 f'{sorted(sd["factors"])} vs {sorted(state["factors"])}')
         state = {**state, 'step': jnp.asarray(sd['step'], jnp.int32),
-                 'factors': sd['factors']}
+                 'factors': sd['factors'],
+                 # Pre-r9 checkpoints have no pipeline position: default
+                 # 0 (window head — always a safe resume point, the
+                 # engine re-derives the schedule from the step counter).
+                 'inv_chunk_phase': jnp.asarray(
+                     sd.get('inv_chunk_phase', 0), jnp.int32)}
         # A checkpoint written under a different inverse layout (e.g.
         # 'eigen' saved, 'auto' loading) is structurally incompatible —
         # rebuild from factors instead of splicing mismatched slots in.
@@ -1002,6 +1275,58 @@ def grouped_block_inverses(factors: dict, damping, inv_dtype) -> dict:
             'G_inv': pallas_kernels.damped_inverse_stack(
                 factors['G'].astype(jnp.float32), damping,
                 'cholesky').astype(inv_dtype)}
+
+
+def measured_unit_scale(measured: dict, dim_counts: dict[int, int],
+                        scope: str) -> float:
+    """Fit the ms-per-dim^3 factor for a measured chunk-cost dict.
+
+    ``measured`` maps dim -> whole-bucket ms, ``dim_counts`` maps
+    dim -> work units in that bucket (per-matrix counts on the
+    single-chip planner, slots_per_col on the SPMD one). Measured ms
+    and the dim^3 proxy are DIFFERENT UNITS, so a measurement must
+    cover every dim in ``dim_counts`` (raises otherwise — a partial
+    dict like ``{4096: 531.8}`` would weight the genuinely heaviest
+    bucket ~1e7x too cheap and silently un-balance the plan). Returns
+    the factor that converts remaining proxy costs (grouped/diagonal
+    items) into the measured unit; 1.0 when nothing is measured.
+    Shared by both planners so the unit discipline cannot drift.
+    """
+    if not measured:
+        return 1.0
+    from distributed_kfac_pytorch_tpu.ops.linalg import (
+        decomposition_cost,
+    )
+    missing = sorted(d for d in dim_counts if d not in measured)
+    if missing:
+        raise ValueError(
+            f'inv_pipeline_costs must cover every {scope} (missing '
+            f'{missing}): measured ms and the dim^3 proxy are '
+            'different units and cannot be mixed in one chunk packing '
+            '— pass the full bucket_parts of a firing leg')
+    proxy_total = sum(decomposition_cost(d, c)
+                      for d, c in dim_counts.items())
+    ms_total = sum(float(measured[d]) for d in dim_counts)
+    return ms_total / proxy_total if ms_total > 0 else 1.0
+
+
+def plan_inverse_chunks(items: Sequence[tuple[Any, float]],
+                        k: int) -> dict[Any, int]:
+    """Greedy LPT assignment of inverse work items onto ``k`` chunks.
+
+    ``items`` are ``(key, cost)`` pairs (see
+    :meth:`KFAC.inverse_chunk_items`); returns ``{key: chunk_index}``.
+    Single point of truth for the single-chip and SPMD pipelined-firing
+    paths — both must fire the same buckets on the same phase steps.
+    Balance quality on the flagship factor sets is test-pinned
+    (tests/test_inv_pipeline.py: max chunk load <= 1.5x the ideal
+    ``total/k`` on the ResNet-50 and xl-LM sets).
+    """
+    from distributed_kfac_pytorch_tpu.parallel.placement import (
+        load_balance,
+    )
+    assignment = load_balance(k, [cost for _, cost in items])
+    return {key: chunk for (key, _), chunk in zip(items, assignment)}
 
 
 def resolve_eigh_method(method: str) -> str:

@@ -21,6 +21,49 @@ from distributed_kfac_pytorch_tpu.parallel.distributed import KFAC_AXES
 from distributed_kfac_pytorch_tpu.training.utils import Metric, accuracy
 
 
+def cadence_flags(step: int, factor_update_freq, inv_update_freq,
+                  inv_pipeline_chunks: int = 1) -> dict:
+    """Static cadence flags for one host step (single point of truth).
+
+    The classic schedule fires the whole inverse update at
+    ``step % inv_update_freq == 0``. With ``inv_pipeline_chunks=k > 1``
+    the firing is pipelined: chunk ``j`` fires on phase step
+    ``j * inv_update_freq / k`` of each window (``inv_chunk=j`` in the
+    returned flags), smearing the decomposition spike across the
+    window — except at step 0, which fires monolithically
+    (``inv_update=True``): every inverse slot is zero-seeded and must
+    exist before its first preconditioning use, so the pipeline takes
+    over from the first window's later phases onward. Each distinct
+    flag combination is its own statically-compiled program variant
+    (PERF.md pitfalls 2-3).
+    """
+    f_freq, i_freq = int(factor_update_freq), int(inv_update_freq)
+    k = int(inv_pipeline_chunks)
+    flags = {'factor_update': step % f_freq == 0}
+    if k > 1 and i_freq % k == 0:
+        stride = i_freq // k
+        phase = step % i_freq
+        flags['inv_update'] = step == 0
+        if step != 0 and phase % stride == 0:
+            flags['inv_chunk'] = phase // stride
+    else:
+        flags['inv_update'] = step % i_freq == 0
+    return flags
+
+
+def fired_stage(flags: dict) -> str | None:
+    """Most expensive stage a step's static flags fire (for step-time
+    attribution in the metrics stream): 'inverse' > 'chunk<j>' >
+    'factor' > None. The report's outlier attribution consumes this."""
+    if flags.get('inv_update'):
+        return 'inverse'
+    if flags.get('inv_chunk') is not None:
+        return f"chunk{flags['inv_chunk']}"
+    if flags.get('factor_update'):
+        return 'factor'
+    return None
+
+
 @dataclasses.dataclass
 class TrainState:
     """Everything a training step threads through (one pytree-of-pytrees).
@@ -122,14 +165,27 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
                 'TrainState.step must be restored alongside kfac_state '
                 '(checkpoint resume restores both; see '
                 "MIGRATION.md 'Checkpoint format').")
+    # Pipelined inverse firing: the step builder advertises its chunk
+    # count (DistributedKFAC.build_train_step); a schedule the chunks
+    # cannot divide evenly (e.g. a KFACParamScheduler freq decay)
+    # falls back to monolithic firing for the epoch rather than
+    # mis-phasing the pipeline.
+    chunks = int(getattr(step_fn, 'inv_pipeline_chunks', 1) or 1)
+    if (chunks > 1 and static_cadence is not None
+            and int(static_cadence[1]) % chunks != 0):
+        import warnings
+        warnings.warn(
+            f'inv_pipeline_chunks={chunks} does not divide this '
+            f'epoch\'s inv_update_freq={static_cadence[1]} — firing '
+            'monolithically for the epoch')
+        chunks = 1
     meters: dict[str, Metric] = {}
     t0 = time.perf_counter()
     n_batches = 0
     for batch in batches:
         if static_cadence is not None:
             f_freq, i_freq = static_cadence
-            flags = {'factor_update': state.step % int(f_freq) == 0,
-                     'inv_update': state.step % int(i_freq) == 0}
+            flags = cadence_flags(state.step, f_freq, i_freq, chunks)
         else:
             flags = {}
         t_it = time.perf_counter()
@@ -141,7 +197,8 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
             # converts to floats at drain time, far behind dispatch.
             dt = time.perf_counter() - t_it
             metrics_sink.step_record(state.step, metrics,
-                                     host_step_ms=dt * 1000.0)
+                                     host_step_ms=dt * 1000.0,
+                                     fired=fired_stage(flags))
             # Feed the dispatch timing into the host trace table too,
             # so epoch snapshots (and the report's stage table) carry a
             # per-stage row even when no phase is @trace-decorated.

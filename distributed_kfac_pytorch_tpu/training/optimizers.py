@@ -82,6 +82,12 @@ class OptimConfig:
     # bf16_inverses the stored inverses are consumed resident (no fp32
     # upcast-on-read). Default False = the bit-identical fp32 path.
     bf16_precond: bool = False
+    # Pipelined inverse firing (r9): partition the per-firing inverse
+    # work into k cost-balanced chunks and fire chunk j on step
+    # j*inv_update_freq/k of each cadence window — smears the
+    # decomposition spike across the window (step-time uniformity).
+    # 1 (default) = reference parity, monolithic firing, bit-identical.
+    inv_pipeline_chunks: int = 1
     # r7 observability: carry an on-device K-FAC metrics pytree in the
     # state (damping, KL-clip nu, grad/precond norms, firing counts —
     # see observability.metrics). Off (default) = bit-identical step.
@@ -184,6 +190,7 @@ def get_optimizer(model, cfg: OptimConfig):
                        else jnp.float32),
             precond_compute_dtype=(jnp.bfloat16 if cfg.bf16_precond
                                    else None),
+            inv_pipeline_chunks=cfg.inv_pipeline_chunks,
             skip_layers=list(cfg.skip_layers) or None,
             symmetry_aware_comm=cfg.symmetry_aware_comm,
             comm_method=COMM_METHODS[cfg.comm_method.lower()],

@@ -97,6 +97,13 @@ def parse_args(argv=None):
     # K-FAC (reference torch_language_model.py:74-104).
     p.add_argument('--kfac-update-freq', type=int, default=10,
                    help='inverse update interval; 0 disables K-FAC')
+    p.add_argument('--inv-pipeline-chunks', type=int, default=1,
+                   help='pipeline the per-firing inverse work into K '
+                        'cost-balanced chunks fired across the cadence '
+                        'window (step-time uniformity, r9); 1 = '
+                        'reference parity (monolithic firing). K must '
+                        'divide --kfac-update-freq and not exceed the '
+                        "model's inverse bucket count")
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
     p.add_argument('--inverse-method', default='auto',
                    choices=['auto', 'eigen', 'cholesky', 'newton'],
@@ -222,6 +229,7 @@ def main(argv=None):
         lr_decay=args.lr_decay, workers=1,
         kfac_inv_update_freq=args.kfac_update_freq,
         kfac_cov_update_freq=args.kfac_cov_update_freq,
+        inv_pipeline_chunks=args.inv_pipeline_chunks,
         damping=args.damping, factor_decay=args.stat_decay,
         kl_clip=args.kl_clip, inverse_method=args.inverse_method,
         eigh_method=args.eigh_method,

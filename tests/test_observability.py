@@ -446,6 +446,50 @@ def test_report_cli_on_recorded_file(tmp_path, capsys):
     assert '8x7' in out                 # bucket table
 
 
+def test_report_step_time_distribution_and_attribution(tmp_path,
+                                                       capsys):
+    """r9 satellite: p50/p95/p99/max ms/iter plus attribution of the
+    outlier steps to the stage that fired them — the pipelined-firing
+    acceptance instrument, backend-independent (host dispatch times)."""
+    path = tmp_path / 'run.jsonl'
+    s = obs_sink.JsonlMetricsSink(str(path))
+    # 16 plain ~10ms steps; one 100ms inverse spike; two 30ms chunks.
+    for i in range(16):
+        s.step_record(i, {'loss': 1.0}, host_step_ms=10.0 + 0.01 * i)
+    s.step_record(16, {'loss': 1.0}, host_step_ms=100.0,
+                  fired='inverse')
+    s.step_record(17, {'loss': 1.0}, host_step_ms=30.0, fired='chunk0')
+    s.step_record(18, {'loss': 1.0}, host_step_ms=30.0, fired='chunk1')
+    s.close()
+    recs = obs_sink.read_jsonl(str(path))  # 'fired' schema-validates
+    summary = obs_report.summarize(recs)
+    d = summary['step_time']
+    assert d['n_steps'] == 19
+    assert 10.0 <= d['p50_ms'] < 11.0
+    assert d['max_ms'] == 100.0
+    assert d['max_over_median'] > 9.0
+    assert d['stages']['inverse']['outliers'] == 1
+    assert d['stages']['chunk0']['outliers'] == 1
+    assert d['stages']['plain']['outliers'] == 0
+    assert obs_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert 'distribution (19 steps)' in out
+    assert 'by fired stage' in out
+    assert 'inverse' in out and 'chunk0' in out
+
+
+def test_report_lists_surviving_incarnations(tmp_path, capsys):
+    path = tmp_path / 'run.jsonl'
+    for run in range(2):
+        s = obs_sink.JsonlMetricsSink(str(path), meta={'run': run})
+        s.step_record(0, {'loss': 1.0})
+        s.flush()
+    assert obs_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert '1 surviving prior incarnation(s)' in out
+    assert f'{path}.prev.1  (2 records)' in out
+
+
 def test_report_cli_rejects_invalid_file(tmp_path, capsys):
     bad = tmp_path / 'bad.jsonl'
     bad.write_text('{"schema": 99, "kind": "step"}\n')

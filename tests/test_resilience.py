@@ -372,8 +372,9 @@ class TestEventRecords:
     def test_relaunch_preserves_previous_incarnation(self, tmp_path):
         """A relaunch reuses the same metrics path; the dead
         incarnation's live segment — holding its preemption/forced-save
-        events — must survive as <path>.prev instead of being unlinked
-        (and must NOT be stitched into the new run's stream)."""
+        events — must survive as <path>.prev.1 instead of being
+        unlinked (and must NOT be stitched into the new run's
+        stream)."""
         path = tmp_path / 'm.jsonl'
         s1 = obs_sink.JsonlMetricsSink(str(path))
         s1.step_record(0, {'loss': 1.0})
@@ -384,9 +385,79 @@ class TestEventRecords:
         s2.close()
         live = obs_sink.read_jsonl(str(path))
         assert [r['kind'] for r in live] == ['meta', 'step']
-        prev = obs_sink.read_jsonl(str(path) + '.prev')
+        assert obs_sink.incarnation_paths(str(path)) == [
+            str(path) + '.prev.1']
+        prev = obs_sink.read_jsonl(str(path) + '.prev.1')
         assert [r.get('event') for r in prev
                 if r['kind'] == 'event'] == ['preemption']
+
+    def test_second_relaunch_chains_incarnations(self, tmp_path):
+        """r9 satellite: the r8 single-slot layout let a SECOND
+        relaunch silently overwrite the first dead incarnation's tail.
+        The chain keeps each one — newest at .prev.1 — bounded, oldest
+        pruned; legacy .prev files fold into the chain."""
+        path = tmp_path / 'm.jsonl'
+        for run in range(3):
+            s = obs_sink.JsonlMetricsSink(str(path), meta={'run': run})
+            s.event_record('preemption', global_step=run)
+        chain = obs_sink.incarnation_paths(str(path))
+        assert chain == [f'{path}.prev.1', f'{path}.prev.2']
+        # Newest-first: .prev.1 is run 1's stream, .prev.2 run 0's.
+        for p, want in zip(chain, (1, 0)):
+            recs = obs_sink.read_jsonl(p)
+            assert recs[0]['meta'] == {'run': want}
+            assert recs[-1]['data']['global_step'] == want
+        # Legacy pre-r9 slot folds into the chain instead of being
+        # clobbered by the next relaunch.
+        import os
+        os.replace(str(path), f'{path}.prev')
+        s = obs_sink.JsonlMetricsSink(str(path), meta={'run': 3})
+        s.flush()
+        assert obs_sink.incarnation_paths(str(path)) == [
+            f'{path}.prev.1', f'{path}.prev.2', f'{path}.prev.3']
+        # Bound: the chain prunes past PREV_INCARNATIONS_KEPT.
+        for run in range(4, 4 + obs_sink.PREV_INCARNATIONS_KEPT):
+            s = obs_sink.JsonlMetricsSink(str(path), meta={'run': run})
+            s.flush()
+        chain = obs_sink.incarnation_paths(str(path))
+        assert len(chain) == obs_sink.PREV_INCARNATIONS_KEPT
+
+    def test_orphaned_rotated_segments_are_chained(self, tmp_path):
+        """Crash window: flush() renames the live segment to <path>.1
+        before republishing a fresh live file — a crash in between
+        leaves rotated segments with NO live file. They are the dead
+        incarnation and must chain on relaunch; the r9.0 early-return
+        left them in place, where the new run's read_jsonl stitched
+        them into a chimeric two-run stream."""
+        path = tmp_path / 'm.jsonl'
+        s1 = obs_sink.JsonlMetricsSink(str(path))
+        s1.event_record('preemption', global_step=0)  # flushed now
+        os.replace(str(path), f'{path}.1')  # crash mid-rotation
+        s2 = obs_sink.JsonlMetricsSink(str(path), meta={'run': 1})
+        s2.step_record(0, {'loss': 1.0})
+        s2.flush()
+        live = obs_sink.read_jsonl(str(path))
+        assert [r['kind'] for r in live] == ['meta', 'step']
+        assert obs_sink.incarnation_paths(str(path)) == [
+            f'{path}.prev.1']
+        prev = obs_sink.read_incarnation(f'{path}.prev.1')
+        assert [r.get('event') for r in prev
+                if r['kind'] == 'event'] == ['preemption']
+
+    def test_legacy_prev_reads_exact_file_only(self, tmp_path):
+        """A legacy '<path>.prev' coexisting with chain entries (e.g.
+        an r8-era binary wrote the slot after an r9 run): its
+        '.prev.<n>' NEIGHBORS are chain entries — other runs — not
+        rotated segments; read_incarnation must not stitch them."""
+        import json as _json
+        path = tmp_path / 'm.jsonl'
+        rec = {'schema': 2, 'kind': 'meta', 'wall_time': 0.0,
+               'meta': {}}
+        (tmp_path / 'm.jsonl.prev').write_text(_json.dumps(rec) + '\n')
+        (tmp_path / 'm.jsonl.prev.2').write_text(
+            (_json.dumps(rec) + '\n') * 3)
+        assert len(obs_sink.read_incarnation(f'{path}.prev')) == 1
+        assert len(obs_sink.read_incarnation(f'{path}.prev.2')) == 3
 
     def test_v1_records_still_validate(self):
         obs_sink.validate_record(
@@ -535,7 +606,8 @@ class _LossSink:
     def __init__(self):
         self.losses = []
 
-    def step_record(self, step, metrics, host_step_ms=None):
+    def step_record(self, step, metrics, host_step_ms=None,
+                    fired=None):
         self.losses.append(metrics['loss'])
 
     def epoch_record(self, epoch, metrics, trace=None):
