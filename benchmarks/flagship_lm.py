@@ -336,6 +336,108 @@ def run_phase(args):
 
 
 # ---------------------------------------------------------------------------
+# Observability baseline (r10): reduce a short measured run to the
+# committed gate baseline (BASELINE_OBS.json)
+# ---------------------------------------------------------------------------
+
+def run_obs_baseline(args):
+    """Record a per-step metrics stream and write a gate baseline.
+
+    Unlike the scan-based timing legs above, this loop dispatches the
+    jitted step ONE host call at a time — the gate regresses the
+    host-visible step-time distribution (p50/p95/p99), which only
+    exists when the host sees every step. Cadence f=5/i=10 via the
+    engine's own ``cadence_flags`` so fired-stage labels and the
+    compile-per-variant shape match a real training run; memory
+    records every 10 steps feed the peak-HBM metric (device allocator
+    stats permitting — CPU runs record the state footprint only, and
+    the committed baseline then simply carries no peak_hbm_bytes for
+    the gate to compare). The recorded stream lands next to the
+    baseline as ``<path>.source.jsonl`` — the evidence the committed
+    number came from.
+    """
+    import time as _time
+
+    jax, jnp, optax, model, kfac, variables, kstate, ids, tgt = _setup(
+        args)
+    from distributed_kfac_pytorch_tpu.observability import (
+        gate as obs_gate,
+        memory as obs_memory,
+        sink as obs_sink,
+    )
+    from distributed_kfac_pytorch_tpu.training import engine
+
+    params = variables['params']
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def loss_fn(out):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            out, tgt).mean()
+
+    variants = {}
+
+    def step(params, opt_state, kstate, f_flag, i_flag):
+        key = (f_flag, i_flag)
+        if key not in variants:
+            def impl(params, opt_state, kstate, _f=f_flag, _i=i_flag):
+                loss, _, grads, captures, _ = (
+                    kfac.capture.loss_and_grads(
+                        loss_fn, params, ids, train=False,
+                        intercept=_f))
+                g, kstate = kfac.step(kstate, grads, captures,
+                                      factor_update=_f, inv_update=_i)
+                updates, opt_state = tx.update(g, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, kstate, loss
+            variants[key] = jax.jit(impl)
+        return variants[key](params, opt_state, kstate)
+
+    f_freq, i_freq = 5, 10
+    n_steps = max(int(args.iters), 4 * i_freq)
+    spath = args.obs_baseline + '.source.jsonl'
+    sink = obs_sink.JsonlMetricsSink(
+        spath, meta={'bench': 'flagship_lm_obs_baseline',
+                     'size': args.size, 'seq': args.seq,
+                     'batch': args.batch, 'vocab': args.vocab,
+                     'backend': jax.default_backend()})
+    footprint = None
+    # Warm every variant outside the recorded window (first calls are
+    # compiles, not step times).
+    for flags in ((True, True), (True, False), (False, False)):
+        out = step(params, opt_state, kstate, *flags)
+        jax.block_until_ready(out[0])
+    for i in range(n_steps):
+        flags = engine.cadence_flags(i, f_freq, i_freq)
+        t0 = _time.perf_counter()
+        params, opt_state, kstate, loss = step(
+            params, opt_state, kstate, flags['factor_update'],
+            flags['inv_update'])
+        jax.block_until_ready(params)
+        dt = (_time.perf_counter() - t0) * 1000.0
+        sink.step_record(i, {'loss': loss}, host_step_ms=dt,
+                         fired=engine.fired_stage(flags))
+        if i % i_freq == 0:
+            if footprint is None:
+                footprint = obs_memory.state_footprint(kstate)
+            sink.memory_record(
+                i, device=obs_memory.device_memory_stats(),
+                state=footprint)
+    sink.close()
+    records, _ = obs_sink.read_jsonl_tolerant(spath)
+    metrics = obs_gate.gate_metrics(records)
+    obj = obs_gate.write_baseline(
+        metrics, args.obs_baseline,
+        meta={'bench': 'flagship_lm_obs_baseline',
+              'workload': (f'transformer_lm_{args.size}_seq{args.seq}'
+                           f'_b{args.batch}_v{args.vocab}'),
+              'backend': jax.default_backend(),
+              'cadence': f'f{f_freq}_i{i_freq}',
+              'source': spath})
+    emit({'obs_baseline': args.obs_baseline, **obj['metrics']})
+
+
+# ---------------------------------------------------------------------------
 # Orchestrator
 # ---------------------------------------------------------------------------
 
@@ -425,9 +527,19 @@ def main(argv=None):
                         'bucket_parts (LPT per-matrix packing, the '
                         'runtime plan) — max_chunk_ms is the residual '
                         'spike a pipelined window pays per step')
+    p.add_argument('--obs-baseline', default=None, metavar='PATH',
+                   help='record a per-step metrics stream at this '
+                        'config and reduce it to a committed '
+                        'observability-gate baseline JSON (see '
+                        'observability.gate; the stream itself lands '
+                        'at PATH.source.jsonl). Use --size small on '
+                        'CPU.')
     p.add_argument('--phase', default=None,
                    help='internal: run one phase in this process')
     args = p.parse_args(argv)
+
+    if args.obs_baseline:
+        return run_obs_baseline(args)
 
     if args.phase:
         return run_phase(args)

@@ -77,6 +77,23 @@ def initialize_multihost(coordinator_address: str | None = None,
             'global_devices': jax.device_count()}
 
 
+def host_metadata() -> dict:
+    """Identity of THIS host for per-rank telemetry (r10).
+
+    The straggler shards (``observability.stragglers``) stamp this into
+    each shard's meta record so a skewed rank in a merged report can be
+    mapped back to a machine — 'rank 13 is slow' is actionable only
+    once rank 13 has a hostname.
+    """
+    import platform
+
+    return {'process_index': jax.process_index(),
+            'process_count': jax.process_count(),
+            'hostname': platform.node(),
+            'backend': jax.default_backend(),
+            'local_devices': jax.local_device_count()}
+
+
 def _detected_world_size() -> int:
     """Process count declared by the launch environment (1 if unknown)."""
     for var in ('SLURM_NTASKS', 'OMPI_COMM_WORLD_SIZE',

@@ -19,7 +19,15 @@ Four parts, one discipline — *observing a run must not change it*:
   - :mod:`tracing` — the legacy host-side ``trace()`` table (still
     re-exported from ``distributed_kfac_pytorch_tpu.utils``).
   - :mod:`report` — ``python -m ...observability.report run.jsonl``
-    offline step-time + health summary.
+    offline step-time + health summary (``--json`` for machines).
+  - :mod:`memory` — device HBM watermarks + resident K-FAC state
+    footprint breakdown (the ``kind='memory'`` records, r10).
+  - :mod:`stragglers` — per-rank sink shards, the pre-collective
+    barrier-wait probe, and the cross-host skew merger (r10).
+  - :mod:`gate` — ``python -m ...observability.gate run.jsonl
+    --baseline BASELINE_OBS.json`` CI regression gate over step-time
+    percentiles / peak HBM / retraces, plus online anomaly checks
+    (r10).
 
 Only the leaf modules (tracing, profiling) import eagerly — the rest
 load on first attribute access so ``ops``/``layers`` can take profiler
@@ -32,7 +40,8 @@ import importlib
 
 from distributed_kfac_pytorch_tpu.observability import profiling, tracing
 
-_LAZY = ('metrics', 'sink', 'health', 'report', 'cli')
+_LAZY = ('metrics', 'sink', 'health', 'report', 'cli', 'memory',
+         'stragglers', 'gate')
 
 __all__ = ['tracing', 'profiling', *_LAZY]
 

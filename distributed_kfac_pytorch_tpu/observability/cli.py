@@ -5,8 +5,10 @@ observability surface; this module is its single implementation:
 
     add_observability_args(parser)       # --kfac-metrics / --metrics-
                                          # interval / --health-action /
-                                         # --profile-dir
+                                         # --profile-dir / --memory-
+                                         # interval / --straggler-shards
     sink = make_metrics_sink(args, info, meta={...})
+    rank_sink = make_rank_shard_sink(args, info)     # r10 stragglers
     profile_epoch(args, info, epoch, start_epoch)   # context manager
 """
 
@@ -50,6 +52,32 @@ def add_observability_args(p) -> None:
                    help='capture a jax.profiler trace of the first '
                         'trained epoch into this dir (kfac/* named '
                         'stage scopes attribute step time; rank 0 only)')
+    p.add_argument('--memory-interval', type=int, default=100,
+                   help='emit a memory-telemetry record (device HBM '
+                        'watermarks + resident K-FAC state footprint '
+                        'by group/dtype) every N steps into the '
+                        'metrics JSONL; 0 disables. Host-side reads '
+                        'only — the step program is untouched. '
+                        'Requires --kfac-metrics')
+    p.add_argument('--no-perf-anomalies', action='store_true',
+                   help='disable the LIVE perf-anomaly monitors '
+                        '(plain-step spike z-score, monotonic memory '
+                        'growth) that --health-action otherwise arms '
+                        'alongside the numerics checks. Use with '
+                        '--health-action raise when a run must die on '
+                        'NaNs but survive host jitter; the offline '
+                        'gate still replays both checks from the '
+                        'recorded stream')
+    p.add_argument('--straggler-shards', action='store_true',
+                   help='every host writes its own sink shard '
+                        '(PATH.rank<r>) with per-step dispatch wall '
+                        'time and pre-collective barrier-wait, for '
+                        'mesh-wide straggler attribution '
+                        '(observability.report merges the shards). '
+                        'The barrier probe blocks the host on device '
+                        'completion each step — costs async-dispatch '
+                        'pipelining, so only enable when hunting '
+                        'skew. Requires --kfac-metrics')
 
 
 def wants_guard(args) -> bool:
@@ -71,20 +99,56 @@ def make_metrics_sink(args, info, meta: dict | None = None):
     if args.health_action and not args.kfac_metrics:
         raise SystemExit('--health-action requires --kfac-metrics '
                          '(the monitor consumes the drained metrics)')
+    if getattr(args, 'straggler_shards', False) and not args.kfac_metrics:
+        raise SystemExit('--straggler-shards requires --kfac-metrics '
+                         '(shards live next to the metrics path)')
     if not args.kfac_metrics:
         return None
-    path = (os.path.join(args.log_dir, 'kfac_metrics.jsonl')
-            if args.kfac_metrics == 'auto' else args.kfac_metrics)
+    path = metrics_path(args)
     monitor = None
     if args.health_action:
         cov_freq = max(1, int(getattr(args, 'kfac_cov_update_freq', 1)))
+        # r10 online anomaly monitors: a plain step landing 8 sigmas
+        # off the running mean, or the device watermark climbing
+        # monotonically — the same signatures the gate checks offline,
+        # surfaced live through the warn/skip/raise action. Opt out
+        # with --no-perf-anomalies (e.g. raise-on-NaN CI on a noisy
+        # shared host, where jitter must not abort the run).
+        perf = not getattr(args, 'no_perf_anomalies', False)
         monitor = obs_health.HealthMonitor(
             action=args.health_action,
-            stale_after_steps=10 * cov_freq)
+            stale_after_steps=10 * cov_freq,
+            step_spike_zscore=8.0 if perf else None,
+            memory_growth_windows=6 if perf else 0)
     return obs_sink.JsonlMetricsSink(
         path, interval=args.metrics_interval,
         process_index=info['process_index'], monitor=monitor,
         meta=meta)
+
+
+def metrics_path(args) -> str:
+    """The resolved --kfac-metrics path (single point of truth for the
+    main stream, the rank shards, and any post-run report/gate call)."""
+    return (os.path.join(args.log_dir, 'kfac_metrics.jsonl')
+            if args.kfac_metrics == 'auto' else args.kfac_metrics)
+
+
+def make_rank_shard_sink(args, info, meta: dict | None = None):
+    """Per-rank straggler shard sink for a CLI (or None when off).
+
+    Every process gets a WRITING sink at ``<metrics-path>.rank<r>``
+    (the inverse of the main stream's rank-0 gate). The shard's meta
+    carries ``launch.host_metadata()`` so the merged report can name
+    the slow machine, not just its rank.
+    """
+    if not getattr(args, 'straggler_shards', False):
+        return None
+    from distributed_kfac_pytorch_tpu import launch
+    from distributed_kfac_pytorch_tpu.observability import stragglers
+
+    shard_meta = {**launch.host_metadata(), **(meta or {})}
+    return stragglers.make_rank_shard_sink(
+        metrics_path(args), info['process_index'], meta=shard_meta)
 
 
 @contextlib.contextmanager

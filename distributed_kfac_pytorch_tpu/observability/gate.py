@@ -1,0 +1,292 @@
+"""Automated performance-regression gate over a recorded metrics JSONL.
+
+    python -m distributed_kfac_pytorch_tpu.observability.gate \\
+        run.jsonl --baseline BASELINE_OBS.json
+
+The ROADMAP's "as fast as the hardware allows" north star finally gets
+a tripwire (r10): the gate reduces a run's stream to a small metric
+vector —
+
+  - ``step_p50_ms`` / ``step_p95_ms`` / ``step_p99_ms``: the host
+    dispatch step-time distribution (the same percentiles the report
+    prints; p50 is throughput, p95/p99 are the firing-spike tail the
+    r9 pipelined firing flattens);
+  - ``max_over_median``: the spike ratio (step-time uniformity);
+  - ``peak_hbm_bytes``: the highest device ``peak_bytes_in_use`` seen
+    in the ``kind='memory'`` records (the KAISA memory axis — absent
+    on backends without allocator stats, e.g. CPU);
+  - ``retraces``: count of ``retrace`` events from the step builder's
+    variant cache — the offline cross-check of the host-side
+    ``trace_counts`` guard; any value above the baseline's (normally
+    0) means a static-cadence program variant recompiled mid-run.
+
+— and compares it against a committed baseline with per-metric
+relative tolerances, exiting non-zero on any breach so CI can block
+the PR. ``--write-baseline`` reduces a known-good run to the committed
+file (see ``BASELINE_OBS.json``, seeded by
+``benchmarks/flagship_lm.py --obs-baseline``; PERF.md r10 has the
+decision rule for which breaches block).
+
+Independent of the baseline, the gate also replays the stream through
+the ONLINE anomaly monitors (``observability.health``): the step-time
+spike z-score and the monotonic memory-growth detector. A single 2x
+spike moves no percentile but is still a regression symptom; a leak
+is monotone long before it is an OOM. Anomalies gate like breaches
+(``--no-anomaly`` opts out).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import time
+
+from distributed_kfac_pytorch_tpu.observability import health as obs_health
+from distributed_kfac_pytorch_tpu.observability import report as obs_report
+from distributed_kfac_pytorch_tpu.observability.sink import (
+    read_jsonl_tolerant,
+)
+
+BASELINE_FORMAT = 'kfac-obs-baseline-v1'
+
+# Per-metric relative tolerances (fraction above baseline that still
+# passes). 'retraces' is absolute: a baseline of 0 retraces tolerates
+# exactly 0. Current values may always be BETTER than baseline.
+DEFAULT_TOLERANCES = {
+    'step_p50_ms': 0.10,
+    'step_p95_ms': 0.15,
+    'step_p99_ms': 0.25,
+    'max_over_median': 0.25,
+    'peak_hbm_bytes': 0.05,
+    'retraces': 0.0,
+}
+_ABSOLUTE_METRICS = ('retraces',)
+
+
+def gate_metrics(records: list[dict]) -> dict:
+    """Reduce a record stream to the gated metric vector."""
+    from distributed_kfac_pytorch_tpu.observability.sink import (
+        peak_hbm_bytes,
+    )
+    dist = obs_report.step_time_distribution(records)
+    peak = peak_hbm_bytes(records)
+    retraces = sum(1 for r in records
+                   if r.get('kind') == 'event'
+                   and r.get('event') == 'retrace')
+    out = {
+        'n_steps': dist['n_steps'] if dist else 0,
+        'step_p50_ms': dist['p50_ms'] if dist else None,
+        'step_p95_ms': dist['p95_ms'] if dist else None,
+        'step_p99_ms': dist['p99_ms'] if dist else None,
+        'max_over_median': (dist['max_over_median'] if dist else None),
+        'peak_hbm_bytes': peak,
+        'retraces': retraces,
+    }
+    for k, v in out.items():
+        if isinstance(v, float) and not math.isfinite(v):
+            out[k] = None
+    return out
+
+
+def compare(current: dict, baseline: dict,
+            tolerances: dict | None = None,
+            allow_missing: bool = False) -> tuple[list[dict], list[str]]:
+    """Gate ``current`` against ``baseline``.
+
+    Returns ``(breaches, skipped)``. A metric present in the baseline
+    but absent from the current run is a breach (the regression the
+    gate exists for could be hiding exactly there) unless
+    ``allow_missing`` — the documented escape for platform differences
+    (a CPU dev box has no HBM watermarks to compare against a TPU
+    baseline). Metrics absent from the baseline are skipped: a
+    baseline only vouches for what it measured.
+    """
+    tolerances = {**DEFAULT_TOLERANCES, **(tolerances or {})}
+    breaches, skipped = [], []
+    for metric, tol in tolerances.items():
+        base = baseline.get(metric)
+        if base is None:
+            skipped.append(f'{metric}: not in baseline')
+            continue
+        cur = current.get(metric)
+        if cur is None:
+            if allow_missing:
+                skipped.append(f'{metric}: absent from this run '
+                               '(allowed)')
+                continue
+            breaches.append({'metric': metric, 'current': None,
+                             'baseline': base, 'limit': None,
+                             'kind': 'missing'})
+            continue
+        if metric in _ABSOLUTE_METRICS:
+            limit = base + tol
+        else:
+            limit = base * (1.0 + tol)
+        if cur > limit:
+            breaches.append({'metric': metric, 'current': cur,
+                             'baseline': base, 'limit': limit,
+                             'kind': 'regression'})
+    return breaches, skipped
+
+
+def anomaly_events(records: list[dict], *,
+                   spike_zscore: float = 8.0,
+                   growth_windows: int = 6,
+                   growth_min_frac: float = 0.05) -> list[str]:
+    """Replay the stream through the online anomaly monitors.
+
+    Returns only the perf-anomaly events (step-time spike, memory
+    growth) — the numerics checks (non-finite, damping, staleness)
+    have their own surface in the report/health path and are not this
+    gate's business.
+    """
+    mon = obs_health.HealthMonitor(
+        action='skip', step_spike_zscore=spike_zscore,
+        memory_growth_windows=growth_windows,
+        memory_growth_min_frac=growth_min_frac)
+    for r in records:
+        if r.get('kind') in ('step', 'memory'):
+            mon.observe(r)
+    return [e for e in mon.events
+            if 'step-time spike' in e or 'memory grew' in e]
+
+
+def write_baseline(metrics: dict, path: str,
+                   meta: dict | None = None) -> dict:
+    """Serialize a gate baseline file (the committed artifact)."""
+    obj = {'format': BASELINE_FORMAT,
+           'created_unix': int(time.time()),
+           'meta': dict(meta or {}),
+           'metrics': {k: v for k, v in metrics.items()
+                       if v is not None}}
+    with open(path, 'w') as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+        f.write('\n')
+    return obj
+
+
+def read_baseline(path: str) -> dict:
+    with open(path) as f:
+        obj = json.load(f)
+    if obj.get('format') != BASELINE_FORMAT:
+        raise ValueError(
+            f'{path}: not a {BASELINE_FORMAT} file '
+            f'(format={obj.get("format")!r})')
+    metrics = obj.get('metrics')
+    if not isinstance(metrics, dict):
+        raise ValueError(f'{path}: baseline has no metrics object')
+    return obj
+
+
+def _parse_tols(pairs: list[str]) -> dict:
+    out = {}
+    for pair in pairs:
+        key, _, val = pair.partition('=')
+        if key not in DEFAULT_TOLERANCES:
+            raise ValueError(
+                f'unknown gate metric {key!r} '
+                f'(one of {sorted(DEFAULT_TOLERANCES)})')
+        try:
+            out[key] = float(val)
+        except ValueError:
+            raise ValueError(f'--tol {pair!r}: not KEY=FLOAT') from None
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog='python -m distributed_kfac_pytorch_tpu.observability'
+             '.gate',
+        description='Performance-regression gate over a K-FAC metrics '
+                    'JSONL: step-time percentiles, peak HBM and '
+                    'retrace count vs a committed baseline, plus '
+                    'online anomaly checks. Exit 0 = pass, 1 = '
+                    'breach/anomaly, 2 = usage/read error.')
+    p.add_argument('jsonl', help='metrics stream from --kfac-metrics')
+    p.add_argument('--baseline', default=None,
+                   help='committed BASELINE_OBS.json to gate against')
+    p.add_argument('--write-baseline', default=None, metavar='PATH',
+                   help='reduce this (known-good) run to a baseline '
+                        'file instead of gating')
+    p.add_argument('--tol', action='append', default=[],
+                   metavar='METRIC=FRAC',
+                   help='override one tolerance (relative fraction; '
+                        'retraces is an absolute count), e.g. '
+                        '--tol step_p95_ms=0.2; repeatable')
+    p.add_argument('--allow-missing', action='store_true',
+                   help='a baseline metric absent from this run is '
+                        'skipped instead of breaching (platform '
+                        'differences, e.g. no HBM stats on CPU)')
+    p.add_argument('--no-anomaly', action='store_true',
+                   help='skip the online anomaly replay (spike '
+                        'z-score, memory growth)')
+    p.add_argument('--spike-zscore', type=float, default=8.0)
+    p.add_argument('--growth-windows', type=int, default=6)
+    p.add_argument('--growth-min-frac', type=float, default=0.05)
+    p.add_argument('--json', action='store_true',
+                   help='machine-readable verdict on stdout')
+    args = p.parse_args(argv)
+
+    try:
+        records, torn = read_jsonl_tolerant(args.jsonl)
+        tols = _parse_tols(args.tol)
+        baseline = (read_baseline(args.baseline)
+                    if args.baseline else None)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f'error: {e}', file=sys.stderr)
+        return 2
+    current = gate_metrics(records)
+
+    if args.write_baseline:
+        obj = write_baseline(current, args.write_baseline,
+                             meta={'source': args.jsonl,
+                                   'torn_lines': torn})
+        print(f'wrote baseline {args.write_baseline}: '
+              + json.dumps(obj['metrics'], sort_keys=True))
+        if not args.baseline:
+            return 0
+
+    breaches, skipped = ([], [])
+    if baseline is not None:
+        breaches, skipped = compare(current, baseline['metrics'], tols,
+                                    allow_missing=args.allow_missing)
+    anomalies = [] if args.no_anomaly else anomaly_events(
+        records, spike_zscore=args.spike_zscore,
+        growth_windows=args.growth_windows,
+        growth_min_frac=args.growth_min_frac)
+    failed = bool(breaches or anomalies)
+
+    if args.json:
+        print(json.dumps({'pass': not failed, 'current': current,
+                          'baseline': (baseline or {}).get('metrics'),
+                          'breaches': breaches, 'skipped': skipped,
+                          'anomalies': anomalies,
+                          'torn_lines': torn}, sort_keys=True))
+        return 1 if failed else 0
+
+    print('== K-FAC observability gate ==')
+    if torn:
+        print(f'note: skipped {torn} torn trailing line(s)')
+    print('current: ' + json.dumps(current, sort_keys=True))
+    if baseline is None:
+        print('no --baseline: anomaly checks only')
+    for s in skipped:
+        print(f'  skip   {s}')
+    for b in breaches:
+        if b['kind'] == 'missing':
+            print(f"  BREACH {b['metric']}: absent from this run "
+                  f"(baseline {b['baseline']:g}; --allow-missing to "
+                  'skip)')
+        else:
+            print(f"  BREACH {b['metric']}: {b['current']:g} > limit "
+                  f"{b['limit']:g} (baseline {b['baseline']:g})")
+    for a in anomalies:
+        print(f'  ANOMALY {a}')
+    print('FAIL' if failed else 'PASS')
+    return 1 if failed else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -26,10 +26,31 @@ Checks (each one host-arithmetic over scalars — zero device work):
     0.0 clip floor) rises past ``eig_clip_limit`` — rising-edge
     detection, so a persistently floored (stable, damping-covered)
     spectrum fires once per new high, not once per record.
+  - **step-time spike** (r10, ``step_spike_zscore``): a step's host
+    dispatch time lands more than z sigmas above the running
+    mean/stddev of the plain (non-firing) steps seen so far. Steps
+    carrying a ``fired`` stage are excluded from both the statistics
+    and the detection — factor/inverse firings are *expected* spikes
+    with their own attribution in the report, and the engine labels a
+    step whose wall time absorbed a variant trace+XLA-compile
+    ``fired='compile'`` for the same reason (one absorbed 20 s
+    compile sample would inflate the running stddev enough to blind
+    the detector for the rest of the run). This check exists for
+    the unexpected spikes (a data-loader stall, a host page-in, a
+    sick chip). The stddev is floored at 1%% of the mean so
+    near-constant step streams don't turn fp jitter into infinite z.
+  - **memory growth** (r10, ``memory_growth_windows``): the
+    ``kind='memory'`` records' ``bytes_in_use`` watermark rises over N
+    consecutive samples by more than ``memory_growth_min_frac`` of the
+    run's starting value — the leak signature (a healthy run's resident
+    state is flat after warmup; a retrace leak or host-buffer
+    accumulation is monotone). Fires once per sustained climb (latched
+    until the watermark dips), not per sample.
 
 The monitor runs at sink drain time (off the step path) — see
 ``JsonlMetricsSink(monitor=...)`` — or standalone over records from
-``sink.read_jsonl``.
+``sink.read_jsonl`` (that is how ``observability.gate`` replays a
+recorded stream through the same anomaly checks offline).
 """
 
 from __future__ import annotations
@@ -54,30 +75,79 @@ class HealthMonitor:
     def __init__(self, action: str = 'warn', *,
                  stale_after_steps: int | None = None,
                  damping_jump_factor: float = 10.0,
-                 eig_clip_limit: int = 0):
+                 eig_clip_limit: int = 0,
+                 step_spike_zscore: float | None = None,
+                 step_spike_warmup: int = 16,
+                 memory_growth_windows: int = 0,
+                 memory_growth_min_frac: float = 0.05):
         if action not in ACTIONS:
             raise ValueError(f'action must be one of {ACTIONS}, '
                              f'got {action!r}')
+        if step_spike_zscore is not None and step_spike_zscore <= 0:
+            raise ValueError(f'{step_spike_zscore=} must be positive')
         self.action = action
         self.stale_after_steps = stale_after_steps
         self.damping_jump_factor = damping_jump_factor
         self.eig_clip_limit = eig_clip_limit
+        self.step_spike_zscore = step_spike_zscore
+        self.step_spike_warmup = max(2, int(step_spike_warmup))
+        self.memory_growth_windows = int(memory_growth_windows)
+        self.memory_growth_min_frac = memory_growth_min_frac
         self.events: list[str] = []
         self._last_factor_updates: float | None = None
         self._last_factor_step: int | None = None
         self._last_damping: float | None = None
         self._nonfinite_skips = 0.0
         self._max_eig_clipped = float(eig_clip_limit)
+        # Welford accumulators over plain (unfired) steps' dispatch ms.
+        self._ms_n = 0
+        self._ms_mean = 0.0
+        self._ms_m2 = 0.0
+        # Memory-growth run state (consecutive-rise tracking).
+        self._mem_prev: float | None = None
+        self._mem_run_start: float | None = None
+        self._mem_run_len = 0
+        self._mem_latched = False
 
     # -- the checks ----------------------------------------------------
 
     def observe(self, rec: dict) -> list[str]:
         """Consume one record; returns (and acts on) new events."""
+        if rec.get('kind') == 'memory':
+            events = self._observe_memory(rec)
+            self.events.extend(events)
+            for e in events:
+                self._act(e)
+            return events
         if rec.get('kind') != 'step':
             return []
         step = int(rec.get('step', 0))
         m = rec.get('metrics', {})
         events: list[str] = []
+
+        ms = rec.get('host_step_ms')
+        if self.step_spike_zscore is not None and \
+                isinstance(ms, (int, float)) and math.isfinite(ms) \
+                and 'fired' not in rec:
+            # Plain steps only: firing steps are expected outliers with
+            # their own report attribution. Spike check BEFORE the
+            # Welford update so the spike cannot vouch for itself.
+            if self._ms_n >= self.step_spike_warmup:
+                var = self._ms_m2 / (self._ms_n - 1)
+                std = max(math.sqrt(max(var, 0.0)),
+                          0.01 * self._ms_mean, 1e-9)
+                z = (ms - self._ms_mean) / std
+                if z > self.step_spike_zscore:
+                    events.append(
+                        f'step {step}: step-time spike {ms:.3g} ms is '
+                        f'{z:.1f} sigma above the plain-step mean '
+                        f'{self._ms_mean:.3g} ms (threshold '
+                        f'{self.step_spike_zscore:g}) — no K-FAC stage '
+                        'fired this step; suspect host/data/chip')
+            self._ms_n += 1
+            delta = ms - self._ms_mean
+            self._ms_mean += delta / self._ms_n
+            self._ms_m2 += delta * (ms - self._ms_mean)
 
         skips = _num(m.get('kfac/nonfinite_skips'))
         if not math.isnan(skips) and skips > self._nonfinite_skips:
@@ -139,6 +209,38 @@ class HealthMonitor:
         self.events.extend(events)
         for e in events:
             self._act(e)
+        return events
+
+    def _observe_memory(self, rec: dict) -> list[str]:
+        """Monotonic device-memory-growth detection (leak signature)."""
+        if not self.memory_growth_windows:
+            return []
+        b = rec.get('device', {}).get('bytes_in_use')
+        if not isinstance(b, (int, float)) or not math.isfinite(b):
+            return []
+        b = float(b)
+        events: list[str] = []
+        if self._mem_prev is None or b <= self._mem_prev:
+            # Flat or falling watermark: a healthy steady state. Reset
+            # the run and re-arm the latch.
+            self._mem_run_start = b
+            self._mem_run_len = 0
+            self._mem_latched = False
+        else:
+            self._mem_run_len += 1
+            start = self._mem_run_start or b
+            grown = (b - start) / start if start > 0 else 0.0
+            if (not self._mem_latched
+                    and self._mem_run_len >= self.memory_growth_windows
+                    and grown > self.memory_growth_min_frac):
+                events.append(
+                    f"step {rec.get('step', '?')}: device memory grew "
+                    f'monotonically over {self._mem_run_len} samples '
+                    f'({start:.4g} -> {b:.4g} bytes_in_use, '
+                    f'+{grown * 100:.1f}%) — leak signature (resident '
+                    'K-FAC state should be flat after warmup)')
+                self._mem_latched = True
+        self._mem_prev = b
         return events
 
     def _act(self, event: str) -> None:

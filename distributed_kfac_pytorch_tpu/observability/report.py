@@ -14,15 +14,31 @@ Prints, from the recorded stream alone (no live process needed):
     preconditioned-grad norm ratio;
   - per precondition-bucket norms (last recorded step);
   - resilience events (r8): preemption / checkpoint-save / restore
-    counts with checkpoint-save latency stats.
+    counts with checkpoint-save latency stats;
+  - memory telemetry (r10): device HBM watermarks (last/peak) and the
+    resident K-FAC state footprint by group/dtype;
+  - compile/retrace telemetry (r10): per-variant first-call wall time
+    from the step builder's (factor, inv, chunk) variant cache, and
+    any retrace events (the offline echo of the ``trace_counts``
+    guard);
+  - straggler attribution (r10): when per-rank shards
+    (``run.jsonl.rank<r>``, ``--straggler-shards``) sit next to the
+    stream, per-host skew, slowest-rank frequency and barrier-wait
+    stats.
 
-Exit status is non-zero when the file fails schema validation, so the
-CI smoke can gate on it directly.
+A torn/truncated FINAL line (a host crashed mid-append) is skipped and
+counted in the header instead of refusing the stream; torn lines
+anywhere else are corruption and still fail. Exit status is non-zero
+when the file fails schema validation, so the CI smoke can gate on it
+directly. ``--json`` emits the machine-readable summary the
+regression gate and CI consume (key set pinned by
+tests/test_obs_perf.py).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import math
 import sys
 
@@ -30,7 +46,9 @@ from distributed_kfac_pytorch_tpu.observability.health import (
     HealthMonitor,
 )
 from distributed_kfac_pytorch_tpu.observability.sink import (
-    read_jsonl,
+    peak_hbm_bytes,
+    percentile as _percentile,
+    read_jsonl_tolerant,
     to_float as _num,
 )
 
@@ -39,17 +57,6 @@ def _fmt(v: float, unit: str = '') -> str:
     if math.isnan(v):
         return '-'
     return f'{v:.4g}{unit}'
-
-
-def _percentile(sorted_vals: list[float], q: float) -> float:
-    """Linear-interpolated percentile of an ascending-sorted list."""
-    if not sorted_vals:
-        return float('nan')
-    pos = (len(sorted_vals) - 1) * q / 100.0
-    lo = int(math.floor(pos))
-    hi = min(lo + 1, len(sorted_vals) - 1)
-    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (
-        pos - lo)
 
 
 def step_time_distribution(records: list[dict]) -> dict | None:
@@ -148,7 +155,33 @@ def summarize(records: list[dict]) -> dict:
                 for r in events if r['event'] == 'checkpoint_save']
     save_lat = [v for v in save_lat if not math.isnan(v)]
 
+    # Memory telemetry (r10): device watermarks + state footprint.
+    mem_records = [r for r in records if r.get('kind') == 'memory']
+    memory = None
+    if mem_records:
+        peak = peak_hbm_bytes(mem_records)
+        last_state = next((r['state'] for r in reversed(mem_records)
+                           if r.get('state')), {})
+        memory = {'n_samples': len(mem_records),
+                  'peak_hbm_bytes': peak,
+                  'last_device': dict(mem_records[-1].get('device',
+                                                          {})),
+                  'last_state': dict(last_state)}
+
+    # Compile/retrace telemetry (r10): the step builder's variant
+    # cache emits one 'compile' event per variant (first-call wall =
+    # trace + XLA compile + first dispatch) and a 'retrace' event if a
+    # variant ever re-traces — which the static-cadence contract
+    # forbids (trace_counts guard).
+    compiles = [dict(r.get('data', {})) for r in events
+                if r['event'] == 'compile']
+    retraces = [dict(r.get('data', {})) for r in events
+                if r['event'] == 'retrace']
+
     return {
+        'memory': memory,
+        'compiles': compiles,
+        'retraces': retraces,
         'events': events,
         'event_counts': event_counts,
         'save_latency_ms': ((sum(save_lat) / len(save_lat),
@@ -177,10 +210,14 @@ def summarize(records: list[dict]) -> dict:
     }
 
 
-def print_report(s: dict, out=None) -> None:
+def print_report(s: dict, out=None, torn: int = 0,
+                 stragglers: dict | None = None) -> None:
     out = out or sys.stdout
     w = lambda line='': print(line, file=out)
     w('== K-FAC run report ==')
+    if torn:
+        w(f'note: skipped {torn} torn trailing line(s) (crash '
+          'mid-write; the rest of the stream is intact)')
     if s['meta']:
         w('meta: ' + ', '.join(f'{k}={v}' for k, v in
                                sorted(s['meta'].items())))
@@ -243,11 +280,82 @@ def print_report(s: dict, out=None) -> None:
         w('-- precondition buckets (last step, |v| per shape) --')
         for k in sorted(s['bucket_norms']):
             w(f'{k:<16} {_fmt(s["bucket_norms"][k])}')
-    if s['event_counts']:
+    if s.get('memory'):
+        from distributed_kfac_pytorch_tpu.observability.memory import (
+            format_bytes,
+        )
+        m = s['memory']
+        w()
+        w(f"-- memory ({m['n_samples']} samples) --")
+        if m['peak_hbm_bytes'] is not None:
+            w(f"peak device HBM: {format_bytes(m['peak_hbm_bytes'])}")
+        dev = m['last_device']
+        if dev:
+            parts = [f'{k}={format_bytes(v)}' for k, v in sorted(
+                dev.items()) if k in ('bytes_in_use',
+                                      'peak_bytes_in_use',
+                                      'bytes_limit')]
+            if parts:
+                w('last sample: ' + '  '.join(parts))
+        else:
+            w('(no device allocator stats on this backend — state '
+              'footprint only)')
+        st = m['last_state']
+        if st.get('total_bytes'):
+            w('resident K-FAC state (per device): '
+              f"{format_bytes(st['total_bytes'])}")
+            for gk in sorted(st.get('by_group_dtype', {})):
+                w(f'  {gk:<24} '
+                  f"{format_bytes(st['by_group_dtype'][gk])}")
+    if s.get('compiles') or s.get('retraces'):
+        w()
+        w(f"-- compile/retrace ({len(s['compiles'])} variant "
+          'compile(s)) --')
+        for ev in s['compiles']:
+            w(f"  compile {ev.get('variant', '?'):<28} "
+              f"first call {_fmt(_num(ev.get('first_call_ms')), ' ms')}")
+        if s['retraces']:
+            w(f"  ! {len(s['retraces'])} RETRACE event(s) — a "
+              'static-cadence variant recompiled mid-run '
+              '(trace_counts contract violated):')
+            for ev in s['retraces']:
+                w(f"    {ev.get('variant', '?')} trace #"
+                  f"{ev.get('trace_count', '?')}")
+    if stragglers:
+        w()
+        w(f"-- stragglers ({stragglers['n_ranks']} rank shard(s), "
+          f"{stragglers['n_common_steps']} common steps) --")
+        for rank in sorted(stragglers.get('unreadable', {})):
+            w(f"  ! rank {rank} shard unreadable: "
+              f"{stragglers['unreadable'][rank]}")
+        for rank in sorted(stragglers['per_rank']):
+            pr = stragglers['per_rank'][rank]
+            wait = ('' if pr['mean_wait_ms'] is None else
+                    f"  wait mean {_fmt(pr['mean_wait_ms'], ' ms')}"
+                    f" max {_fmt(pr['max_wait_ms'], ' ms')}")
+            w(f"  rank {rank}: {pr['n_steps']} steps  "
+              f"p50 {_fmt(pr['p50_ms'], ' ms')}  "
+              f"p95 {_fmt(pr['p95_ms'], ' ms')}{wait}")
+        if stragglers['n_common_steps']:
+            counts = ', '.join(
+                f'r{r}x{n}' for r, n in sorted(
+                    stragglers['slowest_counts'].items()) if n)
+            w(f'  slowest-rank frequency: {counts or "-"}')
+            mean_skew = stragglers['mean_skew_ms']
+            max_skew = stragglers['max_skew_ms']
+            w(f"  per-step skew (slowest-fastest): mean "
+              f"{_fmt(float('nan') if mean_skew is None else mean_skew, ' ms')}"
+              f"  max "
+              f"{_fmt(float('nan') if max_skew is None else max_skew, ' ms')}")
+    # Compile/retrace events have their own section above; everything
+    # else in the event stream is resilience lifecycle (r8).
+    resil_counts = {k: v for k, v in s['event_counts'].items()
+                    if k not in ('compile', 'retrace')}
+    if resil_counts:
         w()
         w('-- resilience events --')
-        for name in sorted(s['event_counts']):
-            w(f'{name:<18} x{s["event_counts"][name]}')
+        for name in sorted(resil_counts):
+            w(f'{name:<18} x{resil_counts[name]}')
         if s['save_latency_ms']:
             mean, worst = s['save_latency_ms']
             w(f'checkpoint save latency: mean {_fmt(mean, " ms")}  '
@@ -266,22 +374,91 @@ def print_report(s: dict, out=None) -> None:
         w('no health events.')
 
 
+def _json_safe(x):
+    """Recursively replace non-finite floats (json.dumps would emit
+    bare NaN/Infinity, which strict parsers — and the gate — reject)
+    and coerce tuple keys/values into JSON-clean structures."""
+    if isinstance(x, dict):
+        return {str(k): _json_safe(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_json_safe(v) for v in x]
+    if isinstance(x, float) and not math.isfinite(x):
+        return None
+    return x
+
+
+def summary_json(s: dict, *, torn: int = 0,
+                 stragglers: dict | None = None) -> dict:
+    """The machine-readable report (``--json``; consumed by the gate
+    and CI). Top-level key set is part of the contract — pinned by
+    tests/test_obs_perf.py; extend, don't rename."""
+    return _json_safe({
+        'meta': s['meta'],
+        'n_records': s['n_records'],
+        'n_steps': s['n_steps'],
+        'n_epochs': s['n_epochs'],
+        'step_range': s['step_range'],
+        'step_time': s['step_time'],
+        'stages': s['stages'],
+        'memory': s['memory'],
+        'compiles': s['compiles'],
+        'retraces': s['retraces'],
+        'event_counts': s['event_counts'],
+        'kfac': {
+            'factor_updates': s['factor_updates'],
+            'inv_updates': s['inv_updates'],
+            'inv_chunk_firings': s['inv_chunk_firings'],
+            'nonfinite_skips': s['nonfinite_skips'],
+            'eig_clipped': s['eig_clipped'],
+            'bucket_norms': s['bucket_norms'],
+        },
+        'health_events': s['health_events'],
+        'stragglers': stragglers,
+        'torn_lines': torn,
+    })
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog='python -m distributed_kfac_pytorch_tpu.observability'
              '.report',
         description='Summarize a recorded K-FAC metrics JSONL '
                     '(schema-validates; non-zero exit on invalid '
-                    'files).')
+                    'files). A torn FINAL line is skipped and counted, '
+                    'not fatal.')
     p.add_argument('jsonl', help='metrics file from --kfac-metrics '
                                  '(rotated segments are read too)')
+    p.add_argument('--json', action='store_true',
+                   help='machine-readable summary on stdout (the gate/'
+                        'CI input; key set pinned by tests)')
     args = p.parse_args(argv)
+    from distributed_kfac_pytorch_tpu.observability import (
+        stragglers as straggler_mod,
+    )
     try:
-        records = read_jsonl(args.jsonl)
+        records, torn = read_jsonl_tolerant(args.jsonl)
+        shards, shard_torn, shard_errors = straggler_mod.merge_shards(
+            args.jsonl)
     except (OSError, ValueError) as e:
         print(f'error: {e}', file=sys.stderr)
         return 1
-    print_report(summarize(records))
+    torn += shard_torn
+    stragglers = straggler_mod.straggler_summary(shards)
+    if shard_errors:
+        # Unreadable shards degrade the straggler section, never the
+        # main report (one sick host must not hide the run summary).
+        if stragglers is None:
+            stragglers = {'n_ranks': 0, 'per_rank': {},
+                          'n_common_steps': 0, 'slowest_counts': {},
+                          'mean_skew_ms': None, 'max_skew_ms': None}
+        stragglers['unreadable'] = shard_errors
+    s = summarize(records)
+    if args.json:
+        print(json.dumps(summary_json(s, torn=torn,
+                                      stragglers=stragglers),
+                         sort_keys=True))
+        return 0
+    print_report(s, torn=torn, stragglers=stragglers)
     from distributed_kfac_pytorch_tpu.observability.sink import (
         incarnation_paths,
         read_incarnation,

@@ -18,28 +18,47 @@ Design constraints (ISSUE r7):
     a full segment is renamed to ``<path>.<n>`` and a fresh one starts.
 
 Record schema (``schema`` = :data:`SCHEMA_VERSION`; the reader accepts
-v1/v2 files too — v2 only *added* the ``event`` kind for the r8
+v1-v3 files too — v2 only *added* the ``event`` kind for the r8
 resilience subsystem, v3 only adds the optional step ``fired`` field
-for r9 step-time attribution):
+for r9 step-time attribution, v4 only adds the ``memory`` kind for the
+r10 memory telemetry):
 
-  {"schema": 3, "kind": "step",  "step": int, "wall_time": float,
+  {"schema": 4, "kind": "step",  "step": int, "wall_time": float,
    "host_step_ms": float?, "fired": str?,
    "metrics": {flat name -> float}}
                      # "fired": the heaviest statically-gated K-FAC
                      # stage this step ran ('factor' / 'inverse' /
                      # 'chunk<j>'); absent on plain steps. The report's
                      # step-time outlier attribution keys on it.
-  {"schema": 3, "kind": "epoch", "epoch": int, "wall_time": float,
+  {"schema": 4, "kind": "epoch", "epoch": int, "wall_time": float,
    "metrics": {...averaged epoch metrics...}, "trace": {stage: {...}}}
-  {"schema": 3, "kind": "meta",  "wall_time": float, "meta": {...}}
-  {"schema": 3, "kind": "event", "event": str, "wall_time": float,
+  {"schema": 4, "kind": "meta",  "wall_time": float, "meta": {...}}
+  {"schema": 4, "kind": "event", "event": str, "wall_time": float,
    "data": {...}}    # resilience: preemption / checkpoint_save (with
                      # latency_ms) / restore — always kept (no
                      # interval thinning) and flushed immediately,
-                     # because the runs that emit them tend to die next
+                     # because the runs that emit them tend to die next.
+                     # r10 adds compile/retrace events from the step
+                     # builder's variant cache (data: variant,
+                     # first_call_ms / trace_count).
+  {"schema": 4, "kind": "memory", "step": int, "wall_time": float,
+   "device": {bytes_in_use, peak_bytes_in_use, ...}?,
+   "state": {total_bytes, by_group, by_dtype, ...}?}
+                     # r10 memory telemetry: periodic device HBM
+                     # watermarks (observability.memory
+                     # .device_memory_stats — absent on backends
+                     # without allocator stats) plus the host-side
+                     # resident K-FAC state footprint breakdown
+                     # (state_footprint). The gate's peak-HBM metric
+                     # and the health monitor's growth detector read
+                     # these.
 
 ``validate_record`` / ``read_jsonl`` are the single schema authority,
-shared by the report CLI and the tests.
+shared by the report CLI and the tests. ``read_jsonl_tolerant`` is the
+crash-forensics reader: a process killed mid-append can leave a torn
+FINAL line (the per-rank straggler shards append without the atomic
+rewrite of the rank-0 stream); the tolerant reader skips-and-counts a
+trailing undecodable line instead of refusing the whole stream.
 """
 
 from __future__ import annotations
@@ -51,9 +70,9 @@ import re
 import time
 from typing import Any
 
-SCHEMA_VERSION = 3
-ACCEPTED_SCHEMAS = (1, 2, 3)
-RECORD_KINDS = ('meta', 'step', 'epoch', 'event')
+SCHEMA_VERSION = 4
+ACCEPTED_SCHEMAS = (1, 2, 3, 4)
+RECORD_KINDS = ('meta', 'step', 'epoch', 'event', 'memory')
 # Dead incarnations kept per metrics path (<path>.prev.1 newest ..
 # .prev.N oldest); older ones are pruned on relaunch.
 PREV_INCARNATIONS_KEPT = 5
@@ -68,6 +87,40 @@ def to_float(x) -> float:
         return float(x)
     except (TypeError, ValueError):
         return float('nan')
+
+
+def percentile(sorted_vals: list[float], q: float) -> float:
+    """Linear-interpolated percentile of an ascending-sorted list.
+
+    Single implementation shared by :mod:`report` (step-time
+    distribution, hence :mod:`gate`'s baseline metrics) and
+    :mod:`stragglers` (per-rank tables) — the gate compares report
+    numbers against baseline numbers, so the math must not fork.
+    """
+    if not sorted_vals:
+        return float('nan')
+    pos = (len(sorted_vals) - 1) * q / 100.0
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (
+        pos - lo)
+
+
+def peak_hbm_bytes(records: list[dict]) -> float | None:
+    """Highest device watermark across a stream's ``memory`` records
+    (``peak_bytes_in_use``, falling back to ``bytes_in_use``); None
+    when no record carries allocator stats. Shared by :mod:`report`
+    and :mod:`gate` — one place to learn a new allocator key.
+    """
+    peak = None
+    for r in records:
+        if r.get('kind') != 'memory':
+            continue
+        dev = r.get('device', {})
+        b = dev.get('peak_bytes_in_use', dev.get('bytes_in_use'))
+        if isinstance(b, (int, float)):
+            peak = b if peak is None else max(peak, b)
+    return peak
 
 
 def validate_record(rec: Any) -> None:
@@ -94,6 +147,12 @@ def validate_record(rec: Any) -> None:
             raise ValueError('event record missing event name')
         if 'data' in rec and not isinstance(rec['data'], dict):
             raise ValueError('event record data is not an object')
+    if kind == 'memory':
+        if not isinstance(rec.get('step'), int):
+            raise ValueError('memory record missing integer step')
+        for sub in ('device', 'state'):
+            if sub in rec and not isinstance(rec[sub], dict):
+                raise ValueError(f'memory record {sub} is not an object')
     if kind in ('step', 'epoch'):
         metrics = rec.get('metrics')
         if not isinstance(metrics, dict):
@@ -230,21 +289,64 @@ def read_jsonl(path: str, validate: bool = True) -> list[dict]:
     return records
 
 
-def _read_jsonl_file(p: str, validate: bool) -> list[dict]:
-    records = []
+def read_jsonl_tolerant(path: str, validate: bool = True
+                        ) -> tuple[list[dict], int]:
+    """:func:`read_jsonl`, but tolerant of a torn FINAL line per file.
+
+    A process killed mid-append (the per-rank straggler shards, or any
+    external writer without the atomic rewrite) leaves at most one
+    truncated trailing line per physical file. That line is skipped and
+    counted — returns ``(records, n_torn)`` so the report can surface
+    the skip instead of refusing the whole stream. An undecodable line
+    anywhere *else* is still corruption and raises: only the crash
+    window at the tail is a known-benign failure mode.
+    """
+    paths = _rotated_segments(path)
+    if os.path.exists(path):
+        paths.append(path)
+    if not paths:
+        raise FileNotFoundError(path)
+    records, torn = [], 0
+    for p in paths:
+        recs, t = _read_jsonl_file(p, validate, tolerate_torn_tail=True)
+        records.extend(recs)
+        torn += t
+    return records, torn
+
+
+def _read_jsonl_file(p: str, validate: bool,
+                     tolerate_torn_tail: bool = False
+                     ) -> list[dict] | tuple[list[dict], int]:
+    # Streaming with one deferred failure: a decode error is only
+    # "torn" if no further non-empty line follows it (the crash
+    # window is the tail by construction) — O(1) extra memory even on
+    # unrotated multi-GB streams.
+    records, torn = [], 0
+    deferred: tuple[int, Exception] | None = None
     with open(p) as f:
-        for i, line in enumerate(f):
-            line = line.strip()
+        for i, raw in enumerate(f):
+            line = raw.strip()
             if not line:
                 continue
+            if deferred is not None:
+                di, de = deferred
+                raise ValueError(f'{p}:{di + 1}: torn/invalid JSON '
+                                 f'line: {de}') from de
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError as e:
+                if tolerate_torn_tail:
+                    deferred = (i, e)
+                    continue
                 raise ValueError(f'{p}:{i + 1}: torn/invalid JSON '
                                  f'line: {e}') from e
             if validate:
                 validate_record(rec)
             records.append(rec)
+    if deferred is not None:
+        torn += 1
+    if tolerate_torn_tail:
+        return records, torn
     return records
 
 
@@ -400,6 +502,29 @@ class JsonlMetricsSink:
                               'wall_time': time.time(),
                               'data': dict(data)})
         self.flush()
+
+    def memory_record(self, step: int, device: dict | None = None,
+                      state: dict | None = None) -> None:
+        """Record one memory-telemetry sample (r10).
+
+        ``device``: allocator watermarks from
+        ``observability.memory.device_memory_stats`` (bytes_in_use /
+        peak_bytes_in_use; omit on backends without stats). ``state``:
+        the host-side K-FAC state footprint breakdown from
+        ``state_footprint``. Bypasses interval thinning (the engine
+        already samples on its own ``memory_interval`` cadence) but
+        drains with the normal flush cadence — watermarks are periodic
+        telemetry, not last-words events.
+        """
+        if not self.enabled:
+            return
+        rec: dict = {'schema': SCHEMA_VERSION, 'kind': 'memory',
+                     'step': int(step), 'wall_time': time.time()}
+        if device:
+            rec['device'] = dict(device)
+        if state:
+            rec['state'] = dict(state)
+        self._pending.append(rec)
 
     # -- drain / write (off the step path) -----------------------------
 

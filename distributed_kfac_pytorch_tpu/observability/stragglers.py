@@ -1,0 +1,212 @@
+"""Per-host straggler attribution: rank shards, barrier probe, merger.
+
+The rank-0 JSONL stream (``observability.sink``) sees one host's view
+of the run. On a pod, the number that decides throughput is the
+*slowest* host — every COMM_OPT/KAISA collective in
+``parallel/distributed.py`` (factor pmean, inverse all_gather, gradient
+psum) runs at the straggler's pace, and the rank-0 stream cannot even
+see which host that is. Three pieces close the gap (r10):
+
+  - **Rank shards** (:func:`make_rank_shard_sink`): every process
+    writes its OWN sink shard ``<path>.rank<r>`` — same atomic
+    write-then-rename, rotation and incarnation chaining as the rank-0
+    stream (it *is* a ``JsonlMetricsSink``, force-enabled for its
+    rank). Each step record carries that host's dispatch wall time
+    plus its pre-collective barrier wait.
+  - **Barrier probe** (:func:`build_barrier_probe`, surfaced as
+    ``DistributedKFAC.build_barrier_probe``): a minimal ``psum`` over
+    the same mesh axes the K-FAC collectives reduce over, dispatched
+    and blocked on from the host. Because the device stream is
+    in-order, the blocking time is (own queue drain) + (wait for the
+    slowest participant to arrive) — i.e. exactly the wait the step's
+    first collective experiences. A fast host measures large waits; the
+    straggler measures ~0. NOTE: blocking the host each probe
+    serializes dispatch with device completion, so the probe is opt-in
+    (``--straggler-shards``) and its cost is documented in PERF.md —
+    the skew numbers are the point of such a run.
+  - **Merger** (:func:`merge_shards` / :func:`straggler_summary`):
+    ``observability.report`` reads the shards next to a stream and
+    prints per-host skew, slowest-rank frequency, and barrier-wait
+    attribution; the ``--json`` output feeds CI.
+
+Shard streams use the torn-tolerant reader: a host that dies
+mid-append loses at most its final line, not its whole shard.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+
+from distributed_kfac_pytorch_tpu.observability import sink as obs_sink
+from distributed_kfac_pytorch_tpu.observability.sink import (
+    percentile as _percentile,
+    to_float as _num,
+)
+
+# Metrics key carrying the probe measurement inside shard step records.
+BARRIER_WAIT_KEY = 'host/barrier_wait_ms'
+
+
+def rank_shard_path(path: str, rank: int) -> str:
+    """``run.jsonl`` -> ``run.jsonl.rank<r>`` (one shard per host)."""
+    return f'{path}.rank{int(rank)}'
+
+
+def make_rank_shard_sink(path: str, process_index: int, *,
+                         rotate_bytes: int | None = 4 * 1024 * 1024,
+                         drain_every: int = 64,
+                         meta: dict | None = None
+                         ) -> obs_sink.JsonlMetricsSink:
+    """A per-rank shard sink at ``rank_shard_path(path, rank)``.
+
+    Every process gets a WRITING sink (``process_index=0`` inside — the
+    shard path itself is the rank gate), unlike the rank-0-gated main
+    stream. The shard's meta record pins its rank so the merger can
+    cross-check the filename against the content.
+    """
+    shard_meta = {'rank': int(process_index), **(meta or {})}
+    return obs_sink.JsonlMetricsSink(
+        rank_shard_path(path, process_index), process_index=0,
+        rotate_bytes=rotate_bytes, drain_every=drain_every,
+        meta=shard_meta)
+
+
+def find_shards(path: str) -> dict[int, str]:
+    """Rank shards written next to a stream: ``{rank: shard_path}``.
+
+    Matches exactly ``<basename>.rank<digits>`` in the stream's
+    directory — rotated shard segments (``.rank0.1``) and incarnations
+    (``.rank0.prev.1``) belong to their shard's own reader, not here.
+    """
+    parent = os.path.dirname(os.path.abspath(path)) or '.'
+    base = os.path.basename(path)
+    pat = re.compile(re.escape(base) + r'\.rank(\d+)$')
+    out = {}
+    try:
+        names = os.listdir(parent)
+    except FileNotFoundError:
+        return {}
+    for name in names:
+        m = pat.match(name)
+        if m:
+            out[int(m.group(1))] = os.path.join(parent, name)
+    return dict(sorted(out.items()))
+
+
+def merge_shards(path: str, validate: bool = True
+                 ) -> tuple[dict[int, list[dict]], int, dict[int, str]]:
+    """Read every rank shard of a stream (torn- and fault-tolerant).
+
+    Returns ``({rank: records}, total_torn_lines, {rank: error})``.
+    Each shard is a full ``read_jsonl`` stream (rotated segments
+    stitch in), read with the tolerant tail. A shard that fails to
+    read ANYWAY (mid-file corruption, schema-invalid line — e.g. an
+    NFS half-write from a sick host) is skipped and reported in the
+    errors map rather than raised: one bad host must not make the
+    whole mesh's telemetry — or the intact rank-0 report — unreadable.
+    """
+    shards, torn, errors = {}, 0, {}
+    for rank, shard in find_shards(path).items():
+        try:
+            records, t = obs_sink.read_jsonl_tolerant(shard, validate)
+        except (OSError, ValueError) as e:
+            errors[rank] = str(e)
+            continue
+        shards[rank] = records
+        torn += t
+    return shards, torn, errors
+
+
+def straggler_summary(shards: dict[int, list[dict]]) -> dict | None:
+    """Cross-host skew analysis over merged rank shards.
+
+    Per rank: step count, p50/p95 dispatch ms, mean/max barrier-wait
+    ms. Across ranks (over steps every shard recorded): how often each
+    rank was the slowest (``slowest_counts`` — the straggler
+    attribution: a uniform spread is jitter, one dominant rank is a
+    sick host), and the mean/max per-step skew (slowest minus fastest
+    dispatch). Wait-time inverts the picture — the rank that waits
+    LEAST at the barrier is the one everyone else waits FOR.
+    """
+    per_rank: dict[int, dict] = {}
+    step_times: dict[int, dict[int, float]] = {}
+    for rank, records in shards.items():
+        times, waits = [], []
+        for r in records:
+            if r.get('kind') != 'step':
+                continue
+            ms = r.get('host_step_ms')
+            if isinstance(ms, (int, float)):
+                times.append(float(ms))
+                step_times.setdefault(int(r['step']), {})[rank] = float(
+                    ms)
+            w = _num(r.get('metrics', {}).get(BARRIER_WAIT_KEY))
+            if w == w:  # not NaN
+                waits.append(w)
+        if not times:
+            continue
+        svals = sorted(times)
+        per_rank[rank] = {
+            'n_steps': len(times),
+            'p50_ms': _percentile(svals, 50),
+            'p95_ms': _percentile(svals, 95),
+            'mean_wait_ms': (sum(waits) / len(waits) if waits else None),
+            'max_wait_ms': (max(waits) if waits else None),
+        }
+    if not per_rank:
+        return None
+    slowest: dict[int, int] = {r: 0 for r in per_rank}
+    skews = []
+    common = [s for s, by_rank in step_times.items()
+              if len(by_rank) == len(per_rank)]
+    for s in common:
+        by_rank = step_times[s]
+        worst = max(by_rank, key=by_rank.get)
+        slowest[worst] += 1
+        skews.append(max(by_rank.values()) - min(by_rank.values()))
+    return {
+        'n_ranks': len(per_rank),
+        'per_rank': per_rank,
+        'n_common_steps': len(common),
+        'slowest_counts': slowest,
+        'mean_skew_ms': (sum(skews) / len(skews) if skews else None),
+        'max_skew_ms': (max(skews) if skews else None),
+    }
+
+
+def build_barrier_probe(mesh, axes):
+    """Compile + warm a minimal psum barrier over ``axes`` of ``mesh``.
+
+    Returns ``probe() -> wait_ms``: dispatch a scalar psum over the
+    same axes the K-FAC collectives reduce over and block until it
+    completes. The measured wall time is this host's pre-collective
+    barrier wait (own-queue drain + slowest-participant arrival; see
+    the module docstring for why that is the right number and what it
+    costs). The program is compiled and run once HERE so the first
+    measured probe is not a compile.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_kfac_pytorch_tpu.observability import profiling
+
+    axes = tuple(axes)
+
+    def reduce(v):
+        with profiling.annotate('kfac/comm/barrier_probe'):
+            return jax.lax.psum(v, axes)
+
+    fn = jax.jit(jax.shard_map(reduce, mesh=mesh, in_specs=P(),
+                               out_specs=P(), check_vma=False))
+    x = jnp.zeros((), jnp.float32)
+    jax.block_until_ready(fn(x))  # compile outside the measured window
+
+    def probe() -> float:
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(x))
+        return (time.perf_counter() - t0) * 1000.0
+
+    return probe

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import time
 from typing import Any, Sequence
 
 import jax
@@ -1343,6 +1344,29 @@ class DistributedKFAC:
         return {**state, 'inv_stacks': stacks, 'diag_inv': diag,
                 'grouped_inv': grouped}
 
+    # -- straggler probe (r10 observability) ---------------------------
+
+    def build_barrier_probe(self):
+        """Host-side pre-collective barrier-wait probe for this mesh.
+
+        Returns ``probe() -> wait_ms``: a minimal psum over the same
+        data axes every K-FAC collective in this module reduces over
+        (the factor ``pmean``, the in-group inverse ``all_gather``,
+        the gradient/KL ``psum`` — COMM_OPT and KAISA alike), blocked
+        on from the host. Since the device stream is in-order, the
+        measured wall time is own-queue drain plus the wait for the
+        slowest participant — the wait this host's next collective
+        would pay. Compiled+warmed here; see
+        ``observability.stragglers`` for semantics and cost (the probe
+        serializes host dispatch with device completion — opt-in via
+        ``--straggler-shards``).
+        """
+        from distributed_kfac_pytorch_tpu.observability import (
+            stragglers,
+        )
+        return stragglers.build_barrier_probe(self.mesh,
+                                              self.data_axes)
+
     # -- full train step builder ---------------------------------------
 
     def build_train_step(self, loss_fn, tx, *, model_args_fn=None,
@@ -1662,8 +1686,19 @@ class DistributedKFAC:
                 # Host-side trace tally: this body re-executes exactly
                 # when jax retraces the variant, so the count pins
                 # PERF.md pitfall 3 (one compile per flag combination,
-                # ever) — asserted by the retrace-guard test.
-                trace_counts[key] = trace_counts.get(key, 0) + 1
+                # ever) — asserted by the retrace-guard test. A count
+                # above 1 additionally queues a 'retrace' telemetry
+                # event (drained into the metrics stream by the
+                # engine): the offline echo of the same contract, so a
+                # recorded run can be audited for mid-run recompiles
+                # (observability.gate regresses the count against 0).
+                n = trace_counts.get(key, 0) + 1
+                trace_counts[key] = n
+                if n > 1:
+                    compile_events.append(
+                        {'event': 'retrace',
+                         'variant': _variant_label(key),
+                         'trace_count': n})
                 kspecs = self.state_pspecs(kstate)
                 rep = P()
                 batch_specs = normalize_batch_specs(batch_spec, batch)
@@ -1706,6 +1741,11 @@ class DistributedKFAC:
         donate_argnums = (0, 1, 2, 3) if donate else ()
         variants: dict[tuple, Any] = {}
         trace_counts: dict[tuple, int] = {}
+        compile_events: list[dict] = []
+
+        def _variant_label(key) -> str:
+            f, i, c = key
+            return f'factor={f},inv={i},chunk={c}'
 
         def step(params, opt_state, kstate, extra_vars, batch, hyper,
                  factor_update: bool | None = None,
@@ -1718,16 +1758,35 @@ class DistributedKFAC:
             only pipelined chunk ``j`` of the inverse work (static int;
             requires ``inv_update`` falsy — see ``KFAC.step``)."""
             key = (factor_update, inv_update, inv_chunk)
-            if key not in variants:
+            first = key not in variants
+            if first:
                 variants[key] = jax.jit(make_step_impl(*key),
                                         donate_argnums=donate_argnums)
-            return variants[key](params, opt_state, kstate, extra_vars,
-                                 batch, hyper)
+                t0 = time.perf_counter()
+            out = variants[key](params, opt_state, kstate, extra_vars,
+                                batch, hyper)
+            if first:
+                # First-call wall = trace + XLA compile + dispatch (the
+                # execution itself is async, so this is dominated by
+                # compile — the 15-45 s/variant cost PERF.md pitfall 2
+                # is about). Queued, not written: the engine drains
+                # compile_events into the metrics sink off the step
+                # path; a sink-less caller just accumulates a short
+                # list (one entry per variant, ever).
+                compile_events.append(
+                    {'event': 'compile',
+                     'variant': _variant_label(key),
+                     'first_call_ms': (time.perf_counter() - t0)
+                     * 1000.0})
+            return out
 
         # Introspection for the engine's chunk scheduler and the
-        # retrace-guard test (host-side, no runtime cost).
+        # retrace-guard test (host-side, no runtime cost);
+        # compile_events additionally feeds the r10 compile/retrace
+        # telemetry (drained by engine.train_epoch).
         step.inv_pipeline_chunks = self.kfac.inv_pipeline_chunks
         step.trace_counts = trace_counts
+        step.compile_events = compile_events
         return step
 
 

@@ -84,7 +84,9 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
                 epoch_len: int | None = None,
                 static_cadence: tuple[int, int] | str | None = 'auto',
                 metrics_sink=None, checkpointer=None,
-                start_step_in_epoch: int = 0) -> dict[str, float]:
+                start_step_in_epoch: int = 0,
+                rank_sink=None, barrier_probe=None,
+                memory_interval: int = 0) -> dict[str, float]:
     """One training epoch; returns averaged metrics.
 
     ``hyper`` holds this epoch's dynamic hyperparameters ('lr', 'damping',
@@ -125,6 +127,26 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
     A resumed run whose offset already covers the whole epoch (the
     preemption landed on the final step) yields zero batches — that is
     treated as a completed epoch, not an error.
+
+    ``rank_sink``: THIS process's straggler shard sink
+    (``observability.stragglers.make_rank_shard_sink`` — every rank
+    writes its own ``<path>.rank<r>``, unlike the rank-0-gated
+    ``metrics_sink``). Each step's host dispatch time (and, with
+    ``barrier_probe``, the pre-collective barrier wait) is recorded so
+    ``observability.report`` can attribute mesh-wide skew to hosts.
+
+    ``barrier_probe``: ``DistributedKFAC.build_barrier_probe()`` (or
+    None). Called once per step BEFORE the step dispatch; the returned
+    wait-ms lands in the rank shard. NOTE: the probe blocks the host
+    on device completion each step (that is what it measures), so it
+    costs async-dispatch pipelining — only wired when straggler
+    attribution is requested.
+
+    ``memory_interval``: every Nth step, emit a ``kind='memory'``
+    record into ``metrics_sink`` — device allocator watermarks plus the
+    resident K-FAC state footprint (``observability.memory``). Pure
+    host-side reads (0 = off). The footprint is computed once per
+    epoch: the state's shapes/dtypes are static across steps.
     """
     if static_cadence == 'auto':
         import inspect
@@ -182,27 +204,86 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
     meters: dict[str, Metric] = {}
     t0 = time.perf_counter()
     n_batches = 0
+    state_footprint = None  # computed lazily, once per epoch
     for batch in batches:
         if static_cadence is not None:
             f_freq, i_freq = static_cadence
             flags = cadence_flags(state.step, f_freq, i_freq, chunks)
         else:
             flags = {}
+        wait_ms = None
+        if barrier_probe is not None:
+            # Straggler attribution: how long does THIS host wait for
+            # the rest of the mesh before its next collective could
+            # proceed? Measured before the dispatch so the wait is not
+            # conflated with this step's own compute.
+            wait_ms = barrier_probe()
         t_it = time.perf_counter()
         (state.params, state.opt_state, state.kfac_state, state.extra_vars,
          metrics) = step_fn(state.params, state.opt_state, state.kfac_state,
                             state.extra_vars, batch, hyper, **flags)
+        dt = time.perf_counter() - t_it
+        # A queued compile event right after the call means THIS step's
+        # wall time is dominated by trace+XLA compile, not training
+        # work. Label plain steps 'compile' so (a) the report's
+        # step-time attribution names the real culprit and (b) the
+        # health monitor's spike z-score excludes it — one absorbed
+        # 20 s compile sample would otherwise inflate the running
+        # stddev by orders of magnitude and blind the detector for the
+        # whole run. Steps that also fired a K-FAC stage keep that
+        # label (fired steps are excluded from spike stats anyway).
+        fired = fired_stage(flags)
+        pending = getattr(step_fn, 'compile_events', None)
+        if pending and fired is None:
+            fired = 'compile'
         if metrics_sink is not None:
             # Enqueue only (device scalars + async host copy): the sink
             # converts to floats at drain time, far behind dispatch.
-            dt = time.perf_counter() - t_it
             metrics_sink.step_record(state.step, metrics,
                                      host_step_ms=dt * 1000.0,
-                                     fired=fired_stage(flags))
+                                     fired=fired)
             # Feed the dispatch timing into the host trace table too,
             # so epoch snapshots (and the report's stage table) carry a
             # per-stage row even when no phase is @trace-decorated.
             tracing.record('train_step_dispatch', dt)
+            if memory_interval > 0 and state.step % memory_interval == 0:
+                from distributed_kfac_pytorch_tpu.observability import (
+                    memory as obs_memory,
+                )
+                if state_footprint is None:
+                    state_footprint = obs_memory.state_footprint(
+                        state.kfac_state)
+                metrics_sink.memory_record(
+                    state.step,
+                    device=obs_memory.device_memory_stats(),
+                    state=state_footprint)
+        if rank_sink is not None:
+            # Per-rank straggler shard: dispatch wall + barrier wait
+            # only (the full metric set already rides the rank-0
+            # stream; shards exist to compare HOSTS, not to duplicate
+            # it).
+            shard_metrics = {}
+            if wait_ms is not None:
+                from distributed_kfac_pytorch_tpu.observability import (
+                    stragglers as obs_stragglers,
+                )
+                shard_metrics[obs_stragglers.BARRIER_WAIT_KEY] = wait_ms
+            rank_sink.step_record(state.step, shard_metrics,
+                                  host_step_ms=dt * 1000.0,
+                                  fired=fired)
+        if metrics_sink is not None:
+            # Drain queued compile/retrace telemetry from the step
+            # builder's variant cache (r10): rare, host-side, and
+            # written as event records so the gate can regress the
+            # retrace count offline. Duck-typed sinks that predate
+            # event records (tests pass minimal step/epoch-only
+            # stand-ins) just leave the queue in place.
+            emit_event = getattr(metrics_sink, 'event_record', None)
+            if pending and emit_event is not None:
+                for ev in list(pending):
+                    data = {k: v for k, v in ev.items() if k != 'event'}
+                    emit_event(ev['event'], **data)
+                pending.clear()
         state.step += 1
         n_batches += 1
         for k, v in metrics.items():
@@ -217,6 +298,8 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
             except BaseException:
                 if metrics_sink is not None:
                     metrics_sink.flush()
+                if rank_sink is not None:
+                    rank_sink.flush()
                 raise
     elapsed = time.perf_counter() - t0
     if n_batches == 0:
@@ -237,6 +320,8 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
         metrics_sink.epoch_record(state.epoch, out,
                                   trace=tracing.snapshot_trace())
         metrics_sink.flush()
+    if rank_sink is not None:
+        rank_sink.flush()
     if log_writer is not None:
         for k, v in out.items():
             log_writer.scalar(f'train/{k}', v, state.epoch)

@@ -218,6 +218,8 @@ def main(argv=None):
                           'batch_size': args.batch_size,
                           'devices': n_dev,
                           'metrics_interval': args.metrics_interval})
+    rank_sink = obs.cli.make_rank_shard_sink(
+        args, info, meta={'cli': 'train_cifar10_resnet'})
 
     x0 = jnp.zeros((2, 32, 32, 3), jnp.float32)
     if kfac is not None:
@@ -280,6 +282,12 @@ def main(argv=None):
         model, lambda out, b: utils.label_smooth_loss(out, b[1], 0.0),
         mesh, model_args_fn=lambda b: (b[0],),
         model_kwargs={'train': False})
+    # Straggler barrier probe: only with shards requested AND a K-FAC
+    # step (the probe reduces over the K-FAC data axes; the SGD
+    # baseline's shards still carry per-host wall times without it).
+    barrier_probe = (dkfac.build_barrier_probe()
+                     if rank_sink is not None and dkfac is not None
+                     else None)
 
     state = engine.TrainState(params=params, opt_state=opt_state,
                               kfac_state=kstate, extra_vars=extra)
@@ -350,7 +358,9 @@ def main(argv=None):
                     step_fn, state, batches, hyper,
                     log_writer=writer, verbose=is_main,
                     metrics_sink=metrics_sink, checkpointer=step_ckpt,
-                    start_step_in_epoch=skip)
+                    start_step_in_epoch=skip,
+                    rank_sink=rank_sink, barrier_probe=barrier_probe,
+                    memory_interval=args.memory_interval)
             val_batches = launch.global_batches(
                 mesh, datasets.epoch_batches(
                     test_x, test_y, args.val_batch_size, shuffle=False,
@@ -385,6 +395,8 @@ def main(argv=None):
         mgr.wait_until_finished()
         if metrics_sink is not None:
             metrics_sink.close()
+        if rank_sink is not None:
+            rank_sink.close()
         if is_main:
             print(f'preempted ({p.reason}) at global step '
                   f'{p.global_step}; checkpoint saved — exiting '
@@ -394,6 +406,8 @@ def main(argv=None):
     mgr.wait_until_finished()  # async saves: durable before exit
     if metrics_sink is not None:
         metrics_sink.close()
+    if rank_sink is not None:
+        rank_sink.close()
     if writer is not None:
         writer.flush()
     if is_main:

@@ -277,6 +277,8 @@ def main(argv=None):
                           'batch_size': args.batch_size,
                           'devices': n_dev,
                           'metrics_interval': args.metrics_interval})
+    rank_sink = obs.cli.make_rank_shard_sink(
+        args, info, meta={'cli': 'train_imagenet_resnet'})
 
     x0 = jnp.zeros((2, args.image_size, args.image_size, 3), jnp.float32)
     if kfac is not None:
@@ -339,6 +341,11 @@ def main(argv=None):
         model, lambda out, b: utils.label_smooth_loss(out, b[1], 0.0),
         mesh, model_args_fn=lambda b: (b[0],),
         model_kwargs={'train': False})
+    # Straggler barrier probe: shards requested + a K-FAC step (the
+    # probe reduces over the K-FAC data axes).
+    barrier_probe = (dkfac.build_barrier_probe()
+                     if rank_sink is not None and dkfac is not None
+                     else None)
 
     state = engine.TrainState(params=params, opt_state=opt_state,
                               kfac_state=kstate, extra_vars=extra)
@@ -404,7 +411,9 @@ def main(argv=None):
                                           already_sharded=batches_local),
                     hyper, log_writer=writer, verbose=is_main,
                     metrics_sink=metrics_sink, checkpointer=step_ckpt,
-                    start_step_in_epoch=skip)
+                    start_step_in_epoch=skip,
+                    rank_sink=rank_sink, barrier_probe=barrier_probe,
+                    memory_interval=args.memory_interval)
             if args.precise_bn_batches > 0:
                 # Precise-BN: eval with stats re-estimated at the current
                 # weights; the training EWMA state is restored afterwards.
@@ -436,6 +445,8 @@ def main(argv=None):
         mgr.wait_until_finished()
         if metrics_sink is not None:
             metrics_sink.close()
+        if rank_sink is not None:
+            rank_sink.close()
         if is_main:
             print(f'preempted ({p.reason}) at global step '
                   f'{p.global_step}; checkpoint saved — exiting '
@@ -445,6 +456,8 @@ def main(argv=None):
     mgr.wait_until_finished()  # async saves: durable before exit
     if metrics_sink is not None:
         metrics_sink.close()
+    if rank_sink is not None:
+        rank_sink.close()
     if writer is not None:
         writer.flush()
     if is_main:

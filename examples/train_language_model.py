@@ -264,6 +264,8 @@ def main(argv=None):
                           'bptt': args.bptt,
                           'devices': n_dev,
                           'metrics_interval': args.metrics_interval})
+    rank_sink = obs.cli.make_rank_shard_sink(
+        args, info, meta={'cli': 'train_language_model'})
     if args.grad_clip:
         tx = optax.chain(optax.clip_by_global_norm(args.grad_clip), tx)
 
@@ -340,6 +342,11 @@ def main(argv=None):
         build_model(args, vocab_size, seq_axis=None), eval_loss, None,
         model_args_fn=lambda b: (b[0],), model_kwargs={'train': False},
         metrics_fn=lambda o, b: {})
+    # Straggler barrier probe: shards requested + a K-FAC step (the
+    # probe reduces over the K-FAC data axes).
+    barrier_probe = (dkfac.build_barrier_probe()
+                     if rank_sink is not None and dkfac is not None
+                     else None)
 
     state = engine.TrainState(params=params, opt_state=opt_state,
                               kfac_state=kstate,
@@ -421,7 +428,9 @@ def main(argv=None):
                         batch_spec=(data_spec, data_spec, P())),
                     hyper, log_writer=writer, verbose=is_main,
                     metrics_sink=metrics_sink, checkpointer=step_ckpt,
-                    start_step_in_epoch=skip)
+                    start_step_in_epoch=skip,
+                    rank_sink=rank_sink, barrier_probe=barrier_probe,
+                    memory_interval=args.memory_interval)
             val_m = engine.evaluate(
                 eval_step, state,
                 launch.global_batches(
@@ -446,6 +455,8 @@ def main(argv=None):
         mgr.wait_until_finished()
         if metrics_sink is not None:
             metrics_sink.close()
+        if rank_sink is not None:
+            rank_sink.close()
         if is_main:
             print(f'preempted ({p.reason}) at global step '
                   f'{p.global_step}; checkpoint saved — exiting '
@@ -455,6 +466,8 @@ def main(argv=None):
     mgr.wait_until_finished()  # async saves: durable before exit
     if metrics_sink is not None:
         metrics_sink.close()
+    if rank_sink is not None:
+        rank_sink.close()
     if writer is not None:
         writer.flush()
     if is_main:

@@ -1,9 +1,15 @@
 #!/usr/bin/env bash
-# Fast-tier observability smoke (r7): 3 CPU steps of the CIFAR CLI with
-# --kfac-metrics, then schema-validate the emitted JSONL via the report
-# CLI (non-zero exit on invalid streams). The same check runs in the
-# test suite as tests/test_observability.py::test_cifar_cli_metrics_smoke;
-# this wrapper is the standalone/CI-pipeline form.
+# Fast-tier observability smoke (r7, extended r10): 3 CPU steps of the
+# CIFAR CLI with --kfac-metrics + per-rank straggler shards + memory
+# telemetry, then:
+#   1. schema-validate the emitted JSONL via the report CLI (non-zero
+#      exit on invalid streams) — the shard/memory sections ride along;
+#   2. emit the machine-readable report (--json);
+#   3. reduce the run to a gate baseline and re-gate the run against
+#      itself (a clean self-baseline run must PASS).
+# The same checks run in the suite as tests/test_observability.py::
+# test_cifar_cli_metrics_smoke + tests/test_obs_perf.py; this wrapper
+# is the standalone/CI-pipeline form.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -18,8 +24,32 @@ python examples/train_cifar10_resnet.py \
     --no-resume \
     --log-dir "$out/logs" --checkpoint-dir "$out/ckpt" \
     --kfac-metrics "$out/metrics.jsonl" \
-    --metrics-interval 1 --health-action raise
+    --metrics-interval 1 --health-action raise \
+    --straggler-shards --memory-interval 1
+
+test -f "$out/metrics.jsonl.rank0" || {
+    echo "missing straggler shard metrics.jsonl.rank0" >&2; exit 1; }
 
 python -m distributed_kfac_pytorch_tpu.observability.report \
     "$out/metrics.jsonl"
+python -m distributed_kfac_pytorch_tpu.observability.report \
+    "$out/metrics.jsonl" --json > "$out/report.json"
+python - "$out/report.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r['n_steps'] == 3, r['n_steps']
+assert r['stragglers'] and r['stragglers']['n_ranks'] == 1
+assert r['memory'] and r['memory']['n_samples'] >= 1
+assert r['compiles'], 'no compile events recorded'
+assert not r['retraces'], r['retraces']
+print('report --json OK')
+EOF
+
+# Gate: a clean run must pass against its own baseline (3 steps is too
+# few for the percentile metrics to be meaningful, but the plumbing —
+# reduce, write, compare, exit code — is exactly the CI path).
+python -m distributed_kfac_pytorch_tpu.observability.gate \
+    "$out/metrics.jsonl" --write-baseline "$out/BASELINE_OBS.json"
+python -m distributed_kfac_pytorch_tpu.observability.gate \
+    "$out/metrics.jsonl" --baseline "$out/BASELINE_OBS.json"
 echo "metrics smoke OK"

@@ -31,7 +31,8 @@ def _configure(n_local_devices=4):
 
 
 def run_training(n_steps=3, metrics_path=None, process_index=0,
-                 checkpoint_dir=None, kill_at=None, resume=False):
+                 checkpoint_dir=None, kill_at=None, resume=False,
+                 rank_shards=False):
     """Build a small conv net + DistributedKFAC on the global mesh and
     train ``n_steps`` deterministic steps through ``global_batches``.
 
@@ -55,6 +56,14 @@ def run_training(n_steps=3, metrics_path=None, process_index=0,
     ``resume=True`` restores the newest step checkpoint (``like=`` the
     live sharded state) and replays only the remaining global batches,
     so a relaunched world must reproduce the uninterrupted run.
+
+    ``rank_shards=True`` (r10, requires ``metrics_path``): EVERY
+    process additionally writes its own straggler shard
+    ``<metrics_path>.rank<r>`` with per-step dispatch wall time and
+    the pre-collective barrier wait from
+    ``DistributedKFAC.build_barrier_probe`` — the 2-process
+    write->merge path ``observability.report``'s straggler section
+    rests on (asserted by test_multihost mode='stragglers').
     """
     import jax
     import jax.numpy as jnp
@@ -116,6 +125,16 @@ def run_training(n_steps=3, metrics_path=None, process_index=0,
             metrics_path, interval=1, process_index=process_index,
             meta={'mode': 'multihost-metrics',
                   'process_index': process_index})
+    rank_sink, probe = None, None
+    if rank_shards:
+        import time
+
+        from distributed_kfac_pytorch_tpu.observability import (
+            stragglers as obs_stragglers,
+        )
+        rank_sink = obs_stragglers.make_rank_shard_sink(
+            metrics_path, process_index, meta=launch.host_metadata())
+        probe = dkfac.build_barrier_probe()
 
     mgr, start = None, 0
     if checkpoint_dir is not None:
@@ -143,11 +162,18 @@ def run_training(n_steps=3, metrics_path=None, process_index=0,
     extra = {}
     for i, batch in enumerate(
             launch.global_batches(mesh, iter(raw[start:])), start=start):
+        wait_ms = probe() if probe is not None else None
+        t_it = time.perf_counter() if rank_sink is not None else None
         params, opt_state, kstate, extra, metrics = step(
             params, opt_state, kstate, extra, batch, hyper,
             factor_update=True, inv_update=(i % 2 == 0))
         if sink is not None:
             sink.step_record(i, metrics)
+        if rank_sink is not None:
+            rank_sink.step_record(
+                i, {obs_stragglers.BARRIER_WAIT_KEY: wait_ms},
+                host_step_ms=(time.perf_counter() - t_it) * 1000.0,
+                fired='inverse' if i % 2 == 0 else 'factor')
         losses.append(float(jax.device_get(metrics['loss'])))
         if mgr is not None:
             # Collective blocking save: every process participates;
@@ -161,6 +187,8 @@ def run_training(n_steps=3, metrics_path=None, process_index=0,
                 os._exit(1)  # the killed worker: no cleanup, no goodbye
     if sink is not None:
         sink.close()
+    if rank_sink is not None:
+        rank_sink.close()
     if mgr is not None:
         mgr.close()
     params_host = jax.tree.map(
@@ -302,6 +330,15 @@ def main():
         # same path; only rank 0 writes (the gating under test).
         run_training(metrics_path=out_path,
                      process_index=info['process_index'])
+        print(f'worker {pid} done', flush=True)
+        return
+    if mode == 'stragglers':
+        # r10: rank-0 stream PLUS one straggler shard per process
+        # (out_path.rank0 / .rank1), each carrying per-step wall +
+        # barrier-wait — the write half of the shard merge path.
+        run_training(metrics_path=out_path,
+                     process_index=info['process_index'],
+                     rank_shards=True)
         print(f'worker {pid} done', flush=True)
         return
     if mode == 'resilience':

@@ -1,0 +1,669 @@
+"""r10 mesh-wide performance observability.
+
+Covers the ISSUE acceptance surface: schema-v4 back-compat over the
+committed v1/v2/v3 fixtures, torn-tail tolerance, memory telemetry
+(device watermarks + state footprint), the per-rank straggler shards
+and their merger, compile/retrace telemetry from the step builder's
+variant cache, the report's machine-readable ``--json`` contract, and
+the regression gate (non-zero exit on an injected 2x step-time spike
+and a synthetic memory-growth run; pass on a clean self-baseline).
+"""
+
+import io
+import contextlib
+import json
+import os
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_kfac_pytorch_tpu.observability import gate as obs_gate
+from distributed_kfac_pytorch_tpu.observability import health as obs_health
+from distributed_kfac_pytorch_tpu.observability import memory as obs_memory
+from distributed_kfac_pytorch_tpu.observability import report as obs_report
+from distributed_kfac_pytorch_tpu.observability import sink as obs_sink
+from distributed_kfac_pytorch_tpu.observability import (
+    stragglers as obs_stragglers,
+)
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        'fixtures')
+
+
+# ---------------------------------------------------------------------------
+# Schema back-compat matrix (satellite: committed v1/v2/v3 fixtures)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('version,n_steps', [(1, 3), (2, 2), (3, 3),
+                                             (4, 2)])
+def test_schema_fixture_matrix(version, n_steps, capsys):
+    """Every historical schema version must validate and report under
+    the v4 reader — the fixtures are frozen files from each era, so a
+    reader change that breaks old streams fails HERE, not in a user's
+    post-mortem."""
+    path = os.path.join(FIXTURES, f'metrics_v{version}.jsonl')
+    records = obs_sink.read_jsonl(path)  # validates every line
+    assert all(r['schema'] == version for r in records)
+    steps = [r for r in records if r['kind'] == 'step']
+    assert len(steps) == n_steps
+    summary = obs_report.summarize(records)
+    assert summary['n_steps'] == n_steps
+    assert obs_report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert 'K-FAC run report' in out
+    assert f'fixture_v{version}' in out
+    if version >= 4:
+        # v4-only surfaces: the memory section and compile telemetry.
+        assert summary['memory']['peak_hbm_bytes'] == 2147483648
+        assert summary['compiles']
+        assert 'peak device HBM' in out
+
+
+def test_v4_writer_emits_current_schema(tmp_path):
+    s = obs_sink.JsonlMetricsSink(str(tmp_path / 'v4.jsonl'))
+    s.step_record(0, {'loss': 1.0})
+    s.memory_record(0, device={'bytes_in_use': 10},
+                    state={'total_bytes': 4})
+    s.close()
+    records = obs_sink.read_jsonl(str(tmp_path / 'v4.jsonl'))
+    assert all(r['schema'] == 4 for r in records)
+    assert [r['kind'] for r in records] == ['step', 'memory']
+
+
+# ---------------------------------------------------------------------------
+# Torn-tail tolerance (satellite: crash mid-write)
+# ---------------------------------------------------------------------------
+
+def test_torn_tail_fixture_tolerated(capsys):
+    path = os.path.join(FIXTURES, 'torn_tail.jsonl')
+    # The strict reader refuses...
+    with pytest.raises(ValueError, match='torn/invalid'):
+        obs_sink.read_jsonl(path)
+    # ...the tolerant reader skips-and-counts the final line only.
+    records, torn = obs_sink.read_jsonl_tolerant(path)
+    assert torn == 1
+    assert [r['step'] for r in records if r['kind'] == 'step'] == [0, 1]
+    # The report survives and surfaces the skip in its header.
+    assert obs_report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert 'skipped 1 torn trailing line(s)' in out
+
+
+def test_torn_midfile_still_raises(tmp_path):
+    """Only the crash window at the tail is benign; an undecodable line
+    mid-file is corruption for BOTH readers."""
+    p = tmp_path / 'mid.jsonl'
+    good = json.dumps({'schema': 4, 'kind': 'step', 'step': 0,
+                       'wall_time': 0.0, 'metrics': {}})
+    p.write_text(good + '\n{"schema": 4, "kind": "st\n' + good + '\n')
+    with pytest.raises(ValueError):
+        obs_sink.read_jsonl(str(p))
+    with pytest.raises(ValueError):
+        obs_sink.read_jsonl_tolerant(str(p))
+
+
+def test_merge_shards_tolerates_torn_shard(tmp_path):
+    path = tmp_path / 'run.jsonl'
+    s = obs_stragglers.make_rank_shard_sink(str(path), 0)
+    s.step_record(0, {obs_stragglers.BARRIER_WAIT_KEY: 0.1},
+                  host_step_ms=10.0)
+    s.close()
+    # Simulate a crash mid-append on the shard.
+    shard = obs_stragglers.rank_shard_path(str(path), 0)
+    with open(shard, 'a') as f:
+        f.write('{"schema": 4, "kind": "ste')
+    shards, torn, errors = obs_stragglers.merge_shards(str(path))
+    assert torn == 1 and errors == {}
+    assert [r['kind'] for r in shards[0]] == ['meta', 'step']
+
+
+def test_merge_shards_skips_unreadable_shard(tmp_path, capsys):
+    """Mid-file corruption in ONE shard (beyond torn-tail tolerance)
+    must not make the merger — or the main report — unreadable; the
+    sick rank is surfaced, the rest parse."""
+    path = tmp_path / 'run.jsonl'
+    main = obs_sink.JsonlMetricsSink(str(path))
+    main.step_record(0, {'loss': 1.0}, host_step_ms=10.0)
+    main.close()
+    good = obs_stragglers.make_rank_shard_sink(str(path), 0)
+    good.step_record(0, {}, host_step_ms=10.0)
+    good.close()
+    bad = obs_stragglers.rank_shard_path(str(path), 1)
+    with open(bad, 'w') as f:
+        f.write('{"schema": 4, "kind": "st\n'  # corrupt MID-file line
+                + json.dumps({'schema': 4, 'kind': 'step', 'step': 0,
+                              'wall_time': 0.0, 'metrics': {}}) + '\n')
+    shards, torn, errors = obs_stragglers.merge_shards(str(path))
+    assert sorted(shards) == [0]
+    assert sorted(errors) == [1] and 'torn/invalid' in errors[1]
+    assert obs_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert 'rank 1 shard unreadable' in out
+
+
+# ---------------------------------------------------------------------------
+# Memory telemetry
+# ---------------------------------------------------------------------------
+
+def test_state_footprint_breakdown():
+    state = {
+        'step': jnp.zeros((), jnp.int32),
+        'factors': {'d0': {'A': jnp.zeros((8, 8), jnp.float32),
+                           'G': jnp.zeros((4, 4), jnp.float32)}},
+        'inv_stacks': {'8': {'inv': jnp.zeros((2, 8, 8),
+                                              jnp.bfloat16)}},
+    }
+    fp = obs_memory.state_footprint(state)
+    factors = (8 * 8 + 4 * 4) * 4
+    inverses = 2 * 8 * 8 * 2
+    assert fp['by_group'] == {'factors': factors,
+                              'inverses': inverses,
+                              'other': 4}
+    assert fp['by_dtype']['float32'] == factors
+    assert fp['by_dtype']['int32'] == 4  # the step scalar
+    assert fp['by_dtype']['bfloat16'] == inverses
+    assert fp['by_group_dtype']['inverses/bfloat16'] == inverses
+    assert fp['total_bytes'] == factors + inverses + 4
+    # Non-dict states (the SGD baseline's None) degrade to zeros.
+    assert obs_memory.state_footprint(None)['total_bytes'] == 0
+
+
+def test_device_memory_stats_graceful():
+    """CPU backend: no allocator stats — must degrade to {} (the
+    memory records then carry the state footprint only), never raise."""
+    stats = obs_memory.device_memory_stats()
+    assert isinstance(stats, dict)
+    for v in stats.values():
+        assert isinstance(v, (int, float))
+
+
+def test_memory_record_roundtrip_and_report(tmp_path, capsys):
+    path = tmp_path / 'mem.jsonl'
+    s = obs_sink.JsonlMetricsSink(str(path))
+    s.step_record(0, {'loss': 1.0}, host_step_ms=10.0)
+    s.memory_record(0, device={'bytes_in_use': 1000,
+                               'peak_bytes_in_use': 2000},
+                    state={'total_bytes': 512,
+                           'by_group_dtype': {'factors/float32': 512}})
+    s.memory_record(1, device={'bytes_in_use': 900,
+                               'peak_bytes_in_use': 2000})
+    s.close()
+    records = obs_sink.read_jsonl(str(path))  # memory kind validates
+    summary = obs_report.summarize(records)
+    m = summary['memory']
+    assert m['n_samples'] == 2
+    assert m['peak_hbm_bytes'] == 2000
+    assert m['last_device']['bytes_in_use'] == 900
+    assert obs_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert 'peak device HBM' in out
+    assert 'factors/float32' in out
+
+
+# ---------------------------------------------------------------------------
+# Health: step-time spike z-score + memory growth
+# ---------------------------------------------------------------------------
+
+def _plain_step(i, ms, fired=None):
+    rec = {'schema': 4, 'kind': 'step', 'step': i, 'wall_time': 0.0,
+           'host_step_ms': ms, 'metrics': {}}
+    if fired:
+        rec['fired'] = fired
+    return rec
+
+
+def test_health_step_spike_zscore():
+    mon = obs_health.HealthMonitor(action='skip', step_spike_zscore=8.0,
+                                   step_spike_warmup=16)
+    for i in range(20):
+        assert mon.observe(_plain_step(i, 10.0 + 0.01 * (i % 5))) == []
+    # A fired inverse step twice the mean is EXPECTED — no event.
+    assert mon.observe(_plain_step(20, 20.0, fired='inverse')) == []
+    # The same spike on a plain step is the anomaly.
+    events = mon.observe(_plain_step(21, 20.0))
+    assert len(events) == 1 and 'step-time spike' in events[0]
+
+
+def test_health_memory_growth_latch():
+    mon = obs_health.HealthMonitor(action='skip',
+                                   memory_growth_windows=4,
+                                   memory_growth_min_frac=0.05)
+
+    def mem(i, b):
+        return {'schema': 4, 'kind': 'memory', 'step': i,
+                'wall_time': 0.0, 'device': {'bytes_in_use': b}}
+
+    # Flat: no events.
+    for i in range(6):
+        assert mon.observe(mem(i, 1000)) == []
+    # Monotone +3%/sample: fires once the run clears 4 windows AND 5%
+    # total, then latches (no re-fire while still climbing).
+    fired = []
+    b = 1000
+    for i in range(6, 16):
+        b = int(b * 1.03)
+        fired += mon.observe(mem(i, b))
+    assert len(fired) == 1 and 'memory grew' in fired[0]
+    # A dip re-arms the latch.
+    assert mon.observe(mem(99, 1000)) == []
+    b = 1000
+    refires = []
+    for i in range(100, 110):
+        b = int(b * 1.03)
+        refires += mon.observe(mem(i, b))
+    assert len(refires) == 1
+
+
+# ---------------------------------------------------------------------------
+# Straggler shards: single-process fast-tier path
+# ---------------------------------------------------------------------------
+
+def test_rank_shard_write_merge_and_summary(tmp_path, capsys):
+    path = tmp_path / 'run.jsonl'
+    # Main stream (rank 0) + two shards, as a 2-host run would leave.
+    main = obs_sink.JsonlMetricsSink(str(path))
+    main.step_record(0, {'loss': 1.0}, host_step_ms=10.0)
+    main.close()
+    for rank, base in ((0, 10.0), (1, 14.0)):  # rank 1 is the straggler
+        s = obs_stragglers.make_rank_shard_sink(
+            str(path), rank, meta={'hostname': f'host{rank}'})
+        for i in range(4):
+            s.step_record(
+                i, {obs_stragglers.BARRIER_WAIT_KEY:
+                    4.0 if rank == 0 else 0.1},
+                host_step_ms=base + 0.1 * i)
+        s.close()
+    assert sorted(obs_stragglers.find_shards(str(path))) == [0, 1]
+    shards, torn, errors = obs_stragglers.merge_shards(str(path))
+    assert torn == 0 and errors == {}
+    summary = obs_stragglers.straggler_summary(shards)
+    assert summary['n_ranks'] == 2
+    assert summary['n_common_steps'] == 4
+    # Rank 1 is slowest every step; rank 0 does all the waiting.
+    assert summary['slowest_counts'] == {0: 0, 1: 4}
+    assert summary['per_rank'][0]['mean_wait_ms'] == pytest.approx(4.0)
+    assert summary['per_rank'][1]['mean_wait_ms'] == pytest.approx(0.1)
+    assert summary['max_skew_ms'] == pytest.approx(4.0)
+    # Report CLI: straggler section present, exit 0.
+    assert obs_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert 'stragglers (2 rank shard(s)' in out
+    assert 'r1x4' in out
+
+
+def test_rank_shard_paths_do_not_collide_with_rotation(tmp_path):
+    """Shard filenames must be invisible to the main stream's rotated-
+    segment reader (run.jsonl.1) and vice versa."""
+    path = tmp_path / 'run.jsonl'
+    main = obs_sink.JsonlMetricsSink(str(path), rotate_bytes=120,
+                                     drain_every=1)
+    for i in range(6):
+        main.step_record(i, {'loss': float(i)})
+    main.close()
+    s = obs_stragglers.make_rank_shard_sink(str(path), 0)
+    s.step_record(0, {}, host_step_ms=1.0)
+    s.close()
+    # Main stream reassembles WITHOUT swallowing the shard...
+    steps = [r['step'] for r in obs_sink.read_jsonl(str(path))
+             if r['kind'] == 'step']
+    assert steps == list(range(6))
+    # ...and shard discovery sees exactly the one shard.
+    assert sorted(obs_stragglers.find_shards(str(path))) == [0]
+
+
+def test_barrier_probe_on_mesh():
+    from jax.sharding import Mesh
+
+    from distributed_kfac_pytorch_tpu.parallel import distributed as D
+
+    devs = np.asarray(jax.devices()).reshape(4, 2)
+    mesh = Mesh(devs, D.KFAC_AXES)
+    probe = obs_stragglers.build_barrier_probe(mesh, D.KFAC_AXES)
+    for _ in range(2):
+        w = probe()
+        assert isinstance(w, float) and w >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# Engine wiring: memory interval, rank shard, compile-event drain
+# ---------------------------------------------------------------------------
+
+def _fake_step(params, opt_state, kstate, extra, batch, hyper):
+    return params, opt_state, kstate, extra, {'loss': 1.0}
+
+
+def test_engine_memory_rank_and_compile_drain(tmp_path):
+    from distributed_kfac_pytorch_tpu.training import engine
+
+    path = tmp_path / 'run.jsonl'
+    sink = obs_sink.JsonlMetricsSink(str(path))
+    rank_sink = obs_stragglers.make_rank_shard_sink(str(path), 0)
+    state = engine.TrainState(
+        params={}, opt_state={},
+        kfac_state={'factors': {'a': jnp.zeros((4, 4), jnp.float32)}},
+        extra_vars={})
+    _fake_step.compile_events = [
+        {'event': 'compile', 'variant': 'fake', 'first_call_ms': 3.0}]
+    engine.train_epoch(_fake_step, state, [None] * 5, {},
+                       metrics_sink=sink, rank_sink=rank_sink,
+                       barrier_probe=lambda: 0.25, memory_interval=2)
+    sink.close()
+    rank_sink.close()
+    records = obs_sink.read_jsonl(str(path))
+    mems = [r for r in records if r['kind'] == 'memory']
+    assert [m['step'] for m in mems] == [0, 2, 4]
+    assert mems[0]['state']['total_bytes'] == 4 * 4 * 4
+    compiles = [r for r in records if r.get('event') == 'compile']
+    assert len(compiles) == 1
+    assert compiles[0]['data']['variant'] == 'fake'
+    # The step whose wall time absorbed the compile is labeled so the
+    # spike detector skips it and attribution names the real culprit.
+    steps = [r for r in records if r['kind'] == 'step']
+    assert steps[0].get('fired') == 'compile'
+    assert all('fired' not in r for r in steps[1:])
+    assert _fake_step.compile_events == []  # drained exactly once
+    shards, _, _ = obs_stragglers.merge_shards(str(path))
+    shard_steps = [r for r in shards[0] if r['kind'] == 'step']
+    assert len(shard_steps) == 5
+    for r in shard_steps:
+        assert r['metrics'][obs_stragglers.BARRIER_WAIT_KEY] == 0.25
+
+
+class TinyMLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.tanh(nn.Dense(8, name='d0')(x))
+        return nn.Dense(4, name='head')(x)
+
+
+def test_spmd_compile_events_and_zero_retraces(tmp_path):
+    """The real variant cache: a 2-variant static-cadence run emits one
+    compile event per variant into the stream, zero retrace events, and
+    the trace_counts guard still reads all-ones — with the new
+    telemetry fully on (the acceptance criterion's composition
+    check)."""
+    from distributed_kfac_pytorch_tpu.parallel import distributed as D
+    from distributed_kfac_pytorch_tpu.preconditioner import (
+        CommMethod,
+        KFAC,
+    )
+    from distributed_kfac_pytorch_tpu.training import engine
+
+    kfac = KFAC(TinyMLP(), factor_update_freq=2, inv_update_freq=2,
+                factor_decay=0.5, damping=0.01, lr=0.1, kl_clip=None)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 8))
+    variables, _ = kfac.init(jax.random.PRNGKey(0), x)
+    params = variables['params']
+    mesh = D.make_kfac_mesh(jax.devices()[:4],
+                            comm_method=CommMethod.COMM_OPT,
+                            grad_worker_fraction=0.5)
+    dkfac = D.DistributedKFAC(kfac, mesh, params)
+    dstate = dkfac.init_state(params)
+    tx = optax.sgd(0.05)
+    step = dkfac.build_train_step(lambda out, b: jnp.mean(out ** 2),
+                                  tx, donate=False)
+    path = tmp_path / 'run.jsonl'
+    sink = obs_sink.JsonlMetricsSink(str(path))
+    state = engine.TrainState(params, tx.init(params), dstate, {})
+    batch = (x, jnp.zeros((16,), jnp.int32))
+    hyper = {'lr': 0.05, 'damping': 0.01,
+             'factor_update_freq': 2, 'inv_update_freq': 2}
+    engine.train_epoch(step, state, [batch] * 4, hyper,
+                       metrics_sink=sink, memory_interval=2)
+    sink.close()
+    assert all(n == 1 for n in step.trace_counts.values()), \
+        step.trace_counts
+    records = obs_sink.read_jsonl(str(path))
+    compiles = [r for r in records if r.get('event') == 'compile']
+    retraces = [r for r in records if r.get('event') == 'retrace']
+    assert len(compiles) == 2  # (True,True,None) + (False,False,None)
+    assert retraces == []
+    variants = {c['data']['variant'] for c in compiles}
+    assert variants == {'factor=True,inv=True,chunk=None',
+                        'factor=False,inv=False,chunk=None'}
+    assert all(c['data']['first_call_ms'] > 0 for c in compiles)
+    # Fired-stage labels: step 0 fired the real stage (inverse wins
+    # over the compile it also paid); step 1's compile of the plain
+    # variant is labeled 'compile' (spike-stat exclusion); steady
+    # plain steps carry no label.
+    step_recs = [r for r in records if r['kind'] == 'step']
+    assert step_recs[0]['fired'] == 'inverse'
+    assert step_recs[1]['fired'] == 'compile'
+    assert 'fired' not in step_recs[3]
+    assert obs_gate.gate_metrics(records)['retraces'] == 0
+    # Memory records rode along from the real SPMD state.
+    mems = [r for r in records if r['kind'] == 'memory']
+    assert mems and mems[0]['state']['by_group'].get('inverses', 0) > 0
+
+
+def test_cli_no_perf_anomalies_flag(tmp_path):
+    """--health-action arms the live spike/growth monitors by default;
+    --no-perf-anomalies keeps the numerics checks but disarms them
+    (raise-on-NaN CI on a jittery shared host)."""
+    import argparse
+
+    from distributed_kfac_pytorch_tpu.observability import (
+        cli as obs_cli,
+    )
+
+    p = argparse.ArgumentParser()
+    p.add_argument('--log-dir', default=str(tmp_path))
+    obs_cli.add_observability_args(p)
+    base = ['--kfac-metrics', str(tmp_path / 'm.jsonl'),
+            '--health-action', 'skip']
+    info = {'process_index': 0}
+    mon = obs_cli.make_metrics_sink(p.parse_args(base), info).monitor
+    assert mon.step_spike_zscore == 8.0
+    assert mon.memory_growth_windows == 6
+    mon2 = obs_cli.make_metrics_sink(
+        p.parse_args(base + ['--no-perf-anomalies']), info).monitor
+    assert mon2.step_spike_zscore is None
+    assert mon2.memory_growth_windows == 0
+
+
+# ---------------------------------------------------------------------------
+# report --json (satellite: machine-readable contract)
+# ---------------------------------------------------------------------------
+
+REPORT_JSON_KEYS = {
+    'meta', 'n_records', 'n_steps', 'n_epochs', 'step_range',
+    'step_time', 'stages', 'memory', 'compiles', 'retraces',
+    'event_counts', 'kfac', 'health_events', 'stragglers',
+    'torn_lines',
+}
+
+
+def test_report_json_key_contract(tmp_path, capsys):
+    path = tmp_path / 'run.jsonl'
+    s = obs_sink.JsonlMetricsSink(str(path), meta={'run': 'json'})
+    for i in range(4):
+        s.step_record(i, {'loss': 1.0, 'kfac/factor_updates': i + 1},
+                      host_step_ms=10.0)
+    s.memory_record(3, device={'bytes_in_use': 100})
+    s.close()
+    assert obs_report.main([str(path), '--json']) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert set(parsed) == REPORT_JSON_KEYS
+    assert parsed['n_steps'] == 4
+    assert parsed['step_time']['p50_ms'] == 10.0
+    assert parsed['memory']['peak_hbm_bytes'] == 100
+    assert parsed['kfac']['factor_updates'] == 4.0
+    assert parsed['torn_lines'] == 0
+    assert parsed['stragglers'] is None  # no shards next to this run
+
+
+def test_report_json_sanitizes_nonfinite(tmp_path, capsys):
+    path = tmp_path / 'nan.jsonl'
+    s = obs_sink.JsonlMetricsSink(str(path))
+    s.step_record(0, {'loss': float('nan')})  # no host_step_ms
+    s.close()
+    assert obs_report.main([str(path), '--json']) == 0
+    # Strict JSON: bare NaN/Infinity must not appear.
+    parsed = json.loads(capsys.readouterr().out,
+                        parse_constant=lambda c: pytest.fail(
+                            f'non-strict JSON constant {c}'))
+    assert parsed['n_steps'] == 1
+
+
+# ---------------------------------------------------------------------------
+# Regression gate (the tentpole's acceptance criteria)
+# ---------------------------------------------------------------------------
+
+def _write_clean_run(path, n=40, base_ms=10.0, spike_at=None,
+                     spike_factor=2.0, mem_growth=False):
+    s = obs_sink.JsonlMetricsSink(str(path), meta={'run': 'gate'})
+    for i in range(n):
+        ms = base_ms + 0.01 * (i % 5)
+        if spike_at is not None and i == spike_at:
+            ms = base_ms * spike_factor
+        s.step_record(i, {'loss': 1.0}, host_step_ms=ms)
+        if i % 4 == 0:
+            b = 1000 + (100 * (i // 4) if mem_growth else 0)
+            s.memory_record(i, device={'bytes_in_use': b,
+                                       'peak_bytes_in_use': 2000 + (
+                                           100 * (i // 4)
+                                           if mem_growth else 0)})
+    s.close()
+
+
+def test_gate_clean_self_baseline_passes(tmp_path, capsys):
+    run = tmp_path / 'run.jsonl'
+    base = tmp_path / 'BASELINE_OBS.json'
+    _write_clean_run(run)
+    assert obs_gate.main([str(run), '--write-baseline',
+                          str(base)]) == 0
+    obj = json.load(open(base))
+    assert obj['format'] == obs_gate.BASELINE_FORMAT
+    assert obj['metrics']['retraces'] == 0
+    assert obs_gate.main([str(run), '--baseline', str(base)]) == 0
+    assert 'PASS' in capsys.readouterr().out
+
+
+def test_gate_fails_on_injected_2x_spike(tmp_path, capsys):
+    """The acceptance spike: ONE plain step at 2x the baseline step
+    time. No percentile moves, but the online z-score anomaly check
+    must still fail the gate."""
+    clean = tmp_path / 'clean.jsonl'
+    spiked = tmp_path / 'spiked.jsonl'
+    base = tmp_path / 'base.json'
+    _write_clean_run(clean)
+    assert obs_gate.main([str(clean), '--write-baseline',
+                          str(base)]) == 0
+    _write_clean_run(spiked, spike_at=30)
+    rc = obs_gate.main([str(spiked), '--baseline', str(base)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert 'ANOMALY' in out and 'step-time spike' in out
+    # --no-anomaly suppresses the z-score replay, but the spike still
+    # breaches through the spike-sensitive baseline metrics
+    # (max_over_median / p99) — two independent tripwires for the same
+    # injected fault.
+    rc = obs_gate.main([str(spiked), '--baseline', str(base),
+                        '--no-anomaly'])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert 'ANOMALY' not in out
+    assert 'BREACH max_over_median' in out
+
+
+def test_gate_fails_on_sustained_regression(tmp_path, capsys):
+    clean = tmp_path / 'clean.jsonl'
+    slow = tmp_path / 'slow.jsonl'
+    base = tmp_path / 'base.json'
+    _write_clean_run(clean)
+    obs_gate.main([str(clean), '--write-baseline', str(base)])
+    capsys.readouterr()
+    _write_clean_run(slow, base_ms=20.0)  # every step 2x
+    rc = obs_gate.main([str(slow), '--baseline', str(base)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert 'BREACH step_p50_ms' in out
+
+
+def test_gate_fails_on_memory_growth(tmp_path, capsys):
+    clean = tmp_path / 'clean.jsonl'
+    leaky = tmp_path / 'leaky.jsonl'
+    base = tmp_path / 'base.json'
+    _write_clean_run(clean)
+    obs_gate.main([str(clean), '--write-baseline', str(base)])
+    capsys.readouterr()
+    _write_clean_run(leaky, mem_growth=True)
+    rc = obs_gate.main([str(leaky), '--baseline', str(base)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert 'memory grew' in out      # anomaly detector
+    assert 'peak_hbm_bytes' in out   # and the baseline breach
+    # Anomaly-only mode (no baseline) catches the growth too.
+    assert obs_gate.main([str(leaky)]) == 1
+
+
+def test_gate_retrace_breach_and_tolerances(tmp_path, capsys):
+    run = tmp_path / 'run.jsonl'
+    base = tmp_path / 'base.json'
+    _write_clean_run(run)
+    obs_gate.main([str(run), '--write-baseline', str(base)])
+    capsys.readouterr()
+    # Same run, plus one retrace event: absolute-zero tolerance trips.
+    s = obs_sink.JsonlMetricsSink(str(tmp_path / 'rt.jsonl'))
+    for r in obs_sink.read_jsonl(str(run)):
+        if r['kind'] == 'step':
+            s.step_record(r['step'], r['metrics'],
+                          host_step_ms=r.get('host_step_ms'))
+    s.event_record('retrace', variant='factor=True,inv=True,chunk=None',
+                   trace_count=2)
+    s.close()
+    rc = obs_gate.main([str(tmp_path / 'rt.jsonl'), '--baseline',
+                        str(base)])
+    out = capsys.readouterr().out
+    assert rc == 1 and 'BREACH retraces' in out
+    # A loosened step tolerance passes where the default would breach.
+    slow = tmp_path / 'slow.jsonl'
+    _write_clean_run(slow, base_ms=11.0)  # +10% — right at the p50 edge
+    assert obs_gate.main([str(slow), '--baseline', str(base),
+                          '--tol', 'step_p50_ms=0.5',
+                          '--tol', 'step_p95_ms=0.5',
+                          '--tol', 'step_p99_ms=0.5']) == 0
+    capsys.readouterr()
+    # Unknown metric name is a usage error, not a silent no-op.
+    assert obs_gate.main([str(slow), '--baseline', str(base),
+                          '--tol', 'bogus=1.0']) == 2
+
+
+def test_gate_missing_metric_policy(tmp_path, capsys):
+    """A TPU baseline with peak HBM vs a CPU run without memory stats:
+    breach by default (the regression could hide there), skipped under
+    --allow-missing (the documented platform escape)."""
+    nomem = tmp_path / 'nomem.jsonl'
+    s = obs_sink.JsonlMetricsSink(str(nomem))
+    for i in range(40):
+        s.step_record(i, {'loss': 1.0}, host_step_ms=10.0)
+    s.close()
+    base = tmp_path / 'base.json'
+    obs_gate.write_baseline({'step_p50_ms': 10.0, 'step_p95_ms': 10.0,
+                             'step_p99_ms': 10.0,
+                             'max_over_median': 1.0,
+                             'peak_hbm_bytes': 2000, 'retraces': 0},
+                            str(base))
+    rc = obs_gate.main([str(nomem), '--baseline', str(base)])
+    out = capsys.readouterr().out
+    assert rc == 1 and 'BREACH peak_hbm_bytes' in out
+    assert obs_gate.main([str(nomem), '--baseline', str(base),
+                          '--allow-missing']) == 0
+
+
+def test_gate_json_verdict(tmp_path, capsys):
+    run = tmp_path / 'run.jsonl'
+    base = tmp_path / 'base.json'
+    _write_clean_run(run)
+    obs_gate.main([str(run), '--write-baseline', str(base)])
+    capsys.readouterr()
+    assert obs_gate.main([str(run), '--baseline', str(base),
+                          '--json']) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict['pass'] is True
+    assert verdict['breaches'] == [] and verdict['anomalies'] == []
+    assert verdict['current']['n_steps'] == 40
