@@ -1,0 +1,28 @@
+"""Elastic mesh training: topology as a resume-time parameter.
+
+Production fleets gain and lose slices constantly; this subsystem lets
+a checkpointed run resume on a DIFFERENT pod topology instead of cold
+restarting (ROADMAP open item 4):
+
+  - :mod:`elastic.topology` — every checkpoint bundle records the
+    saving world (``topo_*`` scalars);
+  - :mod:`elastic.reshard` — gather the saved KAISA slot stacks to a
+    canonical per-factor layout and repack them for the new mesh (a
+    lossless permutation, so N→M→N resumes are bit-identical);
+  - the resume integration lives in ``resilience.cli.resume``
+    (pass ``elastic=ElasticResume(mesh=..., dkfac=..., params=...)``),
+    and the ``resize@K->N`` fault kind in ``resilience.faults`` +
+    ``resilience.chaos`` makes the whole grow/shrink loop testable on
+    CPU.
+
+See README "Elastic training" for the walkthrough and the N→M→N
+contract.
+"""
+
+from distributed_kfac_pytorch_tpu.elastic.reshard import (  # noqa: F401
+    ElasticResume,
+    reshard_state_dict,
+)
+from distributed_kfac_pytorch_tpu.elastic.topology import (  # noqa: F401
+    TopologySpec,
+)

@@ -1,0 +1,142 @@
+"""Checkpoint topology metadata: the saving world, recorded in scalars.
+
+Elastic resume (ROADMAP open item 4) turns the pod topology into a
+resume-time parameter: a run saved on an N-device mesh can restore onto
+an M-device mesh, with the K-FAC state re-sharded on the way in
+(:mod:`elastic.reshard`). The enabler is that every checkpoint bundle
+records the topology that SAVED it — this module is that record.
+
+What the K-FAC state layout actually depends on (and therefore what a
+resharder must know) is the KAISA work-placement grid, not the raw
+device list: ``assign_work`` is a deterministic function of
+``(layer specs, n_rows, n_cols, distribute_layer_factors)``
+(parallel/distributed.py), so those three integers-and-a-bool pin the
+exact slot position of every factor in every row-sharded bucket stack.
+Process/device counts ride along for diagnostics and the
+``topology_change`` event. State-group shardings are structural and
+constant across topologies (``inv_stacks`` row-sharded over
+``kfac_ig``, everything else replicated — ``state_pspecs``), so they
+are documented rather than recorded.
+
+The scalars are plain ints (``topo_*`` keys) inside the bundle's
+existing ``scalars`` subtree, so orbax round-trips them untouched and
+the bundle format bump is additive (MIGRATION.md "Checkpoint format"):
+bundles written before this extension simply lack the keys and are
+treated as *same-topology-only* on restore.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# Bumped if the meaning of the recorded fields ever changes; readers
+# treat unknown future formats as same-topology-only rather than
+# resharding on semantics they do not understand.
+TOPOLOGY_FORMAT = 1
+
+#: scalar keys this module owns inside a bundle's ``scalars`` subtree.
+SCALAR_KEYS = ('topo_format', 'topo_processes', 'topo_devices',
+               'topo_rows', 'topo_cols', 'topo_seq', 'topo_dist_factors')
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologySpec:
+    """The world a checkpoint was saved on (or a live mesh's world).
+
+    ``rows``/``cols`` are the KAISA grid — inverse-broadcast groups and
+    grad workers per group (``placement.WorkerAllocator``);
+    ``distribute_layer_factors`` is the *effective* A/G-on-different-
+    columns flag (the ``assign_work`` default resolves ``None`` to
+    ``cols > 1``, so the recorded value is always a concrete bool).
+    """
+    processes: int
+    devices: int
+    rows: int
+    cols: int
+    seq: int = 1
+    distribute_layer_factors: bool = True
+
+    def __post_init__(self):
+        if self.rows * self.cols * self.seq != self.devices:
+            raise ValueError(
+                f'inconsistent topology: rows {self.rows} x cols '
+                f'{self.cols} x seq {self.seq} != devices {self.devices}')
+
+    @property
+    def layout_key(self) -> tuple:
+        """The part of the spec the K-FAC state layout depends on.
+
+        Worlds with equal layout keys produce byte-compatible state
+        trees (same bucket slot maps, same stack shapes) even when the
+        process count or sequence-parallel factor differs — restore
+        then needs only the existing sharding re-commit, no reshard.
+        """
+        return (self.rows, self.cols, self.distribute_layer_factors)
+
+    def needs_reshard(self, other: 'TopologySpec') -> bool:
+        return self.layout_key != other.layout_key
+
+    def scalars(self) -> dict:
+        """``topo_*`` int scalars to merge into a bundle's scalars."""
+        return {'topo_format': TOPOLOGY_FORMAT,
+                'topo_processes': int(self.processes),
+                'topo_devices': int(self.devices),
+                'topo_rows': int(self.rows),
+                'topo_cols': int(self.cols),
+                'topo_seq': int(self.seq),
+                'topo_dist_factors': int(self.distribute_layer_factors)}
+
+    @classmethod
+    def from_scalars(cls, scalars: dict) -> 'TopologySpec | None':
+        """Rebuild from a restored bundle's ``scalars`` (None when the
+        bundle predates topology metadata, or records a future format
+        — both mean same-topology-only)."""
+        if not scalars or 'topo_format' not in scalars:
+            return None
+        if int(scalars['topo_format']) != TOPOLOGY_FORMAT:
+            return None
+        return cls(processes=int(scalars['topo_processes']),
+                   devices=int(scalars['topo_devices']),
+                   rows=int(scalars['topo_rows']),
+                   cols=int(scalars['topo_cols']),
+                   seq=int(scalars.get('topo_seq', 1)),
+                   distribute_layer_factors=bool(
+                       int(scalars['topo_dist_factors'])))
+
+    @classmethod
+    def of_mesh(cls, mesh, *,
+                distribute_layer_factors: bool | None = None
+                ) -> 'TopologySpec':
+        """The live world of a ``make_kfac_mesh`` mesh.
+
+        ``distribute_layer_factors`` takes the ``DistributedKFAC``
+        value (``DistributedKFAC.distribute_layer_factors`` after
+        construction); ``None`` resolves to the ``assign_work`` default
+        (``cols > 1``) — pass the dkfac's attribute whenever one
+        exists so the record matches the placement actually used.
+        """
+        import jax
+
+        from distributed_kfac_pytorch_tpu.parallel.distributed import (
+            GRAD_WORKER_AXIS,
+            INV_GROUP_AXIS,
+        )
+        from distributed_kfac_pytorch_tpu.parallel.sequence import (
+            SEQ_AXIS,
+        )
+        rows = mesh.shape[INV_GROUP_AXIS]
+        cols = mesh.shape[GRAD_WORKER_AXIS]
+        seq = (mesh.shape[SEQ_AXIS]
+               if SEQ_AXIS in mesh.axis_names else 1)
+        if distribute_layer_factors is None:
+            distribute_layer_factors = cols > 1
+        return cls(processes=jax.process_count(),
+                   devices=int(mesh.devices.size),
+                   rows=int(rows), cols=int(cols), seq=int(seq),
+                   distribute_layer_factors=bool(
+                       distribute_layer_factors))
+
+    def describe(self) -> str:
+        return (f'{self.devices} device(s) / {self.processes} '
+                f'process(es), KAISA grid {self.rows}x{self.cols}'
+                + (f' x seq {self.seq}' if self.seq > 1 else ''))

@@ -71,10 +71,36 @@ def initialize_multihost(coordinator_address: str | None = None,
             # on its own shard.
             if 'should only be called once' not in str(e).lower():
                 raise
+    # Cross-check the env-scan against the live runtime: the scan
+    # silently returns 1 when no known variable matches, and a launch
+    # chain that half-exports its env (e.g. SLURM_NTASKS set on some
+    # hosts only, or a typo'd JAX_NUM_PROCESSES) would otherwise split
+    # the world without a trace. Explicit arguments opt out — they
+    # override the env by design, so a disagreement there is intended.
+    if not explicit:
+        _check_world_size(_detected_world_size(), jax.process_count())
     return {'process_index': jax.process_index(),
             'process_count': jax.process_count(),
             'local_devices': jax.local_device_count(),
             'global_devices': jax.device_count()}
+
+
+def _check_world_size(detected: int, actual: int) -> None:
+    """Warn when the env-declared world size disagrees with the
+    initialized runtime's ``jax.process_count()`` (split out for
+    testability — the runtime value is authoritative, so this is a
+    diagnostic, not a failure)."""
+    if detected == actual:
+        return
+    import warnings
+
+    warnings.warn(
+        f'launch environment declares {detected} process(es) '
+        f'(_detected_world_size: SLURM/OMPI/JAX_NUM_PROCESSES/'
+        f'TPU_WORKER_HOSTNAMES scan) but the initialized JAX runtime '
+        f'reports {actual} — the runtime value wins, but check the '
+        'launch chain: a half-exported env var here usually means '
+        'some hosts are about to train alone on their own shard.')
 
 
 def host_metadata() -> dict:

@@ -361,7 +361,12 @@ def print_report(s: dict, out=None, torn: int = 0,
             w(f'checkpoint save latency: mean {_fmt(mean, " ms")}  '
               f'max {_fmt(worst, " ms")}')
         for r in s['events']:
-            if r['event'] in ('preemption', 'restore'):
+            # Lifecycle moments worth a per-event line: preemptions,
+            # restores, and topology changes (elastic resizes) — the
+            # r11 grow/shrink events show up here alongside the
+            # preemption that drained the old world.
+            if r['event'] in ('preemption', 'restore',
+                              'topology_change'):
                 detail = ', '.join(f'{k}={v}' for k, v in
                                    sorted(r.get('data', {}).items()))
                 w(f'  ! {r["event"]}: {detail}')

@@ -139,7 +139,7 @@ def make_kfac_mesh(devices: Sequence[jax.Device] | None = None, *,
     alloc = WorkerAllocator(dp, gw / dp)
     assert alloc.grad_workers == gw
     # (n_inv_groups, grad_workers) grid of K-FAC ranks per the spec.
-    grid = np.asarray(alloc.bcast_inv_ranks)
+    grid = alloc.grid
     if seq_parallel > 1:
         # Rank r owns the contiguous run of seq_parallel devices.
         devs = devices.reshape(dp, seq_parallel)[grid]
@@ -337,6 +337,13 @@ class DistributedKFAC:
         self.shard_precond_compute = shard_precond_compute
         self.n_rows = mesh.shape[INV_GROUP_AXIS]
         self.n_cols = mesh.shape[GRAD_WORKER_AXIS]
+        # The EFFECTIVE A/G-across-columns flag (assign_work resolves
+        # None to n_cols > 1). Recorded in every checkpoint's topology
+        # scalars (elastic.topology) so the elastic resume path can
+        # reconstruct this exact placement on a different mesh.
+        self.distribute_layer_factors = (
+            self.n_cols > 1 if distribute_layer_factors is None
+            else bool(distribute_layer_factors))
         # Gradient/factor averaging spans every data-bearing axis: the two
         # K-FAC axes plus the sequence axis when context parallelism is on
         # (each device then holds a (batch shard, sequence block) tile).
@@ -346,7 +353,7 @@ class DistributedKFAC:
                                       for a in self.data_axes]))
         self.assignment = assign_work(
             kfac, params, self.n_rows, self.n_cols,
-            distribute_layer_factors=distribute_layer_factors)
+            distribute_layer_factors=self.distribute_layer_factors)
         self._factor_dims = {
             name: L.factor_shapes(spec, _get(params, spec.path))
             for name, spec in kfac.specs.items()}
@@ -1254,10 +1261,19 @@ class DistributedKFAC:
                      sd.get('inv_chunk_phase', 0), jnp.int32)}
         # Layout compatibility: a checkpoint written under a different
         # inverse dispatch (e.g. 'eigen' stacks loaded into an 'auto'
-        # config whose large buckets are 'inv'-typed) is rebuilt from
-        # factors rather than spliced in structurally mismatched.
+        # config whose large buckets are 'inv'-typed) — or under a
+        # DIFFERENT mesh topology, whose slot stacks have other shapes
+        # (the elastic resume path reshards them BEFORE calling here;
+        # anything that reaches this check mismatched is rebuilt) — is
+        # recomputed from the replicated factors rather than spliced in
+        # structurally mismatched. Shapes matter as much as key sets: a
+        # 4-device stack spliced into an 8-device program would feed
+        # out-of-range (silently clamped) dynamic-slice offsets.
         compatible = 'inv_stacks' in sd and all(
             set(sd['inv_stacks'].get(k, ())) == set(state['inv_stacks'][k])
+            and all(tuple(np.shape(sd['inv_stacks'][k][n]))
+                    == tuple(state['inv_stacks'][k][n].shape)
+                    for n in state['inv_stacks'][k])
             for k in state['inv_stacks'])
         if compatible and not self._degenerate_stacks(sd['inv_stacks']):
             state = {**state, 'inv_stacks': sd['inv_stacks'],

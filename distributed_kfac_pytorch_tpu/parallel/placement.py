@@ -151,6 +151,26 @@ class WorkerAllocator:
     def inv_groups(self) -> int:
         return len(self.bcast_inv_ranks)
 
+    @property
+    def grid(self):
+        """The ``(inv_groups, grad_workers)`` rank grid as an ndarray —
+        the device-grid template ``make_kfac_mesh`` indexes devices
+        with, and the KAISA shape (`rows x cols`) the elastic topology
+        record pins (``elastic.topology.TopologySpec``)."""
+        import numpy as np
+        return np.asarray(self.bcast_inv_ranks)
+
+    @classmethod
+    def from_grid(cls, rows: int, cols: int) -> 'WorkerAllocator':
+        """Allocator for an explicit ``rows x cols`` KAISA grid
+        (grad-worker fraction re-derived as ``cols / (rows * cols)``).
+        The elastic resume path validates a checkpoint's recorded grid
+        through this before rebuilding the saved world's work
+        placement (``elastic.reshard.saved_assignment``)."""
+        if rows < 1 or cols < 1:
+            raise ValueError(f'grid must be positive, got {rows}x{cols}')
+        return cls(rows * cols, cols / (rows * cols))
+
     def get_grad_ranks(self, rank: int) -> list[int]:
         """Gradient-broadcast group containing ``rank``."""
         return self.bcast_grad_ranks[rank % self.grad_workers]

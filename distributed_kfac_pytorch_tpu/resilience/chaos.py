@@ -13,6 +13,15 @@ kill-and-resume smoke (scripts/resilience_smoke.sh) and doubles as the
 documented relaunch-loop shape for real supervisors
 (scripts/tpu_pod_setup.md §5).
 
+A ``resize@K->N`` fault makes the relaunch a TOPOLOGY change: the
+relaunched command runs with an N-device world
+(``--xla_force_host_platform_device_count=N`` injected into
+``XLA_FLAGS`` — the CPU-backend world-size knob, which is how the
+grow/shrink loop is testable with no pod; on real TPU fleets the
+re-provisioning supervisor owns the device count and this harness only
+models its relaunch step). The resumed run then reshards its K-FAC
+state through the elastic path instead of cold restarting.
+
 Exit status: the final child's exit code (so CI can gate on it).
 """
 
@@ -37,7 +46,8 @@ def main(argv=None) -> int:
                     f'it exits {RELAUNCH_EXIT_CODE} (preempted).')
     p.add_argument('spec',
                    help="fault spec 'kind@step[,kind@step...]'; kinds: "
-                        'preempt, crash, nan-batch, crash-in-save '
+                        'preempt, crash, nan-batch, crash-in-save, '
+                        "resize@K->N (relaunch with an N-device world) "
                         "(use '-' for no faults: pure relaunch loop)")
     p.add_argument('--relaunch', type=int, default=0, metavar='N',
                    help='relaunch the command up to N times while it '
@@ -72,12 +82,27 @@ def main(argv=None) -> int:
         launches += 1
         if rc != RELAUNCH_EXIT_CODE or launches > args.relaunch:
             break
+        note = ''
+        if plan is not None and plan.resize_to is not None:
+            env['XLA_FLAGS'] = _with_device_count(
+                env.get('XLA_FLAGS', ''), plan.resize_to)
+            note = f' with {plan.resize_to} devices'
         print(f'chaos: launch {launches} exited {rc} (preempted) — '
-              f'relaunching ({launches}/{args.relaunch})',
+              f'relaunching{note} ({launches}/{args.relaunch})',
               file=sys.stderr)
         if not args.keep_faults:
             env.pop(faults.ENV_VAR, None)
     return rc
+
+
+def _with_device_count(xla_flags: str, n: int) -> str:
+    """``XLA_FLAGS`` with the host-platform device count forced to
+    ``n`` (any prior count flag replaced) — the relaunched child's new
+    world size on the CPU backend."""
+    kept = [f for f in xla_flags.split()
+            if not f.startswith('--xla_force_host_platform_device_count')]
+    kept.append(f'--xla_force_host_platform_device_count={n}')
+    return ' '.join(kept)
 
 
 if __name__ == '__main__':

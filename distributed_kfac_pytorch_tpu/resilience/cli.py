@@ -12,7 +12,8 @@ this module is its single implementation:
     ckpt = make_step_checkpointer(args, step_mgr, bundle_fn,
                                   preemption=handler, sink=sink,
                                   start_step=0)
-    resumed = resume(args, epoch_mgr, step_mgr, like, sink=sink)
+    resumed = resume(args, epoch_mgr, step_mgr, like, sink=sink,
+                     elastic=ElasticResume(mesh, dkfac, params))
 
 ``resume`` unifies the two checkpoint trees: epoch-indexed checkpoints
 (the pre-r8 format, still written at ``--checkpoint-freq``) and
@@ -106,7 +107,7 @@ def make_step_checkpointer(args, step_mgr, bundle_fn, *,
 
 
 def resume(args, epoch_mgr, step_mgr, like, *, sink=None,
-           verbose: bool = False):
+           verbose: bool = False, elastic=None):
     """Restore the newest checkpoint (step or epoch tree), if any.
 
     Returns ``(restored_tree, start_epoch, start_offset, source)`` or
@@ -115,6 +116,21 @@ def resume(args, epoch_mgr, step_mgr, like, *, sink=None,
     through ``like=`` so sharded SPMD state comes back with its
     committed shardings (restore without ``like`` yields host arrays —
     see ``CheckpointManager.restore``).
+
+    ``elastic``: an ``elastic.ElasticResume(mesh=, dkfac=, params=)``
+    describing the LIVE world. With it, a bundle saved on a DIFFERENT
+    topology (detected from its recorded ``topo_*`` scalars,
+    ``elastic.topology``) is restored replicated onto the live mesh
+    (``CheckpointManager.restore_replicated``) and its K-FAC slot
+    stacks are repacked for the new KAISA grid
+    (``elastic.reshard``) instead of the restore failing — the
+    grow/shrink resume path (README "Elastic training"). A
+    ``topology_change`` event is emitted into ``sink``. Bundles that
+    predate the topology record restore same-topology-only (their
+    inverse stacks are rebuilt from factors if the layout happens to
+    differ — ``DistributedKFAC.load_state_dict``'s shape check).
+    Without ``elastic``, behavior is unchanged (same-topology
+    ``like=`` restores).
     """
     if getattr(args, 'no_resume', False):
         return None
@@ -124,31 +140,36 @@ def resume(args, epoch_mgr, step_mgr, like, *, sink=None,
     # loads. That only happens on the first relaunch after an old
     # preemption was overtaken by epoch checkpoints — accepted over
     # maintaining a second scalars-only manifest.
-    candidates = []  # ((epoch, offset), tree, source, label)
+    candidates = []  # ((epoch, offset), tree, source, label, relaid, mgr)
     step_label = (args.resume_step if args.resume_step is not None
                   else step_mgr.latest_epoch())
     if args.resume_step is not None or step_label is not None:
-        tree = _restore(step_mgr, step_label, like, args,
-                        what=f'step checkpoint {step_label}')
+        tree, relaid = _restore(step_mgr, step_label, like, args,
+                                what=f'step checkpoint {step_label}',
+                                elastic=elastic)
         sc = tree['scalars']
         candidates.append(((int(sc['epoch']), int(sc['step_in_epoch'])),
-                           tree, 'step', step_label))
+                           tree, 'step', step_label, relaid, step_mgr))
     if args.resume_step is None:
         e = epoch_mgr.latest_epoch()
         if e is not None:
             # Epoch bundles record their resume point too ((e+1, 0) —
             # the epoch completed); restore only if it could win.
             if not candidates or (e + 1, 0) > candidates[0][0]:
-                tree = _restore(epoch_mgr, e, like, args,
-                                what=f'epoch checkpoint {e}')
+                tree, relaid = _restore(epoch_mgr, e, like, args,
+                                        what=f'epoch checkpoint {e}',
+                                        elastic=elastic)
                 sc = tree['scalars']
                 candidates.append(
                     ((int(sc['epoch']), int(sc['step_in_epoch'])),
-                     tree, 'epoch', e))
+                     tree, 'epoch', e, relaid, epoch_mgr))
     if not candidates:
         return None
-    (start_epoch, offset), tree, source, label = max(
+    (start_epoch, offset), tree, source, label, relaid, won_mgr = max(
         candidates, key=lambda c: c[0])
+    if elastic is not None:
+        tree = _adopt_topology(tree, elastic, relaid, won_mgr, label,
+                               like, sink=sink, verbose=verbose)
     # The bundle's data_seed is part of the data-stream position
     # (resilience.dataiter): adopt it, or a supervisor that relaunches
     # without --seed would skip `offset` batches of a DIFFERENT
@@ -174,9 +195,21 @@ def resume(args, epoch_mgr, step_mgr, like, *, sink=None,
     return tree, start_epoch, offset, source
 
 
-def _restore(mgr, label, like, args, *, what: str):
+def _restore(mgr, label, like, args, *, what: str, elastic=None):
+    """Restore one candidate bundle.
+
+    Returns ``(tree, relaid)``; ``relaid`` is True when the bundle came
+    back through the replicated (topology-independent) restore path
+    and so needs re-committing onto the live mesh shardings.
+    """
     try:
-        return mgr.restore(label, like=like)
+        if elastic is None:
+            return mgr.restore(label, like=like), False
+        return _elastic_restore(mgr, label, like, elastic)
+    except FileNotFoundError as e:
+        # Already self-explanatory (names the requested step and the
+        # steps on disk) — don't bury it under the format advice.
+        raise SystemExit(f'cannot resume from {what}: {e}')
     except Exception as e:
         traceback.print_exc()  # keep the real cause diagnosable
         raise SystemExit(
@@ -186,3 +219,68 @@ def _restore(mgr, label, like, args, *, what: str):
             'resilience checkpoint-format extension (see MIGRATION.md '
             '"Checkpoint format") — pass --no-resume or a fresh '
             '--checkpoint-dir.')
+
+
+def _elastic_restore(mgr, label, like, elastic):
+    """Same-topology fast path when the saved shapes match the live
+    template; otherwise the replicated cross-topology restore."""
+    from distributed_kfac_pytorch_tpu.elastic import (
+        reshard as reshard_lib,
+    )
+    md = None
+    try:
+        md = mgr.metadata_tree(label)
+    except Exception:
+        md = None  # metadata unreadable: same-topology restore only
+    if md is None or reshard_lib.like_matches_metadata(md, like):
+        try:
+            return mgr.restore(label, like=like), False
+        except Exception:
+            if md is None:
+                raise
+            # The positional shape match was a coincidence (structure
+            # differed) — the replicated restore below is authoritative.
+    return mgr.restore_replicated(label, mesh=elastic.mesh,
+                                  like=like), True
+
+
+def _adopt_topology(tree, elastic, relaid, mgr, label, like, *,
+                    sink=None, verbose=False):
+    """Post-restore elastic step: reshard the winner's K-FAC state for
+    the live world when its recorded topology differs, and re-commit
+    replicated-restored groups onto the live mesh."""
+    from distributed_kfac_pytorch_tpu.elastic import (
+        topology as topo_lib,
+    )
+    saved = topo_lib.TopologySpec.from_scalars(tree.get('scalars', {}))
+    live = elastic.topology
+    if saved is not None and saved.needs_reshard(live):
+        if not relaid:
+            # Same shapes, different slot layout (possible when two
+            # KAISA grids coincide in slot counts): the like= restore
+            # handed back row-sharded arrays, which cannot be gathered
+            # host-side on a pod — re-restore replicated.
+            tree = mgr.restore_replicated(label, mesh=elastic.mesh,
+                                          like=like)
+        tree = elastic.reshard_tree(tree, saved)
+    elif relaid:
+        # Same layout (or a pre-topology bundle) through the replicated
+        # path: no reshard, but the groups still need committing onto
+        # the live mesh.
+        tree = elastic.reshard_tree(tree, None)
+    if saved is not None and saved != live:
+        if sink is not None:
+            sink.event_record(
+                'topology_change',
+                global_step=int(tree['scalars']['step']),
+                resharded=bool(saved.needs_reshard(live)),
+                from_processes=saved.processes, to_processes=live.processes,
+                from_devices=saved.devices, to_devices=live.devices,
+                from_grid=f'{saved.rows}x{saved.cols}',
+                to_grid=f'{live.rows}x{live.cols}')
+        if verbose:
+            print(f'elastic resume: topology changed — saved on '
+                  f'{saved.describe()}, resuming on {live.describe()}'
+                  + ('' if saved.needs_reshard(live)
+                     else ' (layout-compatible, no reshard)'))
+    return tree

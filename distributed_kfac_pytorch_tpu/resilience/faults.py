@@ -20,10 +20,19 @@ tests set it directly). Spec grammar — comma-separated ``kind@step``::
                       checkpoint write; orbax's write-to-tmp-then-rename
                       atomicity must keep ``latest_epoch()`` from ever
                       surfacing the torn step
+    resize@K->N       topology change after step K completes: drain
+                      like a preemption (forced blocking save, exit
+                      RELAUNCH_EXIT_CODE), and the chaos harness
+                      relaunches the command with an N-device world —
+                      the simulated slice grow/shrink; the relaunch
+                      resumes through the elastic reshard path
+                      (``resilience.cli.resume(elastic=...)``) instead
+                      of cold restarting
 
 Faults are one-shot by design: a relaunch (fresh process) re-reads the
 env, so the chaos harness clears ``KFAC_CHAOS`` for relaunches unless
-told otherwise.
+told otherwise (the resize fault's new world size persists across the
+relaunch, of course — that is the point).
 """
 
 from __future__ import annotations
@@ -34,7 +43,7 @@ import os
 import numpy as np
 
 ENV_VAR = 'KFAC_CHAOS'
-_KINDS = ('preempt', 'crash', 'nan-batch', 'crash-in-save')
+_KINDS = ('preempt', 'crash', 'nan-batch', 'crash-in-save', 'resize')
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,13 +53,20 @@ class FaultPlan:
     crash_at: int | None = None
     nan_batch_at: int | None = None
     crash_in_save_at: int | None = None
+    resize_at: int | None = None
+    resize_to: int | None = None  # new world size for resize_at
 
     def any(self) -> bool:
         return any(v is not None for v in dataclasses.astuple(self))
 
 
 def parse_spec(spec: str | None) -> FaultPlan | None:
-    """Parse a ``kind@step[,kind@step...]`` spec; None/'' -> None."""
+    """Parse a ``kind@step[,kind@step...]`` spec; None/'' -> None.
+
+    The ``resize`` kind takes ``resize@<step>-><new_world_size>``
+    (e.g. ``resize@2->4``: drain after step 2, relaunch with 4
+    devices).
+    """
     if not spec:
         return None
     fields = {}
@@ -59,11 +75,32 @@ def parse_spec(spec: str | None) -> FaultPlan | None:
         if not part:
             continue
         kind, sep, at = part.partition('@')
+        if sep and kind == 'resize':
+            step_s, arrow, to_s = at.partition('->')
+            if not (arrow and step_s.lstrip('-').isdigit()
+                    and to_s.isdigit() and int(to_s) > 0):
+                raise ValueError(
+                    f'bad {ENV_VAR} fault spec {part!r}: expected '
+                    "'resize@<step>-><new_world_size>' (e.g. "
+                    "'resize@2->4')")
+            fields['resize_at'] = int(step_s)
+            fields['resize_to'] = int(to_s)
+            continue
         if not sep or kind not in _KINDS or not at.lstrip('-').isdigit():
             raise ValueError(
                 f'bad {ENV_VAR} fault spec {part!r}: expected '
                 f"'<kind>@<step>' with kind in {_KINDS}")
         fields[kind.replace('-', '_') + '_at'] = int(at)
+    if 'resize_at' in fields and 'preempt_at' in fields:
+        # Both drain via the SAME relaunch exit code, so a supervisor
+        # (resilience.chaos) could not tell which one caused a given
+        # exit — and would change the world size on the wrong drain.
+        # One drain fault per launch; chain launches for sequences.
+        raise ValueError(
+            f'bad {ENV_VAR} spec {spec!r}: preempt and resize cannot '
+            'be combined in one launch (both exit with the relaunch '
+            'code, so the supervisor cannot attribute the drain); '
+            'inject them on separate launches instead')
     return FaultPlan(**fields) if fields else None
 
 

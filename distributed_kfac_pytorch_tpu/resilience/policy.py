@@ -113,6 +113,15 @@ class StepCheckpointer:
             if self.plan.preempt_at == gstep and \
                     self.preemption is not None:
                 self.preemption.trigger('injected preemption')
+            if self.plan.resize_at == gstep and \
+                    self.preemption is not None:
+                # A topology change drains exactly like a preemption
+                # (forced blocking save, relaunch exit code); the NEW
+                # world size lives in the spec the chaos harness
+                # parsed — it relaunches with that many devices and
+                # the resumed run reshards through the elastic path.
+                self.preemption.trigger(
+                    f'injected resize -> {self.plan.resize_to} devices')
         preempted = (self.preemption is not None
                      and self.preemption.triggered())
         due = self.policy is not None and self.policy.should_save(gstep)

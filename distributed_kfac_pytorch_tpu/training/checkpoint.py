@@ -32,10 +32,18 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, max_to_keep: int | None = 2):
+        self.directory = os.path.abspath(directory)
         self._mgr = ocp.CheckpointManager(
-            os.path.abspath(directory),
+            self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True))
+                max_to_keep=max_to_keep, create=True),
+            # Explicit handler so ``item_metadata`` works on a FRESH
+            # manager (a resumed process that has not saved yet has no
+            # lazily-registered handler; without this, orbax returns
+            # None and the elastic restore path cannot inspect saved
+            # shapes before reading data). Same handler save/restore
+            # already use via args=Standard{Save,Restore}.
+            item_handlers=ocp.StandardCheckpointHandler())
 
     def save(self, epoch: int, tree: dict, *, force: bool = False,
              blocking: bool = False) -> None:
@@ -86,12 +94,30 @@ class CheckpointManager:
         leaves as a second line of defense. Regression-tested in
         tests/test_resilience.py (like= adopts the live placements;
         sharded SPMD kill-and-resume).
+
+        Restoring onto a DIFFERENT topology is supported through the
+        elastic path, not through this method's bare form: bundles
+        record their saving world in ``topo_*`` scalars
+        (``elastic.topology``), ``restore_replicated`` brings the
+        bundle up replicated on any live mesh, and
+        ``elastic.reshard`` repacks the K-FAC slot stacks for the new
+        world — ``resilience.cli.resume(elastic=...)`` wires it all
+        (README "Elastic training").
         """
         self._mgr.wait_until_finished()  # join any pending async save
         if epoch is None:
             epoch = self.latest_epoch()
         if epoch is None:
-            raise FileNotFoundError('no checkpoints found')
+            raise FileNotFoundError(
+                f'no checkpoints found under {self.directory}')
+        steps = self._mgr.all_steps()
+        if epoch not in steps:
+            # Orbax's own failure for a missing step is an opaque
+            # directory error; name the request and what IS on disk.
+            raise FileNotFoundError(
+                f'no checkpoint for step {epoch} under '
+                f'{self.directory}; steps on disk: '
+                f'{sorted(steps) if steps else "none"}')
         if like is not None:
             abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
             return self._mgr.restore(
@@ -101,6 +127,69 @@ class CheckpointManager:
         # fresh process always starts this way).
         return self._mgr.restore(epoch, args=ocp.args.StandardRestore())
 
+    def metadata_tree(self, epoch: int) -> dict:
+        """Saved tree structure + per-leaf shape/dtype, WITHOUT reading
+        array data (orbax ``item_metadata``). The elastic resume path
+        uses this to decide between a same-topology ``like=`` restore
+        and a cross-topology replicated restore, and to build the
+        latter's template."""
+        self._mgr.wait_until_finished()
+        return self._mgr.item_metadata(epoch)
+
+    def restore_replicated(self, epoch: int, mesh,
+                           like: dict | None = None) -> dict:
+        """Restore a bundle fully REPLICATED on ``mesh`` — the
+        topology-independent layout any world can load.
+
+        The template is built from the checkpoint's own metadata
+        (saved shapes/dtypes, replicated shardings on the LIVE mesh),
+        so it works regardless of what world wrote the bundle —
+        multi-host safe, unlike the bare no-``like`` restore. Scalars
+        (0-d leaves) come back as host scalars.
+
+        ``like``: the live bundle template. Its ``opt_state`` subtree,
+        when present, is used for that group's restore template
+        instead of the metadata's — orbax metadata comes back in plain
+        containers, and the optimizer state is the one bundle group
+        holding custom pytree nodes (optax states) whose structure the
+        caller needs preserved; its shapes are topology-independent,
+        so the live template's are correct.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        md = self.metadata_tree(epoch)
+        rep = NamedSharding(mesh, PartitionSpec())
+
+        def of_meta(m):
+            shape = tuple(getattr(m, 'shape', ()) or ())
+            # True scalars (python ints/floats in the bundle) restore
+            # as host scalars; ARRAY leaves — 0-d included (the K-FAC
+            # step / inv_chunk_phase counters) — must carry the live
+            # replicated sharding: without one, orbax falls back to
+            # the sharding FILE, which references the SAVING world's
+            # devices and cannot materialize on a different topology.
+            if isinstance(m, ocp.metadata.ScalarMetadata):
+                return jax.ShapeDtypeStruct((), m.dtype)
+            return jax.ShapeDtypeStruct(shape, m.dtype, sharding=rep)
+
+        def of_live(x):
+            # Mirror the save-side typing: array leaves (0-d optax
+            # step counters included) were written as arrays and need
+            # the live replicated sharding to deserialize; plain
+            # python scalars were written as scalars and restore bare.
+            if isinstance(x, (jax.Array, np.ndarray)):
+                return jax.ShapeDtypeStruct(np.shape(x), x.dtype,
+                                            sharding=rep)
+            return jax.ShapeDtypeStruct((), np.asarray(x).dtype)
+
+        template = {k: jax.tree.map(of_meta, v) for k, v in md.items()}
+        if like is not None and 'opt_state' in like \
+                and 'opt_state' in template:
+            template['opt_state'] = jax.tree.map(of_live,
+                                                 like['opt_state'])
+        return self._mgr.restore(
+            epoch, args=ocp.args.StandardRestore(template))
+
     def close(self):
         self._mgr.wait_until_finished()
         self._mgr.close()
@@ -108,7 +197,7 @@ class CheckpointManager:
 
 def bundle_state(params, opt_state, kfac_state_dict, extra_vars,
                  schedulers: dict[str, Any] | None = None,
-                 **scalars) -> dict:
+                 topology=None, **scalars) -> dict:
     """Assemble the composite checkpoint tree.
 
     Mirrors the reference's checkpoint dict {model, optimizer,
@@ -120,12 +209,21 @@ def bundle_state(params, opt_state, kfac_state_dict, extra_vars,
     ``step_in_epoch`` + ``data_seed`` (the data-stream position,
     ``resilience.dataiter.DataStreamState``) — epoch-boundary bundles
     record ``step_in_epoch=0``.
+
+    ``topology``: an ``elastic.topology.TopologySpec`` of the saving
+    world; its ``topo_*`` int scalars are merged into ``scalars`` so
+    the bundle can be resumed on a DIFFERENT topology (the r11
+    elastic format — bundles without it are same-topology-only; see
+    MIGRATION.md).
     """
+    scalars = dict(scalars)
+    if topology is not None:
+        scalars.update(topology.scalars())
     tree = {'params': params,
             'opt_state': opt_state,
             'kfac': kfac_state_dict,
             'extra_vars': extra_vars,
-            'scalars': dict(scalars)}
+            'scalars': scalars}
     if schedulers:
         tree['schedulers'] = {k: v.state_dict()
                               for k, v in schedulers.items()}

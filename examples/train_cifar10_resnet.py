@@ -26,6 +26,7 @@ import optax
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
 from distributed_kfac_pytorch_tpu import capture as capture_lib
+from distributed_kfac_pytorch_tpu import elastic as elastic_lib
 from distributed_kfac_pytorch_tpu import fp16 as fp16_lib
 from distributed_kfac_pytorch_tpu import launch
 from distributed_kfac_pytorch_tpu import observability as obs
@@ -297,6 +298,12 @@ def main(argv=None):
         args.checkpoint_dir += '-sgd'
     mgr = ckpt_lib.CheckpointManager(args.checkpoint_dir)
     step_mgr = resil.cli.make_step_manager(args)
+    # The saving world, recorded in every bundle's scalars so a
+    # relaunch on a grown/shrunk pod can reshard instead of cold
+    # restarting (elastic resume — README "Elastic training").
+    topo = elastic_lib.TopologySpec.of_mesh(
+        mesh, distribute_layer_factors=(
+            dkfac.distribute_layer_factors if dkfac else None))
 
     def bundle_fn(st, step_in_epoch):
         # The like/saved tree must match exactly (orbax StandardRestore
@@ -307,12 +314,16 @@ def main(argv=None):
             dkfac.state_dict(st.kfac_state) if dkfac else {},
             st.extra_vars,
             schedulers={'kfac': kfac_sched} if kfac_sched else None,
+            topology=topo,
             step=st.step, epoch=st.epoch, step_in_epoch=step_in_epoch,
             data_seed=args.seed)
 
     start_epoch, start_offset = 0, 0
     resumed = resil.cli.resume(args, mgr, step_mgr, bundle_fn(state, 0),
-                               sink=metrics_sink, verbose=is_main)
+                               sink=metrics_sink, verbose=is_main,
+                               elastic=elastic_lib.ElasticResume(
+                                   mesh=mesh, dkfac=dkfac,
+                                   params=state.params))
     if resumed is not None:
         restored, start_epoch, start_offset, _src = resumed
         state.params = restored['params']

@@ -70,4 +70,50 @@ EOF
 
 python -m distributed_kfac_pytorch_tpu.observability.report \
     "$out/run2.jsonl"
+
+echo "== elastic resize leg (resize@1->2: drain a 4-device run, =="
+echo "== relaunch with 2 devices, resume via the reshard path)  =="
+# The chaos harness owns the whole loop: it injects the fault, sees the
+# relaunch exit code, rewrites XLA_FLAGS to the new world size, and
+# relaunches. Both launches share one metrics path — the drained
+# incarnation survives as resize.jsonl.prev.1. Compile cache OFF for
+# this leg: multi-device CPU warm reads are the known-segfaulting
+# combination (see tests/conftest.py).
+env JAX_PLATFORMS=cpu KFAC_SYNTHETIC_CIFAR=384 KFAC_COMPILE_CACHE=0 \
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+python -m distributed_kfac_pytorch_tpu.resilience.chaos \
+    'resize@1->2' --relaunch 1 -- \
+    python examples/train_cifar10_resnet.py "${common_args[@]}" \
+    --checkpoint-dir "$out/ckpt-resize" \
+    --kfac-metrics "$out/resize.jsonl"
+
+echo "== checking the grow/shrink loop completed without a cold restart =="
+python - "$out" <<'EOF'
+import sys
+from distributed_kfac_pytorch_tpu.observability import sink
+
+out = sys.argv[1]
+live = sink.read_jsonl(f'{out}/resize.jsonl')
+steps = [r['step'] for r in live if r['kind'] == 'step']
+events = [r['event'] for r in live if r['kind'] == 'event']
+# The relaunch CONTINUED the run (global steps 1..2 after the drained
+# step 0) instead of cold-restarting at 0, and the topology change was
+# recorded alongside the restore.
+assert steps == [1, 2], steps
+assert 'topology_change' in events and 'restore' in events, events
+tc = next(r for r in live if r.get('event') == 'topology_change')
+assert tc['data']['from_devices'] == 4, tc
+assert tc['data']['to_devices'] == 2, tc
+assert tc['data']['resharded'], tc
+prev = sink.read_incarnation(f'{out}/resize.jsonl.prev.1')
+prev_events = [r.get('event') for r in prev if r['kind'] == 'event']
+assert 'preemption' in prev_events, prev_events
+print('resize leg: 4->2 grow/shrink loop resumed elastically '
+      '(topology_change + restore recorded; steps continued 1..2)')
+EOF
+# The report surfaces the resize alongside the preemption/restore
+# lifecycle (schema-validates the stream; non-zero exit fails the
+# smoke).
+python -m distributed_kfac_pytorch_tpu.observability.report \
+    "$out/resize.jsonl"
 echo "resilience smoke OK"

@@ -32,7 +32,7 @@ def _configure(n_local_devices=4):
 
 def run_training(n_steps=3, metrics_path=None, process_index=0,
                  checkpoint_dir=None, kill_at=None, resume=False,
-                 rank_shards=False):
+                 rank_shards=False, devices=None, elastic=False):
     """Build a small conv net + DistributedKFAC on the global mesh and
     train ``n_steps`` deterministic steps through ``global_batches``.
 
@@ -64,6 +64,14 @@ def run_training(n_steps=3, metrics_path=None, process_index=0,
     ``DistributedKFAC.build_barrier_probe`` — the 2-process
     write->merge path ``observability.report``'s straggler section
     rests on (asserted by test_multihost mode='stragglers').
+
+    The r11 elastic path: checkpoints are full ``bundle_state``
+    bundles carrying the saving world's ``topo_*`` scalars;
+    ``devices=`` builds the mesh over a SUBSET of the local devices
+    (a shrunk world), and ``elastic=True`` routes the resume through
+    ``resilience.cli.resume(elastic=...)`` so a checkpoint written by
+    a 2-process 8-device pod restores — resharded — onto a 1-process
+    4-device mesh (the pod-shrink contract test_multihost pins).
     """
     import jax
     import jax.numpy as jnp
@@ -98,7 +106,8 @@ def run_training(n_steps=3, metrics_path=None, process_index=0,
     x0 = jnp.zeros((2, 8, 8, 3))
     variables, _ = kfac.init(jax.random.PRNGKey(0), x0)
     params = variables['params']
-    mesh = D.make_kfac_mesh(comm_method=CommMethod.HYBRID_OPT,
+    mesh = D.make_kfac_mesh(devices,
+                            comm_method=CommMethod.HYBRID_OPT,
                             grad_worker_fraction=0.5)
     # Commit params replicated on the global mesh: the r8 resume path
     # builds its restore template from live state, and an uncommitted
@@ -138,16 +147,51 @@ def run_training(n_steps=3, metrics_path=None, process_index=0,
 
     mgr, start = None, 0
     if checkpoint_dir is not None:
+        from distributed_kfac_pytorch_tpu import elastic as elastic_lib
         from distributed_kfac_pytorch_tpu.training import (
             checkpoint as ckpt_lib,
         )
+        topo = elastic_lib.TopologySpec.of_mesh(
+            mesh,
+            distribute_layer_factors=dkfac.distribute_layer_factors)
+
+        def bundle(params, opt_state, kstate, step):
+            return ckpt_lib.bundle_state(
+                params, opt_state, dkfac.state_dict(kstate), {},
+                topology=topo, step=step, epoch=0,
+                step_in_epoch=step, data_seed=0)
+
         mgr = ckpt_lib.CheckpointManager(checkpoint_dir,
                                          max_to_keep=None)
-        if resume:
-            like = {'params': params, 'opt_state': opt_state,
-                    'kfac': dkfac.state_dict(kstate),
-                    'scalars': {'step': 0}}
-            restored = mgr.restore(like=like)
+        if resume and elastic:
+            # The r11 pod-shrink path: restore the newest bundle via
+            # the elastic resume flow (replicated restore + reshard
+            # onto THIS mesh, which may be a different world than the
+            # one that saved).
+            import argparse
+            import os as _os
+
+            from distributed_kfac_pytorch_tpu.resilience import (
+                cli as resil_cli,
+            )
+            args = argparse.Namespace(no_resume=False,
+                                      resume_step=None,
+                                      checkpoint_dir=checkpoint_dir)
+            epoch_mgr = ckpt_lib.CheckpointManager(
+                _os.path.join(checkpoint_dir, 'elastic-epochs'))
+            restored, _e0, _off, _src = resil_cli.resume(
+                args, epoch_mgr, mgr,
+                bundle(params, opt_state, kstate, 0),
+                elastic=elastic_lib.ElasticResume(
+                    mesh=mesh, dkfac=dkfac, params=params))
+            epoch_mgr.close()
+            params = restored['params']
+            opt_state = restored['opt_state']
+            kstate = dkfac.load_state_dict(restored['kfac'], params)
+            start = int(restored['scalars']['step'])
+        elif resume:
+            restored = mgr.restore(
+                like=bundle(params, opt_state, kstate, 0))
             params = restored['params']
             opt_state = restored['opt_state']
             kstate = dkfac.load_state_dict(restored['kfac'], params)
@@ -177,11 +221,11 @@ def run_training(n_steps=3, metrics_path=None, process_index=0,
         losses.append(float(jax.device_get(metrics['loss'])))
         if mgr is not None:
             # Collective blocking save: every process participates;
-            # durable before the kill fault below can fire.
-            mgr.save(i + 1, {'params': params, 'opt_state': opt_state,
-                             'kfac': dkfac.state_dict(kstate),
-                             'scalars': {'step': i + 1}}, force=True,
-                     blocking=True)
+            # durable before the kill fault below can fire. Full
+            # bundle_state bundles (topo_* scalars included) so the
+            # elastic shrink test can resume them on another world.
+            mgr.save(i + 1, bundle(params, opt_state, kstate, i + 1),
+                     force=True, blocking=True)
             if kill_at == i + 1 and process_index == 1:
                 import os
                 os._exit(1)  # the killed worker: no cleanup, no goodbye
@@ -194,6 +238,41 @@ def run_training(n_steps=3, metrics_path=None, process_index=0,
     params_host = jax.tree.map(
         lambda a: np.asarray(jax.device_get(a)), params)
     return params_host, losses
+
+
+def run_replicate_check(out_path: str, process_index: int) -> None:
+    """Exercise ``launch.replicate_on_mesh``'s MULTI-PROCESS branch
+    (``make_array_from_process_local_data`` — the branch the
+    single-process fast tier can never reach) and assert its contract:
+    every leaf comes back a committed, fully-replicated global
+    ``jax.Array`` whose every addressable shard holds the full value.
+    Writes a per-process OK marker the test asserts on."""
+    import jax
+    import numpy as np
+
+    from distributed_kfac_pytorch_tpu import launch
+    from distributed_kfac_pytorch_tpu.parallel import distributed as D
+
+    assert jax.process_count() > 1, \
+        'replicate check must run the multi-process branch'
+    mesh = D.make_kfac_mesh()
+    tree = {'w': np.arange(24.0, dtype=np.float32).reshape(4, 6),
+            'nested': {'b': np.float32(3.5)}}
+    out = launch.replicate_on_mesh(mesh, tree)
+    for leaf in jax.tree.leaves(out):
+        assert isinstance(leaf, jax.Array), type(leaf)
+        assert leaf.sharding.is_fully_replicated, leaf.sharding
+        assert len(leaf.sharding.device_set) == jax.device_count()
+    w = out['w']
+    assert w.shape == (4, 6)
+    for shard in w.addressable_shards:
+        np.testing.assert_array_equal(np.asarray(shard.data),
+                                      tree['w'])
+    np.testing.assert_array_equal(np.asarray(jax.device_get(w)),
+                                  tree['w'])
+    assert float(jax.device_get(out['nested']['b'])) == 3.5
+    with open(f'{out_path}.p{process_index}', 'w') as f:
+        f.write('ok')
 
 
 def run_comm_bench(iters: int = 10, size: int = 256) -> dict:
@@ -339,6 +418,11 @@ def main():
         run_training(metrics_path=out_path,
                      process_index=info['process_index'],
                      rank_shards=True)
+        print(f'worker {pid} done', flush=True)
+        return
+    if mode == 'replicate':
+        # r11 satellite: the multi-process replicate_on_mesh branch.
+        run_replicate_check(out_path, info['process_index'])
         print(f'worker {pid} done', flush=True)
         return
     if mode == 'resilience':

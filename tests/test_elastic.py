@@ -1,0 +1,659 @@
+"""Tests for the r11 elastic subsystem: resume on a different topology.
+
+The acceptance pins (ISSUE 6):
+
+  - **N→M→N bit-identity** — a run saved on a 4-device mesh, resumed
+    on 8 devices (grow), re-saved, and resumed back on 4 (shrink) must
+    continue bit-identically to an uninterrupted 4-device run: the
+    gather→repack reshard is a lossless permutation of the KAISA slot
+    stacks (partial buckets included — the test net's uneven layer
+    count leaves padding slots on both grids).
+  - **N→M loss-trajectory equivalence** — training ON the new topology
+    matches the old one within cross-layout fp-reduction tolerance.
+  - ``resize@K->N`` fault parsing/firing and the chaos harness's
+    relaunch-with-new-world-size (the CLI loop itself is the slow-tier
+    test + scripts/resilience_smoke.sh's resize leg).
+
+Plus the satellites: ``CheckpointManager.restore`` naming missing
+steps, ``latest_epoch`` on an empty directory, the
+``load_state_dict`` shape hardening (cross-topology stacks rebuilt
+from factors instead of spliced), and the launch world-size
+cross-check.
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_kfac_pytorch_tpu import KFAC, launch
+from distributed_kfac_pytorch_tpu import elastic as elastic_lib
+from distributed_kfac_pytorch_tpu.elastic import reshard as reshard_lib
+from distributed_kfac_pytorch_tpu.elastic import topology as topo_lib
+from distributed_kfac_pytorch_tpu.observability import sink as obs_sink
+from distributed_kfac_pytorch_tpu.parallel import distributed as D
+from distributed_kfac_pytorch_tpu.preconditioner import CommMethod
+from distributed_kfac_pytorch_tpu.resilience import (
+    cli as resil_cli,
+    faults,
+    policy as policy_lib,
+    preemption,
+)
+from distributed_kfac_pytorch_tpu.training import (
+    checkpoint as ckpt_lib,
+    engine,
+)
+
+
+# ---------------------------------------------------------------------------
+# TopologySpec
+# ---------------------------------------------------------------------------
+
+class TestTopologySpec:
+    def test_scalars_roundtrip(self):
+        t = topo_lib.TopologySpec(processes=2, devices=8, rows=2,
+                                  cols=4, seq=1,
+                                  distribute_layer_factors=False)
+        back = topo_lib.TopologySpec.from_scalars(t.scalars())
+        assert back == t
+
+    def test_missing_or_future_format_is_none(self):
+        assert topo_lib.TopologySpec.from_scalars({}) is None
+        assert topo_lib.TopologySpec.from_scalars(
+            {'step': 3, 'epoch': 0}) is None
+        t = topo_lib.TopologySpec(1, 4, 2, 2)
+        sc = t.scalars()
+        sc['topo_format'] = topo_lib.TOPOLOGY_FORMAT + 1
+        assert topo_lib.TopologySpec.from_scalars(sc) is None
+
+    def test_inconsistent_grid_rejected(self):
+        with pytest.raises(ValueError, match='inconsistent topology'):
+            topo_lib.TopologySpec(1, 8, 2, 2)
+
+    def test_layout_key_drives_needs_reshard(self):
+        a = topo_lib.TopologySpec(1, 4, 2, 2)
+        b = topo_lib.TopologySpec(2, 4, 2, 2)  # process split only
+        c = topo_lib.TopologySpec(1, 8, 2, 4)
+        assert not a.needs_reshard(b)
+        assert a != b  # still a topology change (event-worthy)
+        assert a.needs_reshard(c)
+
+    def test_of_mesh(self):
+        mesh = D.make_kfac_mesh(jax.devices()[:4],
+                                comm_method=CommMethod.HYBRID_OPT,
+                                grad_worker_fraction=0.5)
+        t = topo_lib.TopologySpec.of_mesh(mesh)
+        assert (t.rows, t.cols, t.seq, t.devices) == (2, 2, 1, 4)
+        assert t.distribute_layer_factors  # cols > 1 default
+        t2 = topo_lib.TopologySpec.of_mesh(
+            mesh, distribute_layer_factors=False)
+        assert not t2.distribute_layer_factors
+        assert t.needs_reshard(t2)  # A/G placement differs
+
+
+# ---------------------------------------------------------------------------
+# resize fault: parsing, firing, chaos relaunch
+# ---------------------------------------------------------------------------
+
+class TestResizeFault:
+    def test_parse_resize_spec(self):
+        plan = faults.parse_spec('resize@2->4')
+        assert plan.resize_at == 2 and plan.resize_to == 4
+        plan = faults.parse_spec('nan-batch@1,resize@3->2')
+        assert plan.nan_batch_at == 1
+        assert plan.resize_at == 3 and plan.resize_to == 2
+
+    @pytest.mark.parametrize('bad', ['resize@2', 'resize@->4',
+                                     'resize@2->0', 'resize@2->x',
+                                     'resize@a->4'])
+    def test_bad_resize_specs_rejected(self, bad):
+        with pytest.raises(ValueError, match='fault spec'):
+            faults.parse_spec(bad)
+
+    def test_resize_plus_preempt_rejected(self):
+        """Both drain with the relaunch exit code, so a supervisor
+        could not attribute the drain — and would resize the world on
+        the wrong one. One drain fault per launch."""
+        with pytest.raises(ValueError, match='cannot be combined'):
+            faults.parse_spec('preempt@1,resize@3->2')
+
+    def test_worker_allocator_from_grid(self):
+        from distributed_kfac_pytorch_tpu.parallel.placement import (
+            WorkerAllocator,
+        )
+        alloc = WorkerAllocator.from_grid(2, 4)
+        assert (alloc.inv_groups, alloc.grad_workers) == (2, 4)
+        assert alloc.size == 8
+        with pytest.raises(ValueError, match='positive'):
+            WorkerAllocator.from_grid(0, 4)
+
+    def test_resize_drains_like_preemption(self, tmp_path):
+        """resize@K forces a blocking save and raises Preempted with
+        the new world size in the reason — the chaos harness owns the
+        actual relaunch-with-N-devices step."""
+        mgr = ckpt_lib.CheckpointManager(str(tmp_path / 'steps'))
+        handler = preemption.PreemptionHandler(signals=())
+        ck = policy_lib.StepCheckpointer(
+            mgr, policy_lib.CheckpointPolicy(),
+            lambda st, k: {'params': st.params,
+                           'scalars': {'step': st.step}},
+            preemption=handler,
+            plan=faults.FaultPlan(resize_at=2, resize_to=2))
+        state = engine.TrainState(params={'w': jnp.arange(4.0)},
+                                  opt_state=(), kfac_state=None,
+                                  extra_vars={}, step=1)
+        ck.after_step(state, 1)  # step 1: nothing fires
+        state.step = 2
+        with pytest.raises(preemption.Preempted) as ei:
+            ck.after_step(state, 2)
+        assert 'resize -> 2 devices' in ei.value.reason
+        # The save was blocking: durable now.
+        restored = ckpt_lib.CheckpointManager(
+            str(tmp_path / 'steps')).restore(2)
+        assert int(restored['scalars']['step']) == 2
+        ck.close()
+
+    def test_chaos_relaunches_with_new_world_size(self, tmp_path):
+        """The chaos harness must rewrite XLA_FLAGS for the relaunch
+        (replacing any prior host-device-count flag), clear the fault
+        spec, and keep unrelated flags."""
+        from distributed_kfac_pytorch_tpu.resilience import chaos
+
+        marker = tmp_path / 'launched_once'
+        record = tmp_path / 'relaunch_env'
+        script = (
+            "import os, sys\n"
+            f"m = {str(marker)!r}\n"
+            "if not os.path.exists(m):\n"
+            "    open(m, 'w').write(os.environ.get('KFAC_CHAOS', ''))\n"
+            f"    sys.exit({preemption.RELAUNCH_EXIT_CODE})\n"
+            f"open({str(record)!r}, 'w').write("
+            "os.environ.get('XLA_FLAGS', ''))\n"
+            "assert 'KFAC_CHAOS' not in os.environ\n"
+            "sys.exit(0)\n")
+        old = os.environ.get('XLA_FLAGS')
+        os.environ['XLA_FLAGS'] = ('--xla_foo=1 '
+                                   '--xla_force_host_platform_device_'
+                                   'count=4')
+        try:
+            rc = chaos.main(['resize@1->2', '--relaunch', '1', '--',
+                             sys.executable, '-c', script])
+        finally:
+            if old is None:
+                del os.environ['XLA_FLAGS']
+            else:
+                os.environ['XLA_FLAGS'] = old
+        assert rc == 0
+        assert marker.read_text() == 'resize@1->2'
+        flags = record.read_text().split()
+        assert '--xla_force_host_platform_device_count=2' in flags
+        assert '--xla_force_host_platform_device_count=4' not in flags
+        assert '--xla_foo=1' in flags
+
+    def test_with_device_count_helper(self):
+        from distributed_kfac_pytorch_tpu.resilience.chaos import (
+            _with_device_count,
+        )
+        assert _with_device_count('', 4).split() == [
+            '--xla_force_host_platform_device_count=4']
+        out = _with_device_count(
+            '--a --xla_force_host_platform_device_count=8 --b', 2)
+        assert out.split() == [
+            '--a', '--b', '--xla_force_host_platform_device_count=2']
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint satellites
+# ---------------------------------------------------------------------------
+
+class TestCheckpointSatellites:
+    def test_latest_epoch_on_empty_dir(self, tmp_path):
+        mgr = ckpt_lib.CheckpointManager(str(tmp_path / 'empty'))
+        assert mgr.latest_epoch() is None
+        with pytest.raises(FileNotFoundError, match='no checkpoints'):
+            mgr.restore()
+        mgr.close()
+
+    def test_restore_missing_step_names_steps_on_disk(self, tmp_path):
+        mgr = ckpt_lib.CheckpointManager(str(tmp_path / 'ck'),
+                                         max_to_keep=None)
+        mgr.save(2, {'w': jnp.zeros(2)}, blocking=True)
+        mgr.save(5, {'w': jnp.ones(2)}, blocking=True)
+        with pytest.raises(FileNotFoundError) as ei:
+            mgr.restore(3)
+        msg = str(ei.value)
+        assert 'step 3' in msg and '[2, 5]' in msg
+        mgr.close()
+
+    def test_resume_step_missing_is_explained(self, tmp_path):
+        """--resume-step to a nonexistent step surfaces the
+        FileNotFoundError text (requested step + steps on disk), not
+        orbax's opaque error or the generic format advice."""
+        sm = ckpt_lib.CheckpointManager(str(tmp_path / 's'))
+        em = ckpt_lib.CheckpointManager(str(tmp_path / 'e'))
+        sm.save(4, ckpt_lib.bundle_state(
+            {'w': jnp.zeros(2)}, (), {}, {}, step=4, epoch=0,
+            step_in_epoch=4, data_seed=0), blocking=True)
+        args = argparse.Namespace(no_resume=False, resume_step=7,
+                                  checkpoint_dir=str(tmp_path))
+        with pytest.raises(SystemExit) as ei:
+            resil_cli.resume(args, em, sm, {})
+        assert 'step 7' in str(ei.value) and '[4]' in str(ei.value)
+        sm.close(), em.close()
+
+
+# ---------------------------------------------------------------------------
+# Launch world-size cross-check (satellite)
+# ---------------------------------------------------------------------------
+
+class TestWorldSizeCheck:
+    def test_match_is_silent(self, recwarn):
+        launch._check_world_size(1, 1)
+        launch._check_world_size(4, 4)
+        assert not [w for w in recwarn.list
+                    if 'process' in str(w.message)]
+
+    def test_mismatch_warns(self):
+        with pytest.warns(UserWarning, match='runtime value wins'):
+            launch._check_world_size(1, 4)
+        with pytest.warns(UserWarning, match='declares 4'):
+            launch._check_world_size(4, 1)
+
+
+# ---------------------------------------------------------------------------
+# The reshard contract: 4 -> 8 -> 4 on CPU meshes
+# ---------------------------------------------------------------------------
+
+class _ElasticNet(nn.Module):
+    """Five denses with repeated + odd dims: the per-(row, col) bucket
+    cells come out uneven on both the 2x2 and 2x4 grids, so the slot
+    stacks carry PADDING slots — the partial-bucket case the reshard
+    must re-pad correctly."""
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Dense(16)(x))
+        x = nn.relu(nn.Dense(16)(x))
+        x = nn.relu(nn.Dense(12)(x))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(4)(x)
+
+
+def _setup(n_devices, chunks=1):
+    """Mesh/dkfac/jitted-step for ``n_devices`` (cached: every phase of
+    every test shares ONE compile per device count)."""
+    key = (n_devices, chunks)
+    if key not in _setup.cache:
+        model = _ElasticNet()
+        kfac = KFAC(model, factor_update_freq=1, inv_update_freq=2,
+                    damping=0.003, lr=0.1,
+                    inv_pipeline_chunks=chunks,
+                    comm_method=CommMethod.HYBRID_OPT,
+                    grad_worker_fraction=0.5)
+        variables, _ = kfac.init(jax.random.PRNGKey(0),
+                                 jnp.zeros((2, 8)))
+        mesh = D.make_kfac_mesh(jax.devices()[:n_devices],
+                                comm_method=CommMethod.HYBRID_OPT,
+                                grad_worker_fraction=0.5)
+        params = launch.replicate_on_mesh(mesh, variables['params'])
+        dkfac = D.DistributedKFAC(kfac, mesh, params)
+        tx = optax.sgd(0.05, momentum=0.9)
+
+        def loss_fn(out, b):
+            return jnp.mean((out - b[1]) ** 2)
+
+        step_fn = dkfac.build_train_step(loss_fn, tx, donate=False)
+        _setup.cache[key] = dict(mesh=mesh, dkfac=dkfac, tx=tx,
+                                 step_fn=step_fn, params=params,
+                                 chunks=chunks)
+    return _setup.cache[key]
+
+
+_setup.cache = {}
+
+_HYPER = {'lr': 0.05, 'damping': 0.003,
+          'factor_update_freq': 1, 'inv_update_freq': 2}
+
+
+def _batches(n=6):
+    rng = np.random.default_rng(0)
+    return [(rng.normal(size=(32, 8)).astype(np.float32),
+             rng.normal(size=(32, 4)).astype(np.float32))
+            for _ in range(n)]
+
+
+def _fresh(s):
+    return dict(params=s['params'], opt=s['tx'].init(s['params']),
+                kstate=s['dkfac'].init_state(s['params']), extra={})
+
+
+def _run(s, state, batches, start):
+    losses = []
+    for i, b in enumerate(batches, start=start):
+        flags = engine.cadence_flags(i, 1, 2, s['chunks'])
+        (state['params'], state['opt'], state['kstate'],
+         state['extra'], m) = s['step_fn'](
+            state['params'], state['opt'], state['kstate'],
+            state['extra'], b, _HYPER, **flags)
+        losses.append(float(jax.device_get(m['loss'])))
+    return losses
+
+
+def _topo(s):
+    return topo_lib.TopologySpec.of_mesh(
+        s['mesh'],
+        distribute_layer_factors=s['dkfac'].distribute_layer_factors)
+
+
+def _bundle(s, state, step, *, topology='auto'):
+    return ckpt_lib.bundle_state(
+        state['params'], state['opt'],
+        s['dkfac'].state_dict(state['kstate']), state['extra'],
+        topology=_topo(s) if topology == 'auto' else topology,
+        step=step, epoch=0, step_in_epoch=step, data_seed=0)
+
+
+class _EventSink:
+    def __init__(self):
+        self.events = []
+
+    def event_record(self, name, **data):
+        self.events.append((name, data))
+
+
+def _elastic_resume(s, ckdir):
+    """The CLI resume flow against ``ckdir``'s step tree, with the
+    elastic context — returns (state, start_step, restored_tree,
+    events)."""
+    args = argparse.Namespace(no_resume=False, resume_step=None,
+                              checkpoint_dir=str(ckdir))
+    em = ckpt_lib.CheckpointManager(os.path.join(str(ckdir), 'epochs'))
+    sm = ckpt_lib.CheckpointManager(os.path.join(str(ckdir), 'steps'))
+    state = _fresh(s)
+    sink = _EventSink()
+    tree, _e0, _off, _src = resil_cli.resume(
+        args, em, sm, _bundle(s, state, 0), sink=sink,
+        elastic=elastic_lib.ElasticResume(
+            mesh=s['mesh'], dkfac=s['dkfac'], params=s['params']))
+    state['params'] = tree['params']
+    state['opt'] = tree['opt_state']
+    state['kstate'] = s['dkfac'].load_state_dict(tree['kfac'],
+                                                 state['params'])
+    state['extra'] = tree['extra_vars']
+    em.close(), sm.close()
+    return state, int(tree['scalars']['step']), tree, sink.events
+
+
+def _save_step(ckdir, bundle, step):
+    mgr = ckpt_lib.CheckpointManager(os.path.join(str(ckdir), 'steps'))
+    mgr.save(step, bundle, blocking=True)
+    mgr.close()
+
+
+class TestElasticContract:
+    def test_grow_shrink_bit_identity_4_8_4(self, tmp_path):
+        """The acceptance pin: save on 4 devices at step 3, resume on
+        8 (grow — reshard 2x2 -> 2x4), immediately re-save, resume
+        back on 4 (shrink) and finish the run. The combined per-step
+        loss sequence must equal an uninterrupted 4-device run's
+        BIT-FOR-BIT (the reshard is a lossless permutation), and the
+        grow leg's own training must match within cross-layout fp
+        tolerance (the N->M trajectory-equivalence contract)."""
+        s4, s8 = _setup(4), _setup(8)
+        assert (s4['dkfac'].n_rows, s4['dkfac'].n_cols) == (2, 2)
+        assert (s8['dkfac'].n_rows, s8['dkfac'].n_cols) == (2, 4)
+        # Partial buckets on both grids: at least one bucket stack has
+        # more slots than assigned factors (padding present).
+        for s in (s4, s8):
+            assigned = sum(len(p.slot) for p in
+                           s['dkfac'].assignment.buckets.values())
+            total = sum(s['dkfac'].n_rows * p.slots_per_row for p in
+                        s['dkfac'].assignment.buckets.values())
+            assert total > assigned, 'test net must leave padding slots'
+        batches = _batches(6)
+
+        full = _run(s4, _fresh(s4), batches, 0)
+
+        st = _fresh(s4)
+        head = _run(s4, st, batches[:3], 0)
+        np.testing.assert_array_equal(head, full[:3])
+        _save_step(tmp_path / 'a', _bundle(s4, st, 3), 3)
+
+        # Grow: 4 -> 8. Factors ride through the reshard untouched.
+        saved_factors = jax.device_get(
+            s4['dkfac'].state_dict(st['kstate'])['factors'])
+        st8, start, tree8, events = _elastic_resume(s8, tmp_path / 'a')
+        assert start == 3
+        assert [e[0] for e in events] == ['topology_change', 'restore']
+        ev = dict(events)['topology_change']
+        assert ev['resharded'] and ev['from_devices'] == 4 \
+            and ev['to_devices'] == 8
+        for name, fac in jax.device_get(tree8['kfac']['factors']).items():
+            for w in ('A', 'G'):
+                np.testing.assert_array_equal(fac[w],
+                                              saved_factors[name][w])
+        # Save the grown world's state BEFORE training it: the shrink
+        # leg below closes the N->M->N loop on this exact state.
+        _save_step(tmp_path / 'b', _bundle(s8, st8, 3), 3)
+
+        # N->M trajectory equivalence: training ON the new mesh tracks
+        # the old one within fp reduction-order tolerance.
+        grown = _run(s8, st8, batches[3:], 3)
+        np.testing.assert_allclose(grown, full[3:], rtol=2e-4,
+                                   atol=1e-6)
+
+        # Shrink: 8 -> 4, then finish. Bit-identical to uninterrupted.
+        st4, start, _tree, events = _elastic_resume(s4, tmp_path / 'b')
+        assert start == 3
+        assert dict(events)['topology_change']['from_devices'] == 8
+        tail = _run(s4, st4, batches[3:], 3)
+        np.testing.assert_array_equal(np.asarray(head + tail),
+                                      np.asarray(full))
+
+    def test_same_topology_elastic_resume_stays_sharded(self, tmp_path):
+        """With the elastic context but an UNCHANGED topology, resume
+        must take the like= fast path: restored inverse stacks arrive
+        already row-sharded (not replicated), no topology event is
+        emitted, and the continuation is bit-identical (the r8
+        contract, now under the elastic wrapper)."""
+        s4 = _setup(4)
+        batches = _batches(4)
+        full = _run(s4, _fresh(s4), batches, 0)
+        st = _fresh(s4)
+        head = _run(s4, st, batches[:2], 0)
+        _save_step(tmp_path, _bundle(s4, st, 2), 2)
+        st2, start, tree, events = _elastic_resume(s4, tmp_path)
+        assert start == 2
+        assert [e[0] for e in events] == ['restore']
+        live = s4['dkfac'].init_state(s4['params'])
+        for k, entry in tree['kfac']['inv_stacks'].items():
+            for name, leaf in entry.items():
+                assert leaf.sharding == \
+                    live['inv_stacks'][k][name].sharding, (k, name)
+        tail = _run(s4, st2, batches[2:], 2)
+        np.testing.assert_array_equal(np.asarray(head + tail),
+                                      np.asarray(full))
+
+    def test_pre_topology_bundle_cross_topology_rebuilds(self,
+                                                         tmp_path):
+        """A bundle WITHOUT topo_* scalars (pre-r11 format) restored
+        onto a different mesh cannot be resharded — but it must not
+        corrupt either: the replicated restore brings it up, and
+        load_state_dict's shape check rebuilds the inverse stacks from
+        the (topology-independent) factors. Factors survive exactly;
+        the run continues."""
+        s4, s8 = _setup(4), _setup(8)
+        st = _fresh(s4)
+        _run(s4, st, _batches(3), 0)
+        sd = s4['dkfac'].state_dict(st['kstate'])
+        saved_factors = jax.device_get(sd['factors'])
+        _save_step(tmp_path, ckpt_lib.bundle_state(
+            st['params'], st['opt'], sd, st['extra'],
+            step=3, epoch=0, step_in_epoch=3, data_seed=0), 3)
+        st8, start, tree, events = _elastic_resume(s8, tmp_path)
+        assert start == 3
+        assert [e[0] for e in events] == ['restore']  # no topo record
+        for name, fac in jax.device_get(
+                s8['dkfac'].state_dict(st8['kstate'])['factors']).items():
+            for w in ('A', 'G'):
+                np.testing.assert_array_equal(fac[w],
+                                              saved_factors[name][w])
+        # rebuilt stacks have the LIVE world's shapes
+        live = s8['dkfac'].init_state(s8['params'])
+        for k, entry in st8['kstate']['inv_stacks'].items():
+            for name, leaf in entry.items():
+                assert leaf.shape == live['inv_stacks'][k][name].shape
+        losses = _run(s8, st8, _batches(4)[3:], 3)
+        assert all(np.isfinite(losses))
+
+    def test_load_state_dict_shape_hardening(self):
+        """Feeding a 4-device state_dict straight into an 8-device
+        DistributedKFAC (bypassing the resharder) must rebuild from
+        factors, not splice mismatched stacks into the program."""
+        s4, s8 = _setup(4), _setup(8)
+        st = _fresh(s4)
+        _run(s4, st, _batches(2), 0)
+        sd = jax.device_get(s4['dkfac'].state_dict(st['kstate']))
+        state8 = s8['dkfac'].load_state_dict(sd, s8['params'])
+        live = s8['dkfac'].init_state(s8['params'])
+        for k, entry in state8['inv_stacks'].items():
+            for name, leaf in entry.items():
+                assert leaf.shape == live['inv_stacks'][k][name].shape
+
+    def test_reshard_rejects_bundle_topology_mismatch(self):
+        """Stacks whose slot count contradicts the recorded topology
+        must fail loudly, not scatter garbage."""
+        s4, s8 = _setup(4), _setup(8)
+        st = _fresh(s4)
+        sd = jax.device_get(s4['dkfac'].state_dict(st['kstate']))
+        # Claims a 4x2 grid: differs from the live 2x4 (so a reshard
+        # IS attempted) and from the stacks' true 2x2 layout (so the
+        # gather's slot-count validation must fire).
+        wrong = topo_lib.TopologySpec(1, 8, 4, 2)
+        with pytest.raises(ValueError, match='recorded topology'):
+            reshard_lib.reshard_state_dict(sd, wrong, s8['dkfac'],
+                                           s8['params'])
+
+    def test_reshard_cross_config_degrades_to_factor_rebuild(self):
+        """A bundle whose inverse REPRESENTATION no longer matches the
+        live dispatch (config change, not topology change) must drop
+        the inverse groups so load_state_dict rebuilds from factors —
+        mirror of the same-topology cross-config degrade."""
+        s4, s8 = _setup(4), _setup(8)
+        st = _fresh(s4)
+        sd = jax.device_get(s4['dkfac'].state_dict(st['kstate']))
+        doctored = {**sd, 'inv_stacks': {
+            k: {'inv': list(v.values())[0]}
+            for k, v in sd['inv_stacks'].items()}}
+        out = reshard_lib.reshard_state_dict(
+            doctored, _topo(s4), s8['dkfac'], s8['params'])
+        assert 'inv_stacks' not in out
+        assert 'diag_inv' not in out and 'grouped_inv' not in out
+        assert set(out['factors']) == set(sd['factors'])
+
+    @pytest.mark.slow
+    def test_pipelined_chunks_replan_zero_retrace(self, tmp_path):
+        """inv_pipeline_chunks > 1 across a topology change: the chunk
+        plan is recomputed for the new device count when the new
+        DistributedKFAC is built, the engine re-derives the firing
+        schedule from the step counter, and the zero-retrace guard
+        holds on the new world (each variant traces exactly once).
+        Slow tier: two extra full program-variant compile sets."""
+        s4, s8 = _setup(4, chunks=2), _setup(8, chunks=2)
+        batches = _batches(6)
+        st = _fresh(s4)
+        _run(s4, st, batches[:3], 0)
+        _save_step(tmp_path, _bundle(s4, st, 3), 3)
+        st8, start, _tree, events = _elastic_resume(s8, tmp_path)
+        assert start == 3
+        assert dict(events)['topology_change']['resharded']
+        losses = _run(s8, st8, batches[3:], 3)
+        assert all(np.isfinite(losses))
+        assert all(n == 1 for n in s8['step_fn'].trace_counts.values())
+
+
+# ---------------------------------------------------------------------------
+# CLI-level grow/shrink loop (slow tier; smoke-script mirror)
+# ---------------------------------------------------------------------------
+
+def _cli_env(repo, n_devices):
+    env = {**os.environ, 'PYTHONPATH': repo, 'JAX_PLATFORMS': 'cpu',
+           'PYTHONUNBUFFERED': '1',
+           # Compile cache OFF: the two launches run on different
+           # device counts and the multi-device CPU backend has the
+           # known warm-cache issue (see conftest).
+           'KFAC_COMPILE_CACHE': '0',
+           'KFAC_SYNTHETIC_CIFAR': '384'}
+    flags = [f for f in env.get('XLA_FLAGS', '').split()
+             if 'xla_force_host_platform_device_count' not in f]
+    flags.append(f'--xla_force_host_platform_device_count={n_devices}')
+    env['XLA_FLAGS'] = ' '.join(flags)
+    return env
+
+
+@pytest.mark.slow
+class TestCLIResize:
+    def test_cifar_cli_resize_4_to_2(self, tmp_path):
+        """The full resize loop through the REAL entry point: a
+        4-device run drains at step 1 under resize@1->2, the relaunch
+        runs with 2 devices, resumes through the elastic reshard path
+        (no cold restart: the global step continues), and the
+        topology_change event lands in the metrics stream + report.
+        scripts/resilience_smoke.sh drives the same loop via the chaos
+        harness."""
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        cmd = [sys.executable,
+               os.path.join(repo, 'examples',
+                            'train_cifar10_resnet.py'),
+               '--epochs', '1', '--model', 'resnet20',
+               '--batch-size', '128', '--val-batch-size', '96',
+               '--kfac-update-freq', '1', '--kfac-cov-update-freq', '1',
+               '--log-dir', str(tmp_path / 'logs'),
+               '--checkpoint-dir', str(tmp_path / 'ckpt'),
+               '--checkpoint-steps', '1', '--metrics-interval', '1']
+
+        env4 = {**_cli_env(repo, 4), 'KFAC_CHAOS': 'resize@1->2'}
+        run1 = subprocess.run(
+            cmd + ['--kfac-metrics', str(tmp_path / 'run1.jsonl')],
+            env=env4, capture_output=True, text=True, timeout=900)
+        assert run1.returncode == preemption.RELAUNCH_EXIT_CODE, \
+            f'{run1.stdout[-2000:]}\n{run1.stderr[-3000:]}'
+        assert 'resize -> 2 devices' in run1.stdout
+
+        env2 = _cli_env(repo, 2)
+        run2 = subprocess.run(
+            cmd + ['--kfac-metrics', str(tmp_path / 'run2.jsonl')],
+            env=env2, capture_output=True, text=True, timeout=900)
+        assert run2.returncode == 0, \
+            f'{run2.stdout[-2000:]}\n{run2.stderr[-3000:]}'
+        assert 'topology changed' in run2.stdout
+        assert 'resumed from step checkpoint' in run2.stdout
+
+        # No cold restart: steps 0 | 1..2 partition one 3-step run.
+        steps1 = [r['step'] for r in obs_sink.read_jsonl(
+            str(tmp_path / 'run1.jsonl')) if r['kind'] == 'step']
+        steps2 = [r['step'] for r in obs_sink.read_jsonl(
+            str(tmp_path / 'run2.jsonl')) if r['kind'] == 'step']
+        assert steps1 == [0] and steps2 == [1, 2]
+        ev2 = {r['event'] for r in obs_sink.read_jsonl(
+            str(tmp_path / 'run2.jsonl')) if r['kind'] == 'event'}
+        assert 'topology_change' in ev2 and 'restore' in ev2
+        # The report surfaces the resize alongside the restore.
+        from distributed_kfac_pytorch_tpu.observability import (
+            report as obs_report,
+        )
+        import contextlib
+        import io
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert obs_report.main([str(tmp_path / 'run2.jsonl')]) == 0
+        out = buf.getvalue()
+        assert 'topology_change' in out and 'to_devices=2' in out
