@@ -44,10 +44,14 @@ from distributed_kfac_pytorch_tpu import KFAC
 from distributed_kfac_pytorch_tpu.models import cifar_resnet
 
 
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
 def build(model, x, y, inv_freq, n_iters, mode, polish_iters=None,
-          precond_dtype=None):
+          precond_dtype=None, kfac_kwargs=None):
     """One scanned runner for a cumulative phase ``mode``."""
-    kw = {}
+    kw = dict(kfac_kwargs or {})
     if polish_iters is not None:
         kw['eigh_polish_iters'] = polish_iters
     if precond_dtype is not None:
@@ -134,10 +138,55 @@ def build(model, x, y, inv_freq, n_iters, mode, polish_iters=None,
     return run, (params, opt_state, kstate, extra)
 
 
+def tuned_vs_default(args, model, x, y, inv_freq):
+    """Replay a committed ``TUNED_*.json`` against the defaults.
+
+    Both legs run the cumulative 'full' phase (factor EWMA every iter,
+    amortized inverse firing) — the default at the reference cadence
+    and the tuned leg with the artifact's knobs mapped onto raw KFAC
+    kwargs (``autotune.kfac_overrides``); the composed ms/iter delta
+    is the whole win/regression the artifact claims. Knobs the scanned
+    harness cannot express (e.g. ``inv_pipeline_chunks`` — the scan
+    fires monolithically) are surfaced in the row, not silently
+    dropped.
+    """
+    from distributed_kfac_pytorch_tpu import autotune
+
+    artifact = autotune.read_tuned(args.tuned_config)
+    kw, tuned_inv_freq, ignored = autotune.kfac_overrides(
+        artifact['best'])
+    tuned_freq = tuned_inv_freq or inv_freq
+    rows = {}
+    for leg, kwargs, freq in (('default', None, inv_freq),
+                              ('tuned', kw, tuned_freq)):
+        n = (args.iters // freq) * freq or freq
+        run, carry = build(model, x, y, freq, n, 'full',
+                           kfac_kwargs=kwargs)
+        rows[leg] = round(B.time_chained(run, carry, n,
+                                         leg=f'tuned_ab_{leg}'), 2)
+    emit({'phase': 'tuned_vs_default',
+          'tuned_config': args.tuned_config,
+          'workload': artifact.get('workload'),
+          'artifact_platform': artifact.get('platform'),
+          'backend': jax.default_backend(),
+          'knobs': artifact['best'],
+          'ignored_knobs': ignored,
+          'default_inv_freq': inv_freq,
+          'tuned_inv_freq': tuned_freq,
+          'default_ms_per_iter': rows['default'],
+          'tuned_ms_per_iter': rows['tuned'],
+          'delta_ms_per_iter': round(rows['default'] - rows['tuned'],
+                                     2)})
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument('--iters', type=int, default=30)
     p.add_argument('--polish', type=int, nargs='*', default=[16, 8])
+    p.add_argument('--tuned-config', default=None, metavar='PATH',
+                   help='replay a committed TUNED_*.json against the '
+                        'defaults (tuned_vs_default row only; skips '
+                        'the phase decomposition)')
     args = p.parse_args(argv)
 
     on_tpu = jax.default_backend() == 'tpu'
@@ -151,6 +200,9 @@ def main(argv=None):
     y = jax.random.randint(jax.random.PRNGKey(2), (b,), 0, 10)
     inv_freq = 10
     n_iters = (args.iters // inv_freq) * inv_freq or inv_freq
+
+    if args.tuned_config:
+        return tuned_vs_default(args, model, x, y, inv_freq)
 
     kfac = KFAC(model, factor_update_freq=1, inv_update_freq=inv_freq)
     variables, _ = kfac.init(jax.random.PRNGKey(0), x)

@@ -1,0 +1,43 @@
+"""Closed-loop perf autotuner over the observability stack (r12).
+
+Turns the r6-r10 telemetry into automatic configuration (ROADMAP open
+item 5): probe candidate knob settings through short warm segments
+(:mod:`autotune.probe`), score them on the r10 gate metrics
+(:mod:`autotune.score`), commit the winner as a per-workload
+``TUNED_<workload>.json`` artifact (:mod:`autotune.driver`) the
+example CLIs load fail-closed via ``--tuned-config``
+(:mod:`autotune.cli`) — plus the first dynamic in-run policy, the
+straggler-aware cadence backoff (:mod:`autotune.policy`).
+
+    python -m distributed_kfac_pytorch_tpu.autotune --workload flagship_lm
+"""
+
+from distributed_kfac_pytorch_tpu.autotune import cli  # noqa: F401
+from distributed_kfac_pytorch_tpu.autotune import space  # noqa: F401
+from distributed_kfac_pytorch_tpu.autotune.driver import (  # noqa: F401
+    ARTIFACT_FORMAT,
+    apply_tuned,
+    emit_events,
+    kfac_overrides,
+    load_tuned_config,
+    read_tuned,
+    tune,
+    tuned_path,
+    write_tuned,
+)
+from distributed_kfac_pytorch_tpu.autotune.policy import (  # noqa: F401
+    BackoffConfig,
+    StragglerCadencePolicy,
+)
+from distributed_kfac_pytorch_tpu.autotune.probe import (  # noqa: F401
+    WORKLOADS,
+    ProbeResult,
+    Workload,
+    get_workload,
+    probe_candidate,
+)
+from distributed_kfac_pytorch_tpu.autotune.score import (  # noqa: F401
+    hard_violation,
+    objective_value,
+    rank_candidates,
+)

@@ -1,0 +1,6 @@
+import sys
+
+from distributed_kfac_pytorch_tpu.autotune.driver import main
+
+if __name__ == '__main__':
+    sys.exit(main())

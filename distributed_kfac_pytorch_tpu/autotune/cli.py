@@ -1,0 +1,90 @@
+"""Shared CLI wiring for the autotune surface.
+
+All three example entry points expose the same two autotune features
+through this module:
+
+    add_autotune_args(parser)        # --tuned-config / --cadence-
+                                     # backoff + its envelope knobs
+    cfg, events = maybe_apply_tuned(args, cfg)   # fail-closed overlay
+    policy = make_cadence_policy(args)           # or None (default)
+
+``maybe_apply_tuned`` runs BEFORE the optimizer/mesh are built (the
+tuned knobs feed OptimConfig) but the metrics sink does not exist yet
+— the queued events are flushed later with
+``autotune.emit_events(metrics_sink, events)`` so the fail-closed /
+apply decision is always on the record.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from distributed_kfac_pytorch_tpu.autotune import driver as _driver
+from distributed_kfac_pytorch_tpu.autotune import policy as _policy
+
+
+def add_autotune_args(p) -> None:
+    p.add_argument('--tuned-config', default=None, metavar='PATH',
+                   help='load a committed TUNED_<workload>.json '
+                        '(python -m distributed_kfac_pytorch_tpu'
+                        '.autotune) and overlay its tuned knobs on '
+                        'this run. FAIL-CLOSED: an unreadable/'
+                        'mismatched-platform/mismatched-topology '
+                        'artifact falls back to the flag defaults and '
+                        'logs one autotune_fallback event in the '
+                        'metrics stream')
+    p.add_argument('--cadence-backoff', action='store_true',
+                   help='straggler-aware factor-cadence backoff: when '
+                        'the barrier-wait probe shows sustained skew, '
+                        'stretch the factor-update cadence within a '
+                        'bounded envelope (and relax when the mesh '
+                        'recovers). Arms the per-step barrier probe '
+                        '(same host-sync cost note as '
+                        '--straggler-shards). Off by default — the '
+                        'default path is bit-identical to pre-policy '
+                        'runs')
+    p.add_argument('--backoff-skew-ms', type=float, default=5.0,
+                   help='barrier wait above this counts as skew')
+    p.add_argument('--backoff-sustain-steps', type=int, default=8,
+                   help='consecutive skewed steps before stretching')
+    p.add_argument('--backoff-recover-steps', type=int, default=32,
+                   help='consecutive calm steps before relaxing')
+    p.add_argument('--backoff-max-stretch', type=int, default=4,
+                   help='bound on the effective factor-interval '
+                        'multiplier (factor staleness stays bounded)')
+
+
+def maybe_apply_tuned(args, cfg) -> tuple:
+    """``(cfg, events)``: overlay --tuned-config fail-closed.
+
+    ``events`` must be flushed into the metrics sink once it exists
+    (``autotune.emit_events``). Requires the K-FAC step: a tuned
+    artifact cannot apply to the SGD baseline.
+    """
+    if not getattr(args, 'tuned_config', None):
+        return cfg, []
+    if cfg.kfac_inv_update_freq <= 0:
+        raise SystemExit('--tuned-config requires the K-FAC step '
+                         '(--kfac-update-freq > 0)')
+    knobs, events = _driver.load_tuned_config(
+        args.tuned_config, platform=jax.default_backend(),
+        world=_driver.live_world())
+    if knobs is None:
+        return cfg, events
+    new_cfg, err = _driver.apply_tuned(cfg, knobs)
+    if err is not None:
+        return cfg, [{'event': 'autotune_fallback',
+                      'path': str(args.tuned_config),
+                      'reason': 'invalid_merge', 'error': err}]
+    return new_cfg, events
+
+
+def make_cadence_policy(args):
+    """The in-run policy (or None when --cadence-backoff is absent)."""
+    if not getattr(args, 'cadence_backoff', False):
+        return None
+    return _policy.StragglerCadencePolicy(_policy.BackoffConfig(
+        skew_threshold_ms=args.backoff_skew_ms,
+        sustain_steps=args.backoff_sustain_steps,
+        recover_steps=args.backoff_recover_steps,
+        max_stretch=args.backoff_max_stretch))

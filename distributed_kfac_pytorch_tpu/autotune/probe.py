@@ -1,0 +1,296 @@
+"""Short warm probe segments per candidate config.
+
+A probe builds the candidate's full optimizer stack (the same
+``OptimConfig -> get_optimizer -> DistributedKFAC.build_train_step``
+path the example CLIs use), runs one unrecorded warm epoch so every
+static-cadence program variant is compiled, then one recorded epoch
+through ``engine.train_epoch`` with the r7 JSONL sink — the candidate
+is scored on exactly the telemetry the r10 gate consumes
+(``gate.gate_metrics`` over the recorded stream).
+
+Disqualification is structural, not statistical:
+
+  - a candidate the runtime refuses to construct (e.g.
+    ``inv_pipeline_chunks`` exceeding the model's inverse work items)
+    is marked ``invalid: <reason>``;
+  - a candidate that re-traces a static-cadence variant mid-probe
+    (the ``trace_counts`` guard, r9) is marked ``retraces`` — its
+    timings would blend compile into step time and mis-score it;
+  - ``fired='compile'`` step samples are excluded from the scored
+    records for the same reason (belt and braces: the warm epoch
+    should leave none).
+
+Probe workloads are deliberately tiny CPU-shaped stand-ins for the
+real workloads (``flagship_lm`` probes a scaled-down decoder LM, not
+the xl config): the RELATIVE ordering of candidates is what the probe
+measures, and the committed artifact records the probe platform so the
+fail-closed loader refuses to apply a CPU-tuned artifact on TPU (see
+PERF.md r12 for when an artifact may be committed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class Workload:
+    """A probe-sized workload: model + synthetic batch factory."""
+    name: str
+    make_model: Callable[[], Any]
+    make_batch: Callable[[int], tuple]      # batch index -> batch tuple
+    loss_fn: Callable                        # (model_out, batch) -> loss
+    batch_size: int
+    mutable_cols: tuple = ()
+    model_kwargs_fn: Callable | None = None  # batch -> model kwargs
+    init_kwargs: dict = dataclasses.field(default_factory=dict)
+
+
+def _lm_loss(out, batch):
+    logits = out[0] if isinstance(out, tuple) else out
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch[1]).mean()
+
+
+def _make_flagship_lm() -> Workload:
+    from distributed_kfac_pytorch_tpu.models import transformer_lm
+    vocab, seq, batch = 64, 16, 8
+
+    def make_model():
+        return transformer_lm.get_model(
+            vocab_size=vocab, size='tiny', d_model=32, num_heads=2,
+            num_layers=2, max_len=seq, dropout=0.0)
+
+    def make_batch(i):
+        rng = np.random.default_rng(1000 + i)
+        ids = rng.integers(0, vocab, size=(batch, seq)).astype(np.int32)
+        tgt = rng.integers(0, vocab, size=(batch, seq)).astype(np.int32)
+        return jnp.asarray(ids), jnp.asarray(tgt)
+
+    return Workload(name='flagship_lm', make_model=make_model,
+                    make_batch=make_batch, loss_fn=_lm_loss,
+                    batch_size=batch,
+                    model_kwargs_fn=lambda b: {'train': False},
+                    init_kwargs={'train': False})
+
+
+def _make_cifar_resnet20() -> Workload:
+    from distributed_kfac_pytorch_tpu.models import cifar_resnet
+    batch = 16
+
+    def make_batch(i):
+        rng = np.random.default_rng(2000 + i)
+        x = rng.standard_normal((batch, 32, 32, 3)).astype(np.float32)
+        y = rng.integers(0, 10, size=(batch,)).astype(np.int32)
+        return jnp.asarray(x), jnp.asarray(y)
+
+    def loss(out, batch):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            out, batch[1]).mean()
+
+    return Workload(name='cifar_resnet20',
+                    make_model=lambda: cifar_resnet.get_model(
+                        'resnet20'),
+                    make_batch=make_batch, loss_fn=loss,
+                    batch_size=batch, mutable_cols=('batch_stats',))
+
+
+def _make_tiny_mlp() -> Workload:
+    """Fast-tier stand-in: two Dense layers, compiles in seconds."""
+    import flax.linen as nn
+    batch = 16
+
+    class TinyMLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.tanh(nn.Dense(16, name='d0')(x))
+            return nn.Dense(8, name='head')(x)
+
+    def make_batch(i):
+        rng = np.random.default_rng(3000 + i)
+        x = rng.standard_normal((batch, 8)).astype(np.float32)
+        y = rng.integers(0, 8, size=(batch,)).astype(np.int32)
+        return jnp.asarray(x), jnp.asarray(y)
+
+    def loss(out, batch):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            out, batch[1]).mean()
+
+    return Workload(name='tiny_mlp', make_model=TinyMLP,
+                    make_batch=make_batch, loss_fn=loss,
+                    batch_size=batch)
+
+
+WORKLOADS: dict[str, Callable[[], Workload]] = {
+    'flagship_lm': _make_flagship_lm,
+    'cifar_resnet20': _make_cifar_resnet20,
+    'tiny_mlp': _make_tiny_mlp,
+}
+
+
+def get_workload(name: str) -> Workload:
+    if name not in WORKLOADS:
+        raise ValueError(f'unknown workload {name!r} '
+                         f'(one of {sorted(WORKLOADS)})')
+    return WORKLOADS[name]()
+
+
+@dataclasses.dataclass
+class ProbeResult:
+    """One candidate's probe outcome (the scorer's input)."""
+    knobs: dict
+    metrics: dict = dataclasses.field(default_factory=dict)
+    disqualified: str | None = None
+    n_steps: int = 0
+    retraces: int = 0
+    nonfinite_skips: float = 0.0
+    stream_path: str | None = None
+
+    def to_row(self) -> dict:
+        return {'knobs': dict(self.knobs),
+                'metrics': dict(self.metrics),
+                'disqualified': self.disqualified,
+                'n_steps': self.n_steps,
+                'retraces': self.retraces,
+                'nonfinite_skips': self.nonfinite_skips}
+
+
+def probe_candidate(workload: Workload, base_cfg, knobs: dict, *,
+                    steps: int = 8, warmup_windows: int = 2,
+                    mesh=None, seed: int = 0,
+                    keep_stream: str | None = None) -> ProbeResult:
+    """Run one candidate's warm probe segment and reduce it to metrics.
+
+    ``base_cfg`` is an ``OptimConfig``; ``knobs`` overlays
+    ``TUNABLE_FIELDS`` onto it. The probe always enables the metrics
+    pytree and the non-finite guard (collect-only): a candidate that
+    trips the guard is data the scorer's hard constraints need.
+    ``keep_stream`` persists the recorded JSONL at that path (the
+    committed-artifact evidence); otherwise it lives in a temp dir.
+    """
+    import dataclasses as _dc
+
+    from distributed_kfac_pytorch_tpu import launch
+    from distributed_kfac_pytorch_tpu.observability import (
+        gate as obs_gate,
+        sink as obs_sink,
+    )
+    from distributed_kfac_pytorch_tpu.parallel import distributed as D
+    from distributed_kfac_pytorch_tpu.training import engine, optimizers
+
+    result = ProbeResult(knobs=dict(knobs))
+    unknown = set(knobs) - set(optimizers.TUNABLE_FIELDS)
+    if unknown:
+        result.disqualified = f'invalid: unknown knob(s) ' \
+                              f'{sorted(unknown)}'
+        return result
+    cfg = _dc.replace(base_cfg, kfac_metrics=True, nonfinite_guard=True,
+                      **knobs)
+
+    try:
+        model = workload.make_model()
+        tx, _, kfac, _ = optimizers.get_optimizer(model, cfg)
+        if kfac is None:
+            raise ValueError('candidate disables K-FAC '
+                             '(kfac_inv_update_freq == 0)')
+        batch0 = workload.make_batch(0)
+        variables, _ = kfac.init(jax.random.PRNGKey(seed), batch0[0],
+                                 **workload.init_kwargs)
+        params = variables['params']
+        extra = {k: v for k, v in variables.items() if k != 'params'}
+        if mesh is None:
+            mesh = D.make_kfac_mesh(
+                comm_method=optimizers.COMM_METHODS[
+                    cfg.comm_method.lower()],
+                grad_worker_fraction=cfg.grad_worker_fraction)
+        params, extra = launch.replicate_on_mesh(mesh, (params, extra))
+        dkfac = D.DistributedKFAC(kfac, mesh, params)
+        kstate = dkfac.init_state(params)
+        step_fn = dkfac.build_train_step(
+            workload.loss_fn, tx,
+            model_kwargs_fn=workload.model_kwargs_fn,
+            mutable_cols=workload.mutable_cols, donate=False)
+    except (ValueError, TypeError) as e:
+        result.disqualified = f'invalid: {e}'
+        return result
+
+    opt_state = tx.init(params)
+    f_freq = int(cfg.kfac_cov_update_freq)
+    i_freq = int(cfg.kfac_inv_update_freq)
+    hyper = {'lr': cfg.base_lr, 'damping': cfg.damping,
+             'factor_update_freq': f_freq, 'inv_update_freq': i_freq}
+    state = engine.TrainState(params=params, opt_state=opt_state,
+                              kfac_state=kstate, extra_vars=extra)
+    n_warm = max(2, int(warmup_windows)) * i_freq
+    batches = [workload.make_batch(i % 4) for i in range(n_warm)]
+
+    # Warm epoch: every program variant a full cadence window touches
+    # compiles here, outside the recorded segment. TWO windows minimum
+    # — the first window's firing consumes the freshly-committed
+    # (replicate_on_mesh) state, the second consumes epoch-output
+    # state. Those can carry different shardings, and jax's executable
+    # cache is sharding-keyed BELOW the trace cache: a variant first
+    # called on committed inputs silently compiles a second executable
+    # on its first steady-state call, with no retrace and no compile
+    # event (measured: ~2 s on a tiny CPU workload). One window would
+    # leak exactly that compile into the recorded segment's first
+    # firing and mis-score every candidate by its tail metrics.
+    engine.train_epoch(step_fn, state, batches, hyper,
+                       metrics_sink=None)
+    state.epoch -= 1  # the probe is one logical segment, not epochs
+    step_fn.compile_events.clear()  # warm-up compiles are expected
+
+    tmp = None
+    if keep_stream is None:
+        tmp = tempfile.mkdtemp(prefix='kfac_autotune_')
+        stream = os.path.join(tmp, 'probe.jsonl')
+    else:
+        stream = keep_stream
+    sink = obs_sink.JsonlMetricsSink(
+        stream, meta={'autotune_probe': workload.name,
+                      'knobs': {k: repr(v) for k, v in knobs.items()},
+                      'backend': jax.default_backend()})
+    measured = [workload.make_batch(i % 4) for i in range(int(steps))]
+    engine.train_epoch(step_fn, state, measured, hyper,
+                       metrics_sink=sink,
+                       memory_interval=max(1, i_freq))
+    sink.close()
+
+    records, _ = obs_sink.read_jsonl_tolerant(stream)
+    # Compile-labeled samples are trace+XLA wall time, not step time.
+    scored = [r for r in records
+              if not (r.get('kind') == 'step'
+                      and r.get('fired') == 'compile')]
+    result.metrics = obs_gate.gate_metrics(scored)
+    result.n_steps = result.metrics.get('n_steps', 0)
+    result.retraces = sum(
+        1 for r in records
+        if r.get('kind') == 'event' and r.get('event') == 'retrace')
+    if max(step_fn.trace_counts.values(), default=1) > 1:
+        result.retraces = max(result.retraces, 1)
+    step_records = [r for r in records if r.get('kind') == 'step']
+    if step_records:
+        result.nonfinite_skips = float(obs_sink.to_float(
+            step_records[-1].get('metrics', {}).get(
+                'kfac/nonfinite_skips', 0.0)))
+        if not np.isfinite(result.nonfinite_skips):
+            result.nonfinite_skips = float('inf')
+    if result.retraces:
+        result.disqualified = 'retraces: a static-cadence variant ' \
+                              'recompiled mid-probe'
+    if keep_stream is not None:
+        result.stream_path = stream
+    elif tmp is not None:
+        # Temp streams are evidence only while the probe runs.
+        for name in os.listdir(tmp):
+            os.unlink(os.path.join(tmp, name))
+        os.rmdir(tmp)
+    return result

@@ -238,6 +238,11 @@ def main(argv=None) -> int:
         print(f'error: {e}', file=sys.stderr)
         return 2
     current = gate_metrics(records)
+    # The tolerances actually applied (defaults + --tol overrides):
+    # part of the verdict artifact, so a recorded gate run is
+    # self-describing — without this you cannot tell from the output
+    # which overrides were in effect.
+    applied_tols = {**DEFAULT_TOLERANCES, **tols}
 
     if args.write_baseline:
         obj = write_baseline(current, args.write_baseline,
@@ -250,7 +255,8 @@ def main(argv=None) -> int:
 
     breaches, skipped = ([], [])
     if baseline is not None:
-        breaches, skipped = compare(current, baseline['metrics'], tols,
+        breaches, skipped = compare(current, baseline['metrics'],
+                                    applied_tols,
                                     allow_missing=args.allow_missing)
     anomalies = [] if args.no_anomaly else anomaly_events(
         records, spike_zscore=args.spike_zscore,
@@ -261,6 +267,7 @@ def main(argv=None) -> int:
     if args.json:
         print(json.dumps({'pass': not failed, 'current': current,
                           'baseline': (baseline or {}).get('metrics'),
+                          'tolerances': applied_tols,
                           'breaches': breaches, 'skipped': skipped,
                           'anomalies': anomalies,
                           'torn_lines': torn}, sort_keys=True))
@@ -270,6 +277,9 @@ def main(argv=None) -> int:
     if torn:
         print(f'note: skipped {torn} torn trailing line(s)')
     print('current: ' + json.dumps(current, sort_keys=True))
+    if baseline is not None:
+        print('tolerances: ' + json.dumps(applied_tols,
+                                          sort_keys=True))
     if baseline is None:
         print('no --baseline: anomaly checks only')
     for s in skipped:

@@ -178,7 +178,37 @@ def summarize(records: list[dict]) -> dict:
     retraces = [dict(r.get('data', {})) for r in events
                 if r['event'] == 'retrace']
 
+    # Autotune decision events (r12): policy backoff/relax decisions
+    # and the fail-closed --tuned-config load outcome. Rendered in
+    # their own section (and pinned in the --json key set) so a run's
+    # effective configuration story is auditable from the stream.
+    # Counts cover the whole stream; the per-event detail list keeps
+    # only the newest window — a mesh oscillating around the skew
+    # threshold emits stretch/relax pairs indefinitely, and neither
+    # the report nor its --json consumer should scale with that (the
+    # full sequence is on disk in the stream itself).
+    autotune_events = [{'event': r['event'], **dict(r.get('data', {}))}
+                       for r in events
+                       if r['event'].startswith('autotune')]
+    autotune = None
+    if autotune_events:
+        autotune = {
+            'n_events': len(autotune_events),
+            'events': autotune_events[-50:],
+            'backoffs': sum(1 for e in autotune_events
+                            if e['event'] == 'autotune_backoff'
+                            and e.get('action') == 'stretch'),
+            'relaxes': sum(1 for e in autotune_events
+                           if e['event'] == 'autotune_backoff'
+                           and e.get('action') == 'relax'),
+            'fallbacks': sum(1 for e in autotune_events
+                             if e['event'] == 'autotune_fallback'),
+            'applies': sum(1 for e in autotune_events
+                           if e['event'] == 'autotune_apply'),
+        }
+
     return {
+        'autotune': autotune,
         'memory': memory,
         'compiles': compiles,
         'retraces': retraces,
@@ -347,10 +377,27 @@ def print_report(s: dict, out=None, torn: int = 0,
               f"{_fmt(float('nan') if mean_skew is None else mean_skew, ' ms')}"
               f"  max "
               f"{_fmt(float('nan') if max_skew is None else max_skew, ' ms')}")
-    # Compile/retrace events have their own section above; everything
-    # else in the event stream is resilience lifecycle (r8).
+    if s.get('autotune'):
+        a = s['autotune']
+        w()
+        w(f"-- autotune ({a['n_events']} decision event(s)) --")
+        w(f"policy backoffs: {a['backoffs']} stretch / "
+          f"{a['relaxes']} relax   tuned-config: {a['applies']} "
+          f"applied / {a['fallbacks']} fell back to defaults")
+        shown = a['events'][-10:]
+        if a['n_events'] > len(shown):
+            w(f"  (newest {len(shown)} of {a['n_events']}; the full "
+              'sequence is in the stream)')
+        for e in shown:
+            detail = ', '.join(f'{k}={v}' for k, v in sorted(e.items())
+                               if k != 'event')
+            w(f'  ! {e["event"]}: {detail}')
+    # Compile/retrace and autotune events have their own sections
+    # above; everything else in the event stream is resilience
+    # lifecycle (r8).
     resil_counts = {k: v for k, v in s['event_counts'].items()
-                    if k not in ('compile', 'retrace')}
+                    if k not in ('compile', 'retrace')
+                    and not k.startswith('autotune')}
     if resil_counts:
         w()
         w('-- resilience events --')
@@ -408,6 +455,7 @@ def summary_json(s: dict, *, torn: int = 0,
         'memory': s['memory'],
         'compiles': s['compiles'],
         'retraces': s['retraces'],
+        'autotune': s['autotune'],
         'event_counts': s['event_counts'],
         'kfac': {
             'factor_updates': s['factor_updates'],

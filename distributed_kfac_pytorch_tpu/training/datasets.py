@@ -179,7 +179,13 @@ def get_lm_corpus(data_dir: str | None = None, *,
     ``data_dir`` (PTB/WikiText layout), building the vocabulary from the
     train split. Without data, generates a synthetic Markov-chain corpus
     (learnable bigram structure, shared between splits).
+    ``KFAC_SYNTHETIC_LM`` overrides the synthetic train-token count
+    from the environment (the CI smokes bound the data volume this
+    way, like ``KFAC_SYNTHETIC_CIFAR`` for the vision sets).
     """
+    env_size = os.environ.get('KFAC_SYNTHETIC_LM')
+    if env_size:
+        synthetic_size = max(int(env_size), 10)
     if data_dir and os.path.isfile(os.path.join(data_dir, 'train.txt')):
         def read(split):
             with open(os.path.join(data_dir, f'{split}.txt')) as f:

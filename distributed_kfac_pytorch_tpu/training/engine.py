@@ -86,7 +86,8 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
                 metrics_sink=None, checkpointer=None,
                 start_step_in_epoch: int = 0,
                 rank_sink=None, barrier_probe=None,
-                memory_interval: int = 0) -> dict[str, float]:
+                memory_interval: int = 0,
+                cadence_policy=None) -> dict[str, float]:
     """One training epoch; returns averaged metrics.
 
     ``hyper`` holds this epoch's dynamic hyperparameters ('lr', 'damping',
@@ -147,6 +148,19 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
     resident K-FAC state footprint (``observability.memory``). Pure
     host-side reads (0 = off). The footprint is computed once per
     epoch: the state's shapes/dtypes are static across steps.
+
+    ``cadence_policy``: an ``autotune.StragglerCadencePolicy`` (or
+    None, the default — that path is byte-for-byte the pre-policy
+    engine). Per step, the policy sees the static cadence flags plus
+    the barrier-probe wait and may suppress a scheduled factor update
+    (straggler-aware cadence backoff, r12). The first suppression per
+    flag combination may compile a new program variant once (a normal
+    lazy-cache compile, recorded and labeled like any other — see
+    ``autotune.policy``); the zero-RETRACE contract still holds with
+    the policy active. Its decision events drain into
+    ``metrics_sink`` like the compile telemetry. Requires
+    ``barrier_probe`` to act on skew (without one the policy is
+    inert).
     """
     if static_cadence == 'auto':
         import inspect
@@ -218,6 +232,12 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
             # proceed? Measured before the dispatch so the wait is not
             # conflated with this step's own compute.
             wait_ms = barrier_probe()
+        if cadence_policy is not None:
+            # Straggler-aware cadence backoff (r12): may flip a
+            # scheduled factor_update off while skew is sustained.
+            # Applied BEFORE dispatch and before the fired-stage label
+            # is derived, so attribution reflects what actually ran.
+            flags = cadence_policy.adjust(state.step, flags, wait_ms)
         t_it = time.perf_counter()
         (state.params, state.opt_state, state.kfac_state, state.extra_vars,
          metrics) = step_fn(state.params, state.opt_state, state.kfac_state,
@@ -284,6 +304,14 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
                     data = {k: v for k, v in ev.items() if k != 'event'}
                     emit_event(ev['event'], **data)
                 pending.clear()
+            # Autotune policy decisions (stretch/relax) ride the same
+            # event channel so the report/gate can see them offline.
+            if (cadence_policy is not None and emit_event is not None
+                    and cadence_policy.pending_events):
+                for ev in cadence_policy.drain_events():
+                    data = {k: v for k, v in ev.items()
+                            if k != 'event'}
+                    emit_event(ev['event'], **data)
         state.step += 1
         n_batches += 1
         for k, v in metrics.items():

@@ -105,6 +105,26 @@ class OptimConfig:
     kfac_update_freq_schedule: Sequence[int] = ()
 
 
+#: OptimConfig fields the perf autotuner may override from a committed
+#: ``TUNED_<workload>.json`` artifact (``autotune.apply_tuned``). The
+#: set is restricted to per-KFAC knobs that leave the mesh topology
+#: alone: mesh-shaping knobs (``comm_method``,
+#: ``grad_worker_fraction``) would desync the already-constructed mesh
+#: from the config, so they stay CLI-flag-only (the artifact records
+#: them as provenance instead). An artifact naming a knob outside this
+#: set is rejected whole (fail-closed) rather than applied partially.
+TUNABLE_FIELDS = (
+    'bf16_precond',
+    'bf16_factors',
+    'bf16_inverses',
+    'inv_pipeline_chunks',
+    'factor_batch_fraction',
+    'kfac_cov_update_freq',
+    'kfac_inv_update_freq',
+    'eigh_polish_iters',
+)
+
+
 def make_sgd(cfg: OptimConfig) -> optax.GradientTransformation:
     """SGD with L2 and momentum, torch-ordered (wd before momentum).
 

@@ -25,6 +25,7 @@ import optax
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
+from distributed_kfac_pytorch_tpu import autotune
 from distributed_kfac_pytorch_tpu import capture as capture_lib
 from distributed_kfac_pytorch_tpu import elastic as elastic_lib
 from distributed_kfac_pytorch_tpu import fp16 as fp16_lib
@@ -145,6 +146,7 @@ def parse_args(argv=None):
                         'exists for exact reference-recipe parity.')
     obs.cli.add_observability_args(p)
     resil.cli.add_resilience_args(p)
+    autotune.cli.add_autotune_args(p)
     return p.parse_args(argv)
 
 
@@ -209,9 +211,16 @@ def main(argv=None):
         bf16_precond=args.bf16_precond,
         kfac_metrics=bool(args.kfac_metrics),
         nonfinite_guard=obs.cli.wants_guard(args))
+    # Tuned-config overlay (fail-closed): the queued apply/fallback
+    # events land in the metrics stream once the sink exists below.
+    cfg, tune_events = autotune.cli.maybe_apply_tuned(args, cfg)
+    cadence_policy = autotune.cli.make_cadence_policy(args)
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(model, cfg)
     if args.kfac_metrics and kfac is None:
         raise SystemExit('--kfac-metrics requires the K-FAC step '
+                         '(--kfac-update-freq > 0)')
+    if cadence_policy is not None and kfac is None:
+        raise SystemExit('--cadence-backoff requires the K-FAC step '
                          '(--kfac-update-freq > 0)')
     metrics_sink = obs.cli.make_metrics_sink(
         args, info, meta={'cli': 'train_cifar10_resnet',
@@ -219,6 +228,7 @@ def main(argv=None):
                           'batch_size': args.batch_size,
                           'devices': n_dev,
                           'metrics_interval': args.metrics_interval})
+    autotune.emit_events(metrics_sink, tune_events)
     rank_sink = obs.cli.make_rank_shard_sink(
         args, info, meta={'cli': 'train_cifar10_resnet'})
 
@@ -283,11 +293,15 @@ def main(argv=None):
         model, lambda out, b: utils.label_smooth_loss(out, b[1], 0.0),
         mesh, model_args_fn=lambda b: (b[0],),
         model_kwargs={'train': False})
-    # Straggler barrier probe: only with shards requested AND a K-FAC
-    # step (the probe reduces over the K-FAC data axes; the SGD
-    # baseline's shards still carry per-host wall times without it).
+    # Straggler barrier probe: with shards requested OR the cadence-
+    # backoff policy armed, and a K-FAC step (the probe reduces over
+    # the K-FAC data axes; the SGD baseline's shards still carry
+    # per-host wall times without it). The policy consumes the same
+    # per-step wait the shards record.
     barrier_probe = (dkfac.build_barrier_probe()
-                     if rank_sink is not None and dkfac is not None
+                     if (rank_sink is not None
+                         or cadence_policy is not None)
+                     and dkfac is not None
                      else None)
 
     state = engine.TrainState(params=params, opt_state=opt_state,
@@ -371,7 +385,8 @@ def main(argv=None):
                     metrics_sink=metrics_sink, checkpointer=step_ckpt,
                     start_step_in_epoch=skip,
                     rank_sink=rank_sink, barrier_probe=barrier_probe,
-                    memory_interval=args.memory_interval)
+                    memory_interval=args.memory_interval,
+                    cadence_policy=cadence_policy)
             val_batches = launch.global_batches(
                 mesh, datasets.epoch_batches(
                     test_x, test_y, args.val_batch_size, shuffle=False,

@@ -26,6 +26,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
+from distributed_kfac_pytorch_tpu import autotune
 from distributed_kfac_pytorch_tpu import capture as capture_lib
 from distributed_kfac_pytorch_tpu import elastic as elastic_lib
 from distributed_kfac_pytorch_tpu import fp16 as fp16_lib
@@ -163,6 +164,7 @@ def parse_args(argv=None):
                         'exists for exact reference-recipe parity.')
     obs.cli.add_observability_args(p)
     resil.cli.add_resilience_args(p)
+    autotune.cli.add_autotune_args(p)
     return p.parse_args(argv)
 
 
@@ -268,9 +270,16 @@ def main(argv=None):
         bf16_precond=args.bf16_precond,
         kfac_metrics=bool(args.kfac_metrics),
         nonfinite_guard=obs.cli.wants_guard(args))
+    # Tuned-config overlay (fail-closed): the queued apply/fallback
+    # events land in the metrics stream once the sink exists below.
+    cfg, tune_events = autotune.cli.maybe_apply_tuned(args, cfg)
+    cadence_policy = autotune.cli.make_cadence_policy(args)
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(model, cfg)
     if args.kfac_metrics and kfac is None:
         raise SystemExit('--kfac-metrics requires the K-FAC step '
+                         '(--kfac-update-freq > 0)')
+    if cadence_policy is not None and kfac is None:
+        raise SystemExit('--cadence-backoff requires the K-FAC step '
                          '(--kfac-update-freq > 0)')
     metrics_sink = obs.cli.make_metrics_sink(
         args, info, meta={'cli': 'train_imagenet_resnet',
@@ -278,6 +287,7 @@ def main(argv=None):
                           'batch_size': args.batch_size,
                           'devices': n_dev,
                           'metrics_interval': args.metrics_interval})
+    autotune.emit_events(metrics_sink, tune_events)
     rank_sink = obs.cli.make_rank_shard_sink(
         args, info, meta={'cli': 'train_imagenet_resnet'})
 
@@ -342,10 +352,13 @@ def main(argv=None):
         model, lambda out, b: utils.label_smooth_loss(out, b[1], 0.0),
         mesh, model_args_fn=lambda b: (b[0],),
         model_kwargs={'train': False})
-    # Straggler barrier probe: shards requested + a K-FAC step (the
-    # probe reduces over the K-FAC data axes).
+    # Straggler barrier probe: shards requested (or the cadence-backoff
+    # policy armed) + a K-FAC step (the probe reduces over the K-FAC
+    # data axes).
     barrier_probe = (dkfac.build_barrier_probe()
-                     if rank_sink is not None and dkfac is not None
+                     if (rank_sink is not None
+                         or cadence_policy is not None)
+                     and dkfac is not None
                      else None)
 
     state = engine.TrainState(params=params, opt_state=opt_state,
@@ -424,7 +437,8 @@ def main(argv=None):
                     metrics_sink=metrics_sink, checkpointer=step_ckpt,
                     start_step_in_epoch=skip,
                     rank_sink=rank_sink, barrier_probe=barrier_probe,
-                    memory_interval=args.memory_interval)
+                    memory_interval=args.memory_interval,
+                    cadence_policy=cadence_policy)
             if args.precise_bn_batches > 0:
                 # Precise-BN: eval with stats re-estimated at the current
                 # weights; the training EWMA state is restored afterwards.

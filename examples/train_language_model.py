@@ -36,6 +36,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
 from jax.sharding import PartitionSpec as P
 
+from distributed_kfac_pytorch_tpu import autotune
 from distributed_kfac_pytorch_tpu import elastic as elastic_lib
 from distributed_kfac_pytorch_tpu import fp16 as fp16_lib
 from distributed_kfac_pytorch_tpu import launch
@@ -159,6 +160,7 @@ def parse_args(argv=None):
                         'scaler.')
     obs.cli.add_observability_args(p)
     resil.cli.add_resilience_args(p)
+    autotune.cli.add_autotune_args(p)
     return p.parse_args(argv)
 
 
@@ -244,6 +246,10 @@ def main(argv=None):
         bf16_precond=args.bf16_precond,
         kfac_metrics=bool(args.kfac_metrics),
         nonfinite_guard=obs.cli.wants_guard(args))
+    # Tuned-config overlay (fail-closed): the queued apply/fallback
+    # events land in the metrics stream once the sink exists below.
+    cfg, tune_events = autotune.cli.maybe_apply_tuned(args, cfg)
+    cadence_policy = autotune.cli.make_cadence_policy(args)
     tx, lr_schedule, kfac, kfac_sched = optimizers.get_optimizer(model, cfg)
     if kfac is None:
         # --kfac-update-freq 0: plain SGD baseline (reference
@@ -258,6 +264,9 @@ def main(argv=None):
             raise SystemExit('--fp16 requires the K-FAC step '
                              '(--kfac-update-freq > 0); the SGD baseline '
                              'path does not wire the loss scaler.')
+        if cadence_policy is not None:
+            raise SystemExit('--cadence-backoff requires the K-FAC '
+                             'step (--kfac-update-freq > 0)')
     metrics_sink = obs.cli.make_metrics_sink(
         args, info, meta={'cli': 'train_language_model',
                           'arch': args.arch,
@@ -265,6 +274,7 @@ def main(argv=None):
                           'bptt': args.bptt,
                           'devices': n_dev,
                           'metrics_interval': args.metrics_interval})
+    autotune.emit_events(metrics_sink, tune_events)
     rank_sink = obs.cli.make_rank_shard_sink(
         args, info, meta={'cli': 'train_language_model'})
     if args.grad_clip:
@@ -343,10 +353,13 @@ def main(argv=None):
         build_model(args, vocab_size, seq_axis=None), eval_loss, None,
         model_args_fn=lambda b: (b[0],), model_kwargs={'train': False},
         metrics_fn=lambda o, b: {})
-    # Straggler barrier probe: shards requested + a K-FAC step (the
-    # probe reduces over the K-FAC data axes).
+    # Straggler barrier probe: shards requested (or the cadence-backoff
+    # policy armed) + a K-FAC step (the probe reduces over the K-FAC
+    # data axes).
     barrier_probe = (dkfac.build_barrier_probe()
-                     if rank_sink is not None and dkfac is not None
+                     if (rank_sink is not None
+                         or cadence_policy is not None)
+                     and dkfac is not None
                      else None)
 
     state = engine.TrainState(params=params, opt_state=opt_state,
@@ -441,7 +454,8 @@ def main(argv=None):
                     metrics_sink=metrics_sink, checkpointer=step_ckpt,
                     start_step_in_epoch=skip,
                     rank_sink=rank_sink, barrier_probe=barrier_probe,
-                    memory_interval=args.memory_interval)
+                    memory_interval=args.memory_interval,
+                    cadence_policy=cadence_policy)
             val_m = engine.evaluate(
                 eval_step, state,
                 launch.global_batches(

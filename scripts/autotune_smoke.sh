@@ -1,0 +1,80 @@
+#!/usr/bin/env bash
+# Fast-tier autotune smoke (r12): the closed loop end to end on CPU —
+#   1. probe a 2-candidate space of the flagship LM probe workload and
+#      commit a TUNED_flagship_lm.json artifact (+ its probe-stream
+#      evidence at <out>.probe.jsonl);
+#   2. reload the artifact through the real LM CLI via --tuned-config
+#      (one tiny synthetic epoch) and check the metrics stream carries
+#      exactly one autotune_apply event (report --json);
+#   3. fail-closed leg: point the same CLI flag at a torn artifact and
+#      check the run still completes on defaults with exactly one
+#      autotune_fallback event;
+#   4. gate self-check over the committed probe stream (reduce to a
+#      baseline, re-gate against itself — the CI plumbing path; the
+#      --json verdict must now carry the applied tolerances).
+# The same checks run in the suite as tests/test_autotune.py; this
+# wrapper is the standalone/CI-pipeline form (see metrics_smoke.sh).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="$(mktemp -d)"
+trap 'rm -rf "$out"' EXIT
+
+# 1. probe -> artifact (2 candidates keeps the compile bill smoke-sized)
+JAX_PLATFORMS=cpu KFAC_COMPILE_CACHE=0 \
+python -m distributed_kfac_pytorch_tpu.autotune \
+    --workload flagship_lm --steps 6 --max-candidates 2 \
+    --out "$out/TUNED_flagship_lm.json"
+test -f "$out/TUNED_flagship_lm.json"
+test -f "$out/TUNED_flagship_lm.json.probe.jsonl"
+
+# 2. reload through the real LM CLI (tiny synthetic corpus: 32 steps)
+run_lm() {  # $1 = tuned-config path, $2 = metrics path
+    JAX_PLATFORMS=cpu KFAC_COMPILE_CACHE=0 KFAC_SYNTHETIC_LM=2048 \
+    python examples/train_language_model.py \
+        --arch transformer --emsize 32 --nlayers 1 --nheads 2 \
+        --bptt 16 --batch-size 4 --epochs 1 \
+        --kfac-update-freq 4 --no-resume \
+        --log-dir "$out/logs" --checkpoint-dir "$out/ckpt-$(basename "$2" .jsonl)" \
+        --kfac-metrics "$2" --metrics-interval 1 \
+        --tuned-config "$1"
+}
+run_lm "$out/TUNED_flagship_lm.json" "$out/applied.jsonl"
+python -m distributed_kfac_pytorch_tpu.observability.report \
+    "$out/applied.jsonl" --json > "$out/applied.json"
+python - "$out/applied.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+a = r['autotune']
+assert a and a['applies'] == 1 and a['fallbacks'] == 0, a
+print('tuned-config apply OK')
+EOF
+
+# 3. fail-closed: a torn artifact must fall back to defaults + 1 event
+printf '{"format": "kfac-autotune' > "$out/torn.json"
+run_lm "$out/torn.json" "$out/fellback.jsonl"
+python -m distributed_kfac_pytorch_tpu.observability.report \
+    "$out/fellback.jsonl" --json > "$out/fellback.json"
+python - "$out/fellback.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+a = r['autotune']
+assert a and a['fallbacks'] == 1 and a['applies'] == 0, a
+print('fail-closed fallback OK')
+EOF
+
+# 4. gate self-check over the committed probe stream
+python -m distributed_kfac_pytorch_tpu.observability.gate \
+    "$out/TUNED_flagship_lm.json.probe.jsonl" \
+    --write-baseline "$out/B.json"
+python -m distributed_kfac_pytorch_tpu.observability.gate \
+    "$out/TUNED_flagship_lm.json.probe.jsonl" \
+    --baseline "$out/B.json" --allow-missing --json > "$out/gate.json"
+python - "$out/gate.json" <<'EOF'
+import json, sys
+v = json.load(open(sys.argv[1]))
+assert v['pass'] is True, v
+assert 'tolerances' in v and 'step_p50_ms' in v['tolerances'], v
+print('gate self-check OK')
+EOF
+echo "autotune smoke OK"

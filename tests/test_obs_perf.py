@@ -472,8 +472,8 @@ def test_cli_no_perf_anomalies_flag(tmp_path):
 REPORT_JSON_KEYS = {
     'meta', 'n_records', 'n_steps', 'n_epochs', 'step_range',
     'step_time', 'stages', 'memory', 'compiles', 'retraces',
-    'event_counts', 'kfac', 'health_events', 'stragglers',
-    'torn_lines',
+    'autotune', 'event_counts', 'kfac', 'health_events',
+    'stragglers', 'torn_lines',
 }
 
 
@@ -494,6 +494,7 @@ def test_report_json_key_contract(tmp_path, capsys):
     assert parsed['kfac']['factor_updates'] == 4.0
     assert parsed['torn_lines'] == 0
     assert parsed['stragglers'] is None  # no shards next to this run
+    assert parsed['autotune'] is None    # no autotune events either
 
 
 def test_report_json_sanitizes_nonfinite(tmp_path, capsys):
@@ -667,3 +668,11 @@ def test_gate_json_verdict(tmp_path, capsys):
     assert verdict['pass'] is True
     assert verdict['breaches'] == [] and verdict['anomalies'] == []
     assert verdict['current']['n_steps'] == 40
+    # The tolerances actually applied are part of the verdict (you
+    # could not previously tell which --tol overrides were in effect).
+    assert verdict['tolerances'] == obs_gate.DEFAULT_TOLERANCES
+    assert obs_gate.main([str(run), '--baseline', str(base), '--json',
+                          '--tol', 'step_p50_ms=0.42']) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict['tolerances']['step_p50_ms'] == 0.42
+    assert verdict['tolerances']['retraces'] == 0.0
