@@ -111,6 +111,11 @@ def _setup(args, with_kfac=True):
         # scale fp32 inverse stacks alone are 3.2 GB and the scan
         # carry double-buffers.
         kw['inv_dtype'] = jnp.bfloat16
+    if args.kfac_approx and args.kfac_approx != 'expand':
+        # r13 weight-sharing approximation: 'reduce' switches every
+        # sequence-shared Dense's factor statistics to the
+        # sum-over-sequence form (and ties the embedding factor pair).
+        kw['kfac_approx'] = args.kfac_approx
     kfac = KFAC(model, factor_update_freq=1, inv_update_freq=1,
                 damping=0.003, lr=0.1, **kw)
     variables, kstate = kfac.init(jax.random.PRNGKey(0), ids, train=False)
@@ -336,6 +341,133 @@ def run_phase(args):
 
 
 # ---------------------------------------------------------------------------
+# KFAC-expand vs KFAC-reduce vs SGD quality ladder (r13)
+# ---------------------------------------------------------------------------
+
+def run_quality_leg(args):
+    """One (d_model, leg) rung of the --approx-ab scaling ladder.
+
+    A short REAL training run (synthetic Markov corpus, the LM CLI's
+    offline default) recording the per-step loss curve and steady-state
+    ms/iter: legs 'sgd' (momentum baseline), 'expand' and 'reduce'
+    (K-FAC under each weight-sharing approximation, identical
+    hyperparameters otherwise — the curve difference isolates the
+    approximation). Static cadence f=--ab-f / i=--ab-i through
+    ``engine.cadence_flags`` like a production run; one jit variant per
+    flag combination; step 0's compile wall is excluded from ms/iter.
+    Quality curves, not microbenches — the PERF.md r13 decision rule
+    consumes these next to step_breakdown's factor-cost rows.
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import bench as B  # noqa: F401  (compile cache)
+    from distributed_kfac_pytorch_tpu import KFAC
+    from distributed_kfac_pytorch_tpu.models import transformer_lm
+    from distributed_kfac_pytorch_tpu.training import datasets, engine
+
+    d = args.ab_d
+    leg = args.quality_leg
+    train_ids, _, vocab = datasets.get_lm_corpus(
+        None, synthetic_size=max(args.ab_steps * args.ab_batch
+                                 * args.ab_seq + args.ab_seq + 1,
+                                 20_000),
+        vocab_size=args.ab_vocab)
+    model = transformer_lm.TransformerLM(
+        vocab_size=vocab, d_model=d, num_layers=args.ab_layers,
+        num_heads=8, max_len=args.ab_seq, dropout=0.0, tie_weights=True)
+
+    def loss_of(logits, tgt):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt).mean()
+
+    tx = optax.sgd(args.ab_lr, momentum=0.9)
+    f_freq, i_freq = args.ab_f, args.ab_i
+    if leg == 'sgd':
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, args.ab_seq), jnp.int32),
+                               train=False)
+        params = variables['params']
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def sgd_step(params, opt_state, x, y):
+            def wrapped(p):
+                return loss_of(model.apply({'params': p}, x,
+                                           train=False), y)
+            l, grads = jax.value_and_grad(wrapped)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, l
+
+        def step(st, x, y, flags):
+            p, o, l = sgd_step(st[0], st[1], x, y)
+            return (p, o), l
+        state0 = (params, opt_state)
+    else:
+        kfac = KFAC(model, factor_update_freq=f_freq,
+                    inv_update_freq=i_freq, damping=0.003,
+                    lr=args.ab_lr, kl_clip=0.001,
+                    kfac_approx=leg)
+        variables, kstate = kfac.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, args.ab_seq), jnp.int32), train=False)
+        params = variables['params']
+        opt_state = tx.init(params)
+        variants = {}
+
+        def step(st, x, y, flags):
+            key = (flags['factor_update'], flags['inv_update'])
+            if key not in variants:
+                def impl(params, opt_state, kstate, x, y,
+                         _f=key[0], _i=key[1]):
+                    l, _, grads, captures, _ = (
+                        kfac.capture.loss_and_grads(
+                            lambda out: loss_of(out, y), params, x,
+                            train=False, intercept=_f))
+                    g, kstate = kfac.step(kstate, grads, captures,
+                                          factor_update=_f,
+                                          inv_update=_i)
+                    updates, opt_state = tx.update(g, opt_state,
+                                                   params)
+                    params = optax.apply_updates(params, updates)
+                    return params, opt_state, kstate, l
+                variants[key] = jax.jit(impl)
+            p, o, k, l = variants[key](st[0], st[1], st[2], x, y)
+            return (p, o, k), l
+        state0 = (params, opt_state, kstate)
+
+    losses, times = [], []
+    st = state0
+    batches = datasets.bptt_batches(train_ids, args.ab_batch,
+                                    args.ab_seq)
+    for i, (x, y) in enumerate(batches):
+        if i >= args.ab_steps:
+            break
+        flags = engine.cadence_flags(i, f_freq, i_freq)
+        t0 = _time.perf_counter()
+        st, l = step(st, jnp.asarray(x), jnp.asarray(y), flags)
+        jax.block_until_ready(l)
+        times.append((_time.perf_counter() - t0) * 1000.0)
+        losses.append(float(l))
+    tail = losses[-max(len(losses) // 4, 1):]
+    # Steady-state ms/iter over plain (non-fired, post-warm) steps.
+    plain = [t for i, t in enumerate(times)
+             if i > 0 and engine.fired_stage(
+                 engine.cadence_flags(i, f_freq, i_freq)) is None]
+    emit({'phase_result': round(float(np.mean(tail)), 4),
+          'losses': [round(v, 4) for v in losses],
+          'final_loss': round(float(np.mean(tail)), 4),
+          'first_loss': round(losses[0], 4),
+          'ms_per_iter_plain': (round(float(np.median(plain)), 2)
+                                if plain else None),
+          'steps': len(losses)})
+
+
+# ---------------------------------------------------------------------------
 # Observability baseline (r10): reduce a short measured run to the
 # committed gate baseline (BASELINE_OBS.json)
 # ---------------------------------------------------------------------------
@@ -456,6 +588,8 @@ def spawn_phase(args, phase, inverse_method=None):
         cmd += ['--precond-dtype', args.precond_dtype]
     if inverse_method:
         cmd += ['--inverse-method', inverse_method]
+    if args.kfac_approx:
+        cmd += ['--kfac-approx', args.kfac_approx]
     if args.attn_block_size:
         cmd += ['--attn-block-size', str(args.attn_block_size)]
     if args.inv_pipeline_chunks > 1:
@@ -527,6 +661,40 @@ def main(argv=None):
                         'bucket_parts (LPT per-matrix packing, the '
                         'runtime plan) — max_chunk_ms is the residual '
                         'spike a pipelined window pays per step')
+    p.add_argument('--kfac-approx', default=None,
+                   choices=['expand', 'reduce'],
+                   help='r13 weight-sharing approximation for the '
+                        'K-FAC phases (factors/firing legs): reduce '
+                        'sums/averages over the sequence axis before '
+                        'the factor covariance')
+    p.add_argument('--approx-ab', action='store_true',
+                   help='r13 expand/reduce/SGD quality ladder: for '
+                        'each --ladder d_model, run a short REAL '
+                        'training leg per approximation (identical '
+                        'hyperparameters) and emit the loss curves + '
+                        'steady-state ms/iter — the committed evidence '
+                        'rows (FLAGSHIP_LM_r13_APPROX.jsonl; PERF.md '
+                        'r13 decision rule)')
+    p.add_argument('--ladder', type=int, nargs='+',
+                   default=[512, 1024, 2048],
+                   help='--approx-ab d_model rungs (d512 -> d2048)')
+    p.add_argument('--ab-steps', type=int, default=60,
+                   help='training steps per --approx-ab leg')
+    p.add_argument('--ab-seq', type=int, default=64)
+    p.add_argument('--ab-batch', type=int, default=8)
+    p.add_argument('--ab-vocab', type=int, default=512)
+    p.add_argument('--ab-layers', type=int, default=2)
+    p.add_argument('--ab-lr', type=float, default=0.1)
+    p.add_argument('--ab-f', type=int, default=5,
+                   help='--approx-ab factor-update cadence')
+    p.add_argument('--ab-i', type=int, default=20,
+                   help='--approx-ab inverse-update cadence')
+    p.add_argument('--ab-d', type=int, default=512,
+                   help='internal: quality-phase d_model')
+    p.add_argument('--quality-leg', default=None,
+                   choices=['sgd', 'expand', 'reduce'],
+                   help='internal: which --approx-ab leg this '
+                        'subprocess runs')
     p.add_argument('--obs-baseline', default=None, metavar='PATH',
                    help='record a per-step metrics stream at this '
                         'config and reduce it to a committed '
@@ -541,8 +709,56 @@ def main(argv=None):
     if args.obs_baseline:
         return run_obs_baseline(args)
 
+    if args.phase == 'quality':
+        return run_quality_leg(args)
+
     if args.phase:
         return run_phase(args)
+
+    if args.approx_ab:
+        import jax as _jax
+        backend = _jax.default_backend()
+        for d in args.ladder:
+            for leg in ('sgd', 'expand', 'reduce'):
+                cmd = [sys.executable, os.path.abspath(__file__),
+                       '--phase', 'quality', '--quality-leg', leg,
+                       '--ab-d', str(d),
+                       '--ab-steps', str(args.ab_steps),
+                       '--ab-seq', str(args.ab_seq),
+                       '--ab-batch', str(args.ab_batch),
+                       '--ab-vocab', str(args.ab_vocab),
+                       '--ab-layers', str(args.ab_layers),
+                       '--ab-lr', str(args.ab_lr),
+                       '--ab-f', str(args.ab_f),
+                       '--ab-i', str(args.ab_i)]
+                row = {'config': 4, 'ab': 'kfac_approx',
+                       'd_model': d, 'leg': leg, 'backend': backend,
+                       'seq': args.ab_seq, 'batch': args.ab_batch,
+                       'vocab': args.ab_vocab,
+                       'layers': args.ab_layers,
+                       'steps': args.ab_steps, 'lr': args.ab_lr,
+                       'cadence': f'f{args.ab_f}_i{args.ab_i}'}
+                try:
+                    out = subprocess.run(cmd, capture_output=True,
+                                         text=True, timeout=7200,
+                                         cwd=REPO)
+                except subprocess.TimeoutExpired:
+                    emit({**row, 'error': 'timeout'})
+                    continue
+                for line in reversed(out.stdout.strip().splitlines()):
+                    try:
+                        obj = json.loads(line)
+                        obj.pop('phase_result', None)
+                        emit({**row, **obj})
+                        break
+                    except Exception:
+                        continue
+                else:
+                    from bench import extract_failure_line
+                    emit({**row, 'error': extract_failure_line(
+                        out.stderr, limit=160)
+                        or f'rc={out.returncode}'})
+        return
 
     if args.precond_ab:
         import jax as _jax

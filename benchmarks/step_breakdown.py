@@ -179,6 +179,149 @@ def tuned_vs_default(args, model, x, y, inv_freq):
                                      2)})
 
 
+def lm_approx_rows(args):
+    """Per-approximation factor-update cost rows (r13).
+
+    For each ``--lm-d`` rung of the LM ladder: a scanned
+    capture+precondition baseline (factor_update=False — everything
+    the step pays EXCEPT the factor statistics, the r6 cumulative-
+    phase methodology) and a capture+precondition+factor-EWMA leg per
+    weight-sharing approximation ('expand' flattens B*T covariance
+    rows, 'reduce' sums/averages over T first). The deltas isolate the
+    A/G factor-statistic cost per approx — on the d2048 rung reduce's
+    contraction sees seq x fewer rows, so its factor cost should drop
+    toward ~T x, bounded by the rows-independent EWMA/symmetrize
+    dim^2 passes that remain in both legs (the r13 claim the
+    committed BENCH_r13_APPROX_COST.jsonl records; CPU provenance
+    caveats per PERF.md).
+    """
+    import jax.numpy as jnp
+    import optax as _optax
+
+    from distributed_kfac_pytorch_tpu.models import transformer_lm
+
+    for d in args.lm_d:
+        model = transformer_lm.TransformerLM(
+            vocab_size=args.lm_vocab, d_model=d, num_layers=1,
+            num_heads=8, max_len=args.lm_seq, dropout=0.0,
+            tie_weights=False)
+        ids = jax.random.randint(jax.random.PRNGKey(1),
+                                 (args.lm_batch, args.lm_seq), 0,
+                                 args.lm_vocab)
+        tgt = jax.random.randint(jax.random.PRNGKey(2),
+                                 (args.lm_batch, args.lm_seq), 0,
+                                 args.lm_vocab)
+
+        def loss(out, tgt=tgt):
+            return _optax.softmax_cross_entropy_with_integer_labels(
+                out, tgt).mean()
+
+        def make_run(approx, factor_update):
+            kfac = KFAC(model, factor_update_freq=1,
+                        inv_update_freq=args.iters * 10,
+                        damping=0.003, lr=0.1,
+                        kfac_approx=approx)
+            variables, kstate = kfac.init(jax.random.PRNGKey(0), ids,
+                                          train=False)
+            params = variables['params']
+            tx = _optax.sgd(0.1, momentum=0.9)
+            opt_state = tx.init(params)
+
+            def body(carry, _):
+                params, opt_state, kstate = carry
+                l, _, grads, captures, _ = (
+                    kfac.capture.loss_and_grads(loss, params, ids,
+                                                train=False))
+                # The baseline leg still PRECONDITIONS (frozen
+                # inverses): the factor-cost delta must not absorb
+                # the approx-independent precondition matmuls.
+                g, kstate = kfac.step(kstate, grads, captures,
+                                      factor_update=factor_update,
+                                      inv_update=False)
+                updates, opt_state = tx.update(g, opt_state, params)
+                params = _optax.apply_updates(params, updates)
+                return (params, opt_state, kstate), l
+
+            @jax.jit
+            def run(carry):
+                carry, losses = jax.lax.scan(body, carry, None,
+                                             length=args.iters)
+                return carry, losses[-1]
+            return run, (params, opt_state, kstate)
+
+        run, carry = make_run('expand', factor_update=False)
+        base = B.time_chained(run, carry, args.iters,
+                              leg=f'lm{d}_precond')
+        row = {'phase': 'lm_approx_factor_cost', 'd_model': d,
+               'seq': args.lm_seq, 'batch': args.lm_batch,
+               'vocab': args.lm_vocab,
+               'backend': jax.default_backend(),
+               'precond_ms_per_iter': round(base, 2)}
+        for approx in ('expand', 'reduce'):
+            run, carry = make_run(approx, factor_update=True)
+            ms = B.time_chained(run, carry, args.iters,
+                                leg=f'lm{d}_factors_{approx}')
+            row[f'factors_{approx}_ms_per_iter'] = round(ms, 2)
+            row[f'factor_cost_{approx}'] = round(ms - base, 2)
+        ce, cr = row['factor_cost_expand'], row['factor_cost_reduce']
+        if cr > 0:
+            row['expand_over_reduce'] = round(ce / cr, 2)
+
+        # Statistics-only rows: time the A/G covariance COMPUTATION
+        # alone (no EWMA write-back, no precondition) — the part of
+        # the factor stage the approximation actually changes. The
+        # whole-step deltas above bound the end-to-end win; these
+        # isolate the ~T x contraction claim, which on a memory-bound
+        # CPU is otherwise buried under the rows-independent dim^2
+        # EWMA/assembly traffic both approxes pay equally (on TPU the
+        # MXU contraction dominates the factor phase — PERF.md
+        # roofline — so the whole-stage ratio tracks this number).
+        kexp = KFAC(model, kfac_approx='expand')
+        kred = KFAC(model, kfac_approx='reduce')
+        variables, _ = kexp.init(jax.random.PRNGKey(0), ids,
+                                 train=False)
+        kred.init(jax.random.PRNGKey(0), ids, train=False)
+        _, _, _, captures, _ = jax.jit(
+            lambda p: kexp.capture.loss_and_grads(
+                loss, p, ids, train=False))(variables['params'])
+
+        def stat_runner(specs):
+            from distributed_kfac_pytorch_tpu import layers as L
+
+            def body(carry, _):
+                caps = carry
+                probe = jnp.zeros((), jnp.float32)
+                for name, spec in specs.items():
+                    a = L.compute_a_factor(spec, caps[name]['a'])
+                    g = L.compute_g_factor(spec, caps[name]['g'])
+                    probe = probe + a.reshape(-1)[0] + g.reshape(-1)[0]
+                # Perturb float captures so the chain cannot be CSE'd
+                # across scan iterations (ids stay ints).
+                caps = jax.tree.map(
+                    lambda x: x * (1.0 + 1e-6)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                    caps)
+                return caps, probe
+
+            @jax.jit
+            def run(caps):
+                caps, probes = jax.lax.scan(body, caps, None,
+                                            length=args.iters)
+                return caps, probes[-1]
+            return run
+
+        for approx, k in (('expand', kexp), ('reduce', kred)):
+            run = stat_runner(k.specs)
+            ms = B.time_chained(run, captures, args.iters,
+                                leg=f'lm{d}_stats_{approx}')
+            row[f'factor_stats_{approx}_ms_per_iter'] = round(ms, 2)
+        se = row['factor_stats_expand_ms_per_iter']
+        sr = row['factor_stats_reduce_ms_per_iter']
+        if sr > 0:
+            row['stats_expand_over_reduce'] = round(se / sr, 2)
+        emit(row)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument('--iters', type=int, default=30)
@@ -187,7 +330,20 @@ def main(argv=None):
                    help='replay a committed TUNED_*.json against the '
                         'defaults (tuned_vs_default row only; skips '
                         'the phase decomposition)')
+    p.add_argument('--lm-approx', action='store_true',
+                   help='r13 per-approx factor-update cost rows on the '
+                        'LM ladder (expand vs reduce; skips the CIFAR '
+                        'phase decomposition)')
+    p.add_argument('--lm-d', type=int, nargs='+',
+                   default=[512, 1024, 2048],
+                   help='--lm-approx d_model rungs')
+    p.add_argument('--lm-seq', type=int, default=128)
+    p.add_argument('--lm-batch', type=int, default=4)
+    p.add_argument('--lm-vocab', type=int, default=512)
     args = p.parse_args(argv)
+
+    if args.lm_approx:
+        return lm_approx_rows(args)
 
     on_tpu = jax.default_backend() == 'tpu'
     if on_tpu:

@@ -206,6 +206,8 @@ def kfac_overrides(knobs: dict) -> tuple[dict, int | None, list[str]]:
             kwargs['factor_batch_fraction'] = float(value)
         elif name == 'eigh_polish_iters':
             kwargs['eigh_polish_iters'] = int(value)
+        elif name == 'kfac_approx':
+            kwargs['kfac_approx'] = str(value)
         elif name == 'kfac_inv_update_freq':
             inv_freq = int(value)
         else:
@@ -245,6 +247,16 @@ def tune(workload_name: str, *, out: str | None = None,
         kfac_cov_update_freq=int(cov_update_freq))
     base = {f: getattr(base_cfg, f)
             for f in optimizers.TUNABLE_FIELDS}
+    if (not workload.weight_shared
+            and 'kfac_approx' not in (space_overrides or {})):
+        # No weight-shared layers -> 'reduce' resolves to the identical
+        # program as 'expand' (sharing.approx auto-policy): probing
+        # both would double the table for zero information. An explicit
+        # override still wins.
+        space_overrides = {**(space_overrides or {}),
+                           'kfac_approx': ['expand']}
+        log(f'autotune[{workload_name}]: kfac_approx knob dropped '
+            '(workload has no weight-shared layers; reduce == expand)')
     space = space_mod.default_space(space_overrides)
 
     if mesh is None:

@@ -52,6 +52,12 @@ class Workload:
     mutable_cols: tuple = ()
     model_kwargs_fn: Callable | None = None  # batch -> model kwargs
     init_kwargs: dict = dataclasses.field(default_factory=dict)
+    # Does the model have weight-shared (sequence/patch-axis) layers
+    # the r13 kfac_approx knob can act on? False lets the driver drop
+    # that knob from the space: on a conv/MLP workload 'reduce'
+    # resolves to the identical program as 'expand', and probing both
+    # would double the candidate table for zero information.
+    weight_shared: bool = False
 
 
 def _lm_loss(out, batch):
@@ -79,7 +85,8 @@ def _make_flagship_lm() -> Workload:
                     make_batch=make_batch, loss_fn=_lm_loss,
                     batch_size=batch,
                     model_kwargs_fn=lambda b: {'train': False},
-                    init_kwargs={'train': False})
+                    init_kwargs={'train': False},
+                    weight_shared=True)
 
 
 def _make_cifar_resnet20() -> Workload:

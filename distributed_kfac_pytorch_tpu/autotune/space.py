@@ -88,6 +88,9 @@ BASE_CONSTRAINTS = (
                                            1.0)) <= 1.0),
     Constraint('kfac_cov_update_freq must be >= 1',
                lambda c: int(c.get('kfac_cov_update_freq', 1)) >= 1),
+    Constraint("kfac_approx must be 'expand' or 'reduce'",
+               lambda c: c.get('kfac_approx', 'expand') in ('expand',
+                                                            'reduce')),
 )
 
 
@@ -137,6 +140,11 @@ def default_space(overrides: dict[str, Sequence] | None = None
              'fraction of the batch used for factor statistics'),
         Knob('kfac_cov_update_freq', (1, 2),
              'factor-statistics update cadence'),
+        Knob('kfac_approx', ('expand', 'reduce'),
+             'weight-sharing Kronecker approximation (r13): reduce '
+             'collapses the shared sequence/patch axis before the '
+             'covariance — factor-T cheaper factor updates on '
+             'transformer/ViT workloads, a no-op elsewhere'),
     ]
     if overrides:
         unknown = set(overrides) - {k.name for k in stock}

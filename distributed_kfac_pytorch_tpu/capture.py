@@ -57,6 +57,26 @@ EMBEDDING = 'embedding'
 # framework preconditions MobileNet/EfficientNet-class models).
 CONV2D_GROUPED = 'conv2d_grouped'
 
+# Weight-sharing Kronecker approximations (arXiv:2311.00636, "K-FAC for
+# Modern Neural Network Architectures"). A layer whose weight is shared
+# across a sequence/patch axis (every Dense in a transformer block, the
+# ViT patch-embed conv) admits two principled factorizations:
+#   - KFAC_EXPAND: per-position independence — flatten (batch, T, d)
+#     into B*T covariance rows (the historical default of this repo's
+#     collapse_batch_dims path; bit-identical to pre-sharing behavior);
+#   - KFAC_REDUCE: reduce over the shared axis BEFORE the covariance —
+#     activations are averaged and output-grads summed over T (the
+#     paper's Eq. 22 convention keeps the bias column exactly 1), so
+#     the factor contraction sees B rows instead of B*T: a factor-T
+#     cheaper statistic that is exact whenever activations are constant
+#     across the shared axis and empirically matches expand on
+#     transformer/ViT workloads.
+# The per-layer choice is carried here, in the registry
+# (LayerSpec.kfac_approx), resolved by sharing.approx.
+KFAC_EXPAND = 'expand'
+KFAC_REDUCE = 'reduce'
+KFAC_APPROXES = (KFAC_EXPAND, KFAC_REDUCE)
+
 
 @dataclasses.dataclass(frozen=True)
 class LayerSpec:
@@ -78,6 +98,24 @@ class LayerSpec:
     feature_group_count: int = 1   # conv2d_grouped: number of groups
     # embedding only:
     vocab_size: int | None = None
+    # Weight-sharing approximation for this layer's factor statistics
+    # (KFAC_EXPAND | KFAC_REDUCE). Registration records 'expand' (the
+    # exact-parity default); sharing.annotate_specs resolves the
+    # per-layer setting from KFAC(kfac_approx=...). Static program
+    # structure: the choice is baked into the trace (zero retraces).
+    kfac_approx: str = KFAC_EXPAND
+    # Shared-axis positions seen at registration (prod of the input
+    # dims between batch and features for a Dense; 1 when the input is
+    # 2-D). The sharing policy's "is this Dense sequence/patch-shared"
+    # signal; informational for other kinds.
+    shared_positions: int = 1
+    # Tied-embedding support: number of ``Embed.attend`` call sites
+    # captured for this embedding (0 = lookup-only registration). The
+    # in/out-tied pair contributes BOTH call sites' statistics to one
+    # factor pair with one inverse entry (the reference's
+    # register_shared_module intent, kfac/preconditioner.py:404-470 —
+    # which it then disabled wholesale, embedding.py:20).
+    tied_calls: int = 0
 
     @property
     def name(self) -> str:
@@ -145,7 +183,7 @@ def _decline_reason(mod: nn.Module) -> str | None:
 
 
 def _spec_for_module(mod: nn.Module, path: tuple[str, ...],
-                     num_calls: int) -> LayerSpec | None:
+                     num_calls: int, a_in=None) -> LayerSpec | None:
     """Build a LayerSpec for a supported flax module, else None.
 
     Mirrors the registry dispatch in reference kfac/layers/__init__.py:13-36
@@ -153,14 +191,20 @@ def _spec_for_module(mod: nn.Module, path: tuple[str, ...],
     (grouped/dilated convs, subclasses of the registered families)
     skipped rather than mis-modelled (declines are recorded and
     reported — see KFACCapture.skipped_modules).
+
+    ``a_in`` is the module input at registration time — only its static
+    SHAPE is read (the Dense shared-axis position count for the
+    sharing policy); None leaves the default.
     """
     if _decline_reason(mod) is not None:
         return None
     # isinstance AFTER the decline gate: what reaches here is the exact
     # type or a flax lifted-transform wrapper (accepted above).
     if isinstance(mod, nn.Dense):
+        shared = (int(np.prod(a_in.shape[1:-1]))
+                  if a_in is not None and a_in.ndim > 2 else 1)
         return LayerSpec(path=path, kind=LINEAR, has_bias=mod.use_bias,
-                         num_calls=num_calls)
+                         num_calls=num_calls, shared_positions=shared)
     if isinstance(mod, nn.Conv):
         strides = mod.strides
         if strides is None:
@@ -203,8 +247,17 @@ class KFACCapture:
     def __init__(self, model: nn.Module,
                  skip_layers: str | Sequence[str] | None = None,
                  capture_dtype: Any = 'auto',
-                 trainable: Callable[[str], bool] | None = None):
+                 trainable: Callable[[str], bool] | None = None,
+                 tied_embeddings: bool = False):
         self.model = model
+        # Capture ``Embed.attend`` call sites (the tied in/out decoder,
+        # flax's form of the reference register_shared_module pair) so
+        # both uses of a tied embedding weight feed one factor pair.
+        # Off by default: the lookup-only capture is the historical
+        # bit-identical path (KFAC resolves the default from its
+        # sharing setting).
+        self.tied_embeddings = tied_embeddings
+        self._tied_counts: dict[tuple[str, ...], int] = {}
         if skip_layers is None:
             skip_layers = []
         elif isinstance(skip_layers, str):
@@ -263,10 +316,40 @@ class KFACCapture:
 
     def _make_interceptor(self, record_specs: bool):
         call_counts: dict[tuple[str, ...], int] = {}
+        tied_counts: dict[tuple[str, ...], int] = {}
+        self._tied_counts = tied_counts
+
+        def tied_attend(mod, path, args, kwargs, next_fun):
+            """Capture an ``Embed.attend`` call site (the output-tied
+            use of a tied in/out embedding weight: ``logits = x E^T``).
+            The attend input rides in the ``a_tied`` capture slot and
+            the output probe in ``tied_probe<i>`` — paired by
+            :meth:`collect` into the same layer's captures so both call
+            sites' statistics feed ONE factor pair (the reference's
+            register_shared_module intent, preconditioner.py:404-470).
+            """
+            if args:
+                x_in = args[0]
+            elif 'query' in kwargs:
+                x_in = kwargs['query']
+            else:
+                return next_fun(*args, **kwargs)
+            idx = tied_counts.get(path, 0)
+            tied_counts[path] = idx + 1
+            mod.sow(CAPTURE_COL, 'a_tied', self._cast_capture(x_in),
+                    init_fn=tuple, reduce_fn=lambda p, x: p + (x,))
+            y = next_fun(*args, **kwargs)
+            return mod.perturb(f'tied_probe{idx}', y,
+                               collection=PROBE_COL)
 
         def interceptor(next_fun, args, kwargs, context):
             mod = context.module
-            if context.method_name != '__call__' or mod is None:
+            if mod is None:
+                return next_fun(*args, **kwargs)
+            is_attend = (self.tied_embeddings
+                         and context.method_name == 'attend'
+                         and isinstance(mod, nn.Embed))
+            if context.method_name != '__call__' and not is_attend:
                 return next_fun(*args, **kwargs)
             path = self._module_path(mod)
             if self._is_skipped(mod, path):
@@ -285,6 +368,8 @@ class KFACCapture:
                 if record_specs and reason:
                     self._skipped['/'.join(path)] = reason
                 return next_fun(*args, **kwargs)
+            if is_attend:
+                return tied_attend(mod, path, args, kwargs, next_fun)
             # Dense/Conv/Embed all name their input 'inputs'; support both
             # positional and keyword call styles.
             if args:
@@ -301,7 +386,8 @@ class KFACCapture:
             y = next_fun(*args, **kwargs)
             y = mod.perturb(f'probe{idx}', y, collection=PROBE_COL)
             if record_specs:
-                spec = _spec_for_module(mod, path, call_counts[path])
+                spec = _spec_for_module(mod, path, call_counts[path],
+                                        a_in)
                 self._specs['/'.join(path)] = spec
             return y
 
@@ -327,6 +413,16 @@ class KFACCapture:
             variables = model.init(rng, *args, **kwargs)
         variables = dict(variables)
         variables.pop(CAPTURE_COL, None)
+        # Tied attend call sites seen during the trace: merge the count
+        # into the owning embedding's spec (the attend branch never
+        # records specs itself — registration is the lookup's job; an
+        # attend on a NEVER-looked-up Embed stays unregistered, like
+        # any other un-called module).
+        for path, n in self._tied_counts.items():
+            name = '/'.join(path)
+            if name in self._specs:
+                self._specs[name] = dataclasses.replace(
+                    self._specs[name], tied_calls=n)
         self._record_unregistered_params(variables.get('params', {}))
         declined = {n: r for n, r in self._skipped.items()
                     if 'conv' in r.lower() or 'subclass' in r}
@@ -540,15 +636,24 @@ class KFACCapture:
         """
         captures = {}
         for name, spec in self.specs.items():
-            a_node = tuple(_get_path(acts_tree, spec.path)['a'])
+            acts_node = _get_path(acts_tree, spec.path)
+            a_node = tuple(acts_node['a'])
             g_node = _get_path(probe_grads_tree, spec.path)
-            gs = tuple(g_node[f'probe{i}'] for i in range(len(g_node)))
+            n_tied = len(acts_node.get('a_tied', ()))
+            gs = tuple(g_node[f'probe{i}']
+                       for i in range(len(g_node) - n_tied))
             if len(a_node) != len(gs):
                 raise ValueError(
                     f'layer {name}: {len(a_node)} captured activations vs '
                     f'{len(gs)} probe gradients — activation and probe '
                     'call counts must match')
             captures[name] = {'a': a_node, 'g': gs}
+            if n_tied:
+                # Tied-embedding attend sites: inputs + output-grad
+                # probes, paired per call like the primary stream.
+                captures[name]['a_tied'] = tuple(acts_node['a_tied'])
+                captures[name]['g_tied'] = tuple(
+                    g_node[f'tied_probe{i}'] for i in range(n_tied))
         return captures
 
 
@@ -595,6 +700,10 @@ def subsample_captures(captures: dict, fraction: float) -> dict:
         # constant gather under jit.
         return t[np.arange(k) * b // k]
 
-    return {name: {'a': tuple(keep(t) for t in c['a']),
-                   'g': tuple(keep(t) for t in c['g'])}
+    # All capture streams thin identically — including the tied
+    # 'a_tied'/'g_tied' attend-site streams, which feed the same factor
+    # statistics (dropping them here would silently bias the tied
+    # factor pair toward the lookup site at fraction < 1).
+    return {name: {key: tuple(keep(t) for t in calls)
+                   for key, calls in c.items()}
             for name, c in captures.items()}

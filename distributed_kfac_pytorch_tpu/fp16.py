@@ -57,9 +57,12 @@ def sanitize_captures(captures: dict) -> tuple[dict, jax.Array]:
     out = {}
     for name, entry in captures.items():
         clean = {}
-        for key in ('a', 'g'):
+        # Every capture stream, not just the primary 'a'/'g' pair — a
+        # tied embedding's 'a_tied'/'g_tied' attend streams (r13) feed
+        # the same factor statistics and need the same NaN hygiene.
+        for key, calls_in in entry.items():
             calls = []
-            for x in entry[key]:
+            for x in calls_in:
                 ok = _tensor_finite(x)
                 count = count + jnp.where(ok, 0, 1).astype(jnp.int32)
                 calls.append(jnp.where(ok, x, jnp.zeros_like(x)))

@@ -25,6 +25,7 @@ from distributed_kfac_pytorch_tpu.capture import (
     CONV2D,
     CONV2D_GROUPED,
     EMBEDDING,
+    KFAC_REDUCE,
     LINEAR,
     LayerSpec,
 )
@@ -39,20 +40,31 @@ def compute_a_factor(spec: LayerSpec, a_calls: Sequence[jax.Array],
 
     ``compute_dtype`` selects the covariance matmul input dtype (fp32
     accumulation always) — see ops.factors.get_cov.
+
+    ``spec.kfac_approx`` dispatches the weight-sharing approximation
+    for dense/patch-conv layers: 'expand' (default) flattens the
+    shared axis into covariance rows (the historical path, untouched);
+    'reduce' averages activations over it first (sharing.approx,
+    arXiv:2311.00636 Eq. 22). Static per-spec dispatch — the choice is
+    program structure, not data.
     """
+    reduced = spec.kfac_approx == KFAC_REDUCE
     if spec.kind == LINEAR:
+        fn = (F.linear_a_factor_reduced if reduced
+              else F.linear_a_factor)
         out = None
         for a in a_calls:
-            cur = F.linear_a_factor(a, spec.has_bias,
-                                    compute_dtype=compute_dtype)
+            cur = fn(a, spec.has_bias, compute_dtype=compute_dtype)
             out = cur if out is None else out + cur
         return out
     if spec.kind == CONV2D:
+        fn = (F.conv2d_a_factor_reduced if reduced
+              else F.conv2d_a_factor)
         out = None
         for a in a_calls:
-            cur = F.conv2d_a_factor(a, spec.kernel_size, spec.strides,
-                                    spec.padding, spec.has_bias,
-                                    compute_dtype=compute_dtype)
+            cur = fn(a, spec.kernel_size, spec.strides,
+                     spec.padding, spec.has_bias,
+                     compute_dtype=compute_dtype)
             out = cur if out is None else out + cur
         return out
     if spec.kind == CONV2D_GROUPED:
@@ -75,17 +87,27 @@ def compute_a_factor(spec: LayerSpec, a_calls: Sequence[jax.Array],
 
 def compute_g_factor(spec: LayerSpec, g_calls: Sequence[jax.Array],
                      compute_dtype=None) -> jax.Array:
-    """Output-gradient covariance factor G from per-call probe grads."""
+    """Output-gradient covariance factor G from per-call probe grads.
+
+    Under ``spec.kfac_approx == 'reduce'`` the grads are summed over
+    the shared axis before the covariance (the Eq. 22 counterpart of
+    the activation mean — see :func:`compute_a_factor`).
+    """
+    reduced = spec.kfac_approx == KFAC_REDUCE
     if spec.kind in (LINEAR, EMBEDDING):
+        fn = (F.linear_g_factor_reduced
+              if reduced and spec.kind == LINEAR else F.linear_g_factor)
         out = None
         for g in g_calls:
-            cur = F.linear_g_factor(g, compute_dtype=compute_dtype)
+            cur = fn(g, compute_dtype=compute_dtype)
             out = cur if out is None else out + cur
         return out
     if spec.kind == CONV2D:
+        fn = (F.conv2d_g_factor_reduced if reduced
+              else F.conv2d_g_factor)
         out = None
         for g in g_calls:
-            cur = F.conv2d_g_factor(g, compute_dtype=compute_dtype)
+            cur = fn(g, compute_dtype=compute_dtype)
             out = cur if out is None else out + cur
         return out
     if spec.kind == CONV2D_GROUPED:
@@ -96,6 +118,49 @@ def compute_g_factor(spec: LayerSpec, g_calls: Sequence[jax.Array],
             out = cur if out is None else out + cur
         return out
     raise ValueError(f'unknown layer kind {spec.kind!r}')
+
+
+#: capture-entry keys that are QUADRATIC in the output-gradients —
+#: under SPMD (local-mean loss) and gradient accumulation these need
+#: the ``1/world**2`` / ``1/accum**2`` rescale the primary 'G' gets;
+#: everything else ('A', 'G_a') is activation-derived and needs none.
+#: Single point of truth for parallel.distributed's contrib scaling.
+GRAD_QUADRATIC_KEYS = ('G', 'A_g2')
+
+
+def compute_tied_factor_extras(spec: LayerSpec, entry: dict,
+                               compute_dtype=None):
+    """Tied-embedding attend-site factor contributions, or None.
+
+    For an in/out-tied embedding (``spec.tied_calls > 0``, captures
+    carrying the ``a_tied``/``g_tied`` attend streams), the attend call
+    site's Fisher block folds into the SAME factor pair as the lookup
+    (sum of per-site Kronecker approximations — the multi-call /
+    LinearMultiLayer semantics applied across the tie):
+
+      - ``A_g2``: diagonal vocab-side term ``diag cov(dL/dlogits)``
+        (ops.factors.embedding_tied_a_diag) — added to the lookup's
+        one-hot-frequency diagonal. QUADRATIC in the output grads
+        (see GRAD_QUADRATIC_KEYS).
+      - ``G_a``: d-side term ``cov(attend inputs)`` — added to the
+        lookup's output-grad covariance. Activation-derived.
+
+    Returns ``{'A_g2': vec, 'G_a': mat}`` (per-call sums) or None for
+    layers without tied captures. One factor pair, one inverse entry:
+    the state layout is untouched — only the statistics change.
+    """
+    if spec.kind != EMBEDDING or not entry.get('g_tied'):
+        return None
+    a_diag = None
+    for g in entry['g_tied']:
+        cur = F.embedding_tied_a_diag(g)
+        a_diag = cur if a_diag is None else a_diag + cur
+    g_cov = None
+    for x in entry['a_tied']:
+        cur = F.get_cov(F.collapse_batch_dims(x),
+                        compute_dtype=compute_dtype)
+        g_cov = cur if g_cov is None else g_cov + cur
+    return {'A_g2': a_diag, 'G_a': g_cov}
 
 
 def grads_to_matrix(spec: LayerSpec, grads: dict) -> jax.Array:

@@ -12,6 +12,15 @@ attention runs as a ring (``parallel.sequence.ring_self_attention``), the
 rest of the network is token-local, and K-FAC factor statistics average
 over the extra axis like any other batch sharding. The reference has no
 analogue (SURVEY.md §5: sequence handling = BPTT truncation only).
+
+Weight-sharing preconditioning (r13): every Dense here shares its
+weight across the sequence axis, so ``KFAC(kfac_approx='reduce')``
+switches their factor statistics to the KFAC-reduce approximation
+(sum/mean over the sequence before the covariance, arXiv:2311.00636 —
+a factor-seq cheaper factor update; ``sharing.approx``). With
+``tie_weights`` the ``Embed.attend`` decoder call site then also feeds
+the embedding's single factor pair (one inverse for the tied in/out
+weight) instead of contributing gradient with no statistics.
 """
 
 from __future__ import annotations
@@ -165,6 +174,11 @@ def get_model(vocab_size: int, size: str = 'small',
         # straddles the 640 eigen/cholesky dispatch cutoff (q/k/v/o
         # A factors 1025, MLP A factors 1025/4097, G 1024/4096).
         'xl': dict(d_model=1024, num_layers=18, num_heads=16),
+        # d2048 — the top rung of the r13 expand/reduce scaling ladder
+        # (flagship_lm.py --approx-ab): MLP factors 8192/8193, where
+        # KFAC-reduce's sum-over-sequence factor statistics are ~seq x
+        # cheaper than the expand flatten (sharing.approx).
+        'xxl': dict(d_model=2048, num_layers=24, num_heads=16),
     }
     if size not in configs:
         raise ValueError(f'unknown size {size!r}; have {sorted(configs)}')

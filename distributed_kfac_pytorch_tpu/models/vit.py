@@ -17,6 +17,15 @@ position table are plain (non-layer) params, exactly like the LM's
 ``pos_embed`` — SGD-updated, outside K-FAC's blocks, matching how the
 reference leaves non-module params alone.
 
+Weight-sharing preconditioning (r13): under
+``KFAC(kfac_approx='reduce')`` the patch-embed conv registers under
+the KFAC-reduce approximation (its stride==kernel VALID geometry is
+the ``sharing.is_patch_conv`` signature — patch vectors mean-reduced
+over the grid before the covariance, the paper's ViT treatment,
+arXiv:2311.00636) and every encoder Dense reduces over the patch
+sequence; ``'expand'`` (the default) keeps the reference conv2d/flatten
+factor math bit-identically.
+
 For high-resolution inputs, ``attn_block_size`` folds the patch
 sequence blockwise on one device (the chunked-attention knob inherited
 from the shared block; the cls token's ragged ``num_patches + 1``

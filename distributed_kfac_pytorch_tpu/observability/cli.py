@@ -126,6 +126,27 @@ def make_metrics_sink(args, info, meta: dict | None = None):
         meta=meta)
 
 
+def emit_layer_meta(sink, kfac) -> None:
+    """Append the per-layer K-FAC registry provenance to the metrics
+    stream (r13): the resolved weight-sharing approximation per layer
+    (``KFAC.approx_summary`` — 'expand' / 'reduce' / '<approx>+tied')
+    plus the global setting. Called by the CLIs AFTER registration
+    (the sink is built before the model exists, so this rides as a
+    second ``kind='meta'`` record). No-ops on None sinks, non-K-FAC
+    runs, and duck-typed sinks without ``meta_record``.
+    """
+    if sink is None or kfac is None:
+        return
+    emit = getattr(sink, 'meta_record', None)
+    if emit is None:
+        return
+    emit({'kfac_approx': kfac.approx_summary(),
+          'kfac_approx_setting': (kfac.kfac_approx
+                                  if isinstance(kfac.kfac_approx, str)
+                                  else dict(kfac.kfac_approx)),
+          'tied_embeddings': bool(kfac.tied_embeddings)})
+
+
 def metrics_path(args) -> str:
     """The resolved --kfac-metrics path (single point of truth for the
     main stream, the rank shards, and any post-run report/gate call)."""

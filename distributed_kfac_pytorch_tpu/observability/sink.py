@@ -487,6 +487,24 @@ class JsonlMetricsSink:
             rec['trace'] = trace
         self._pending.append(rec)
 
+    def meta_record(self, meta: dict) -> None:
+        """Append a ``kind='meta'`` record mid-stream.
+
+        For run provenance that only exists AFTER sink construction —
+        e.g. the per-layer K-FAC approximation map, resolved at layer
+        registration (the CLIs build the sink before the model). The
+        reader treats every meta record as provenance; multiple are
+        fine (the leading constructor meta stays the run header).
+        Flushed immediately like events: provenance must survive an
+        early crash.
+        """
+        if not self.enabled:
+            return
+        self._pending.append({'schema': SCHEMA_VERSION, 'kind': 'meta',
+                              'wall_time': time.time(),
+                              'meta': dict(meta)})
+        self.flush()
+
     def event_record(self, name: str, **data) -> None:
         """Record a resilience/lifecycle event (preemption, checkpoint
         save + latency, restore — r8). Events bypass interval thinning

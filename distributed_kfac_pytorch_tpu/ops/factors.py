@@ -13,6 +13,7 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from distributed_kfac_pytorch_tpu.observability import profiling
 
@@ -166,6 +167,130 @@ def linear_g_factor(g: jax.Array, compute_dtype=None) -> jax.Array:
     Reference parity: kfac/layers/linear.py:20-24.
     """
     return get_cov(collapse_batch_dims(g), compute_dtype=compute_dtype)
+
+
+# ---------------------------------------------------------------------------
+# KFAC-reduce: sum/mean over the shared (sequence/patch) axis BEFORE the
+# covariance (arXiv:2311.00636; see sharing.approx for the policy layer)
+# ---------------------------------------------------------------------------
+
+def _reduce_shared_axes(x: jax.Array, mean: bool) -> jax.Array:
+    """Reduce ``(B, *, d)`` over the middle (shared) axes -> ``(B, d)``.
+
+    Expressed as a batched ones-row matmul rather than ``jnp.sum/mean``
+    over the axis — the same portability rule as :func:`_column_mean`
+    (axis reductions segfault XLA:CPU inside large shard_map programs,
+    and the batched column reduction rides the MXU on TPU). Accumulates
+    fp32 (``preferred_element_type``) and returns fp32 rows; the
+    downstream covariance's ``compute_dtype`` governs the contraction
+    inputs exactly as on the expand path.
+    """
+    if x.ndim <= 2:
+        return x.astype(jnp.float32)
+    b, d = x.shape[0], x.shape[-1]
+    t = int(np.prod(x.shape[1:-1]))
+    x3 = x.reshape(b, t, d)
+    ones = jnp.ones((1, t), x3.dtype)
+    out = jnp.matmul(ones, x3, preferred_element_type=jnp.float32)[:, 0]
+    return out / t if mean else out
+
+
+@profiling.scope('kfac/factors/linear_a_reduced')
+def linear_a_factor_reduced(a: jax.Array, has_bias: bool,
+                            compute_dtype=None) -> jax.Array:
+    """KFAC-reduce A for a weight-shared dense layer.
+
+    ``a`` is ``(B, T..., d)``; the shared axes are MEAN-reduced before
+    the covariance — the paper's Eq. 22 convention, under which the
+    appended bias column reduces to exactly 1 (an average of ones), so
+    the bias row/column assembly is the ordinary
+    :func:`linear_a_factor` over the ``(B, d)`` reduced rows. Scale is
+    the reduced row count ``B`` (vs expand's ``B*T``): the factor
+    contraction — the dominant factor-phase cost on transformer
+    workloads — is a factor ``T`` cheaper. Degenerates bit-identically
+    to expand at T=1 (test-pinned).
+    """
+    return linear_a_factor(_reduce_shared_axes(a, mean=True), has_bias,
+                           compute_dtype=compute_dtype)
+
+
+@profiling.scope('kfac/factors/linear_g_reduced')
+def linear_g_factor_reduced(g: jax.Array,
+                            compute_dtype=None) -> jax.Array:
+    """KFAC-reduce G for a weight-shared dense layer.
+
+    Output-grads are SUMMED over the shared axes (the weight gradient
+    is the sum over positions, so the summed probe grad keeps the
+    per-sample gradient scale exact — Eq. 22's counterpart to the
+    activation mean), then the covariance runs over the ``B`` rows.
+    """
+    return linear_g_factor(_reduce_shared_axes(g, mean=False),
+                           compute_dtype=compute_dtype)
+
+
+@profiling.scope('kfac/factors/conv2d_a_reduced')
+def conv2d_a_factor_reduced(a: jax.Array, kernel_size, strides, padding,
+                            has_bias: bool,
+                            compute_dtype=None) -> jax.Array:
+    """KFAC-reduce A for a patch-embedding conv (NHWC input).
+
+    The shared axis is the conv's output-position grid: patch vectors
+    are MEAN-reduced over ``(OH, OW)`` and the covariance runs over the
+    ``B`` reduced rows — the paper's ViT patch-embed treatment, with
+    the bias column exactly 1 (Eq. 22). Intended for non-overlapping
+    patch convs (``sharing.is_patch_conv``), where the patches tile the
+    image disjointly; the math is well-defined for any conv geometry.
+
+    NOTE the scaling convention deliberately differs from the expand
+    path's reference-parity ``1/(rows * spatial^2)`` folding
+    (:func:`conv2d_a_factor`): reduce is a different approximation with
+    its own normalization (plain covariance over reduced rows, matching
+    :func:`linear_a_factor_reduced`). At OH*OW = 1 the two coincide
+    bit-identically (spatial = 1 folds to nothing; test-pinned).
+    """
+    if (compute_dtype is None and a.dtype == jnp.float32
+            and jax.default_backend() == 'tpu'):
+        # Same pre-im2col bf16 contract as conv2d_a_factor: under the
+        # default precision the covariance rounds to bf16 on the MXU
+        # anyway; casting first halves the patch-tensor HBM traffic.
+        a = a.astype(jnp.bfloat16)
+    patches = extract_conv2d_patches_slices(a, kernel_size, strides,
+                                            padding)
+    b = patches.shape[0]
+    d = patches.shape[-1]
+    reduced = _reduce_shared_axes(patches.reshape(b, -1, d), mean=True)
+    return linear_a_factor(reduced, has_bias,
+                           compute_dtype=compute_dtype)
+
+
+@profiling.scope('kfac/factors/conv2d_g_reduced')
+def conv2d_g_factor_reduced(g: jax.Array,
+                            compute_dtype=None) -> jax.Array:
+    """KFAC-reduce G for a patch-embedding conv: output-grads summed
+    over the ``(OH, OW)`` grid, covariance over the ``B`` rows (the
+    counterpart of :func:`conv2d_a_factor_reduced`; same convention
+    note applies)."""
+    b, c = g.shape[0], g.shape[-1]
+    return linear_g_factor(
+        _reduce_shared_axes(g.reshape(b, -1, c), mean=False),
+        compute_dtype=compute_dtype)
+
+
+@profiling.scope('kfac/factors/embedding_tied_a')
+def embedding_tied_a_diag(g: jax.Array) -> jax.Array:
+    """Diagonal vocab-side contribution of a tied ``Embed.attend`` site.
+
+    The attend call site's exact vocab-side factor is the dense
+    ``cov(dL/dlogits)`` — ``(vocab, vocab)``, which at LM vocabularies
+    would dwarf every other factor in the model. Its DIAGONAL
+    (``E[g_v^2]`` per vocab entry) is the projection that preserves the
+    embedding layer's diagonal-A structure, so the in/out-tied pair
+    keeps ONE factor pair and ONE inverse entry: the combined A is
+    ``onehot-frequency (lookup) + diag cov(attend output-grads)``.
+    Matmul-form mean (see :func:`_column_mean`'s portability note).
+    """
+    g2 = collapse_batch_dims(g)
+    return _column_mean(g2.astype(jnp.float32) ** 2)
 
 
 def extract_conv2d_patches(x: jax.Array,

@@ -585,11 +585,25 @@ class DistributedKFAC:
         cdt = self.kfac.factor_compute_dtype
         captures = subsample_captures(captures,
                                       self.kfac.factor_batch_fraction)
-        return {name: {'A': L.compute_a_factor(spec, captures[name]['a'],
-                                               compute_dtype=cdt),
-                       'G': L.compute_g_factor(spec, captures[name]['g'],
-                                               compute_dtype=cdt)}
-                for name, spec in self.kfac.specs.items()}
+        out = {}
+        for name, spec in self.kfac.specs.items():
+            contrib = {
+                'A': L.compute_a_factor(spec, captures[name]['a'],
+                                        compute_dtype=cdt),
+                'G': L.compute_g_factor(spec, captures[name]['g'],
+                                        compute_dtype=cdt)}
+            extras = L.compute_tied_factor_extras(spec, captures[name],
+                                                  compute_dtype=cdt)
+            if extras is not None:
+                # Tied embedding (attend site): kept as SEPARATE parts
+                # through accumulation/pmean because their world/accum
+                # rescale differs — 'A_g2' is quadratic in the (local-
+                # mean-loss) output grads like 'G'; 'G_a' is
+                # activation-derived like 'A' (L.GRAD_QUADRATIC_KEYS).
+                # _spmd_update_factors folds them in post-scale.
+                contrib.update(extras)
+            out[name] = contrib
+        return out
 
     @profiling.scope('kfac/factors')
     def _spmd_update_factors(self, state, contribs, factor_decay):
@@ -631,6 +645,15 @@ class DistributedKFAC:
         for name in kfac.specs:
             a_new = factor_pmean(contribs[name]['A'])
             g_new = g_scale * factor_pmean(contribs[name]['G'])
+            if 'A_g2' in contribs[name]:
+                # Tied-embedding attend parts: the vocab-side diagonal
+                # is grad-quadratic (g_scale corrects the local-mean-
+                # loss blowup exactly like 'G'); the d-side input
+                # covariance is activation-derived (no rescale, like
+                # 'A'). See L.GRAD_QUADRATIC_KEYS.
+                a_new = a_new + g_scale * factor_pmean(
+                    contribs[name]['A_g2'])
+                g_new = g_new + factor_pmean(contribs[name]['G_a'])
             old = state['factors'][name]
             new_factors[name] = {
                 'A': F.update_running_avg(a_new.astype(old['A'].dtype),
@@ -1566,11 +1589,16 @@ class DistributedKFAC:
             inv_n = 1.0 / grad_accum_steps
             mean = lambda t: jax.tree.map(lambda x: x * inv_n, t)
             # g captures come from the micro-mean loss: accum x larger
-            # than the local-batch-mean-loss g; G is quadratic in g.
+            # than the local-batch-mean-loss g; grad-QUADRATIC contrib
+            # parts ('G', and a tied embedding's 'A_g2' — see
+            # L.GRAD_QUADRATIC_KEYS) get the 1/accum**2 correction;
+            # activation-derived parts ('A', 'G_a') only the mean.
             g_fix = 1.0 / grad_accum_steps ** 2
-            contribs = {name: {'A': c['A'] * inv_n,
-                               'G': g_fix * c['G'] * inv_n}
-                        for name, c in contribs_sum.items()}
+            contribs = {
+                name: {k: (g_fix if k in L.GRAD_QUADRATIC_KEYS
+                           else 1.0) * v * inv_n
+                       for k, v in c.items()}
+                for name, c in contribs_sum.items()}
             updated = ({c: extra_out[c] for c in mutable_cols
                         if c in extra_out} if mutable_cols else {})
             return (mean(loss_sum), mean(extras_sum), mean(grads_sum),

@@ -213,6 +213,36 @@ class KFAC:
         path is pinned on the CPU test backend; on-TPU bit-identity is
         expected — vmap adds a batch dim, it does not reassociate a
         slice's contraction — but remains to be pinned on-chip).
+      kfac_approx: weight-sharing Kronecker approximation policy
+        (arXiv:2311.00636; see ``sharing.approx``). ``'expand'``
+        (default) flattens every layer's shared sequence/patch axis
+        into covariance rows — bit-identical to the pre-sharing code
+        path (test-pinned). ``'reduce'`` engages the automatic
+        by-module-kind policy: sequence/patch-shared Denses (attention
+        q/k/v/o, MLP in/out) and patch-embedding convs reduce over the
+        shared axis BEFORE the covariance (activations averaged,
+        output-grads summed — Eq. 22's bias-column-exactly-1
+        convention), a factor-T cheaper factor update with matching
+        quality on transformer/ViT workloads (PERF.md r13); everything
+        else stays expand. A ``{pattern: 'expand'|'reduce'}`` dict
+        gives explicit per-layer control (loud validation). Factor
+        DIMS are approximation-invariant, so the state layout, KAISA
+        buckets and chunk plans are identical under any setting — the
+        choice is static program structure (zero retraces,
+        test-pinned).
+      tied_embeddings: capture ``Embed.attend`` call sites (the tied
+        in/out decoder, flax's form of the reference
+        register_shared_module pair, preconditioner.py:404-470) so
+        both uses of a tied embedding weight contribute statistics to
+        ONE factor pair with ONE inverse entry: A gains the attend
+        output-grads' diagonal vocab covariance, G the attend inputs'
+        covariance. ``None`` (default) follows the sharing subsystem —
+        on with any non-expand ``kfac_approx``, off under the pure
+        default (which keeps the all-default path bit-identical:
+        lookup-only statistics, the historical behavior where the
+        attend site contributed gradient but no statistics). State
+        layout is unchanged either way (additive statistics only —
+        MIGRATION.md).
       skip_layers: module names/classes to skip (case-insensitive, prunes
         subtrees).
       trainable: optional predicate ``trainable(module_path) -> bool``
@@ -314,6 +344,8 @@ class KFAC:
                  precond_bucketing: bool = True,
                  inv_pipeline_chunks: int = 1,
                  inv_pipeline_costs: dict | None = None,
+                 kfac_approx: Any = 'expand',
+                 tied_embeddings: bool | None = None,
                  skip_layers: str | Sequence[str] | None = None,
                  trainable: Any = None,
                  symmetry_aware_comm: bool = False,
@@ -359,9 +391,45 @@ class KFAC:
             # contraction exists to keep (ADVICE r3: the old gate only
             # matched fp32, leaking bf16 captures under fp64).
             capture_dtype = None
+        # Weight-sharing approximation policy (sharing.approx,
+        # arXiv:2311.00636): 'expand' (default, bit-identical to the
+        # pre-sharing code path), 'reduce' (automatic by-module-kind:
+        # sequence/patch-shared Denses + patch-embed convs reduce over
+        # the shared axis before the covariance — a factor-T cheaper
+        # factor update), or a {pattern: approx} dict for explicit
+        # per-layer control. Resolved per layer at init() and carried
+        # in the LayerSpec registry (static program structure).
+        from distributed_kfac_pytorch_tpu.sharing import approx as _approx
+        if isinstance(kfac_approx, str) or kfac_approx is None:
+            if kfac_approx not in (None, 'expand', 'reduce'):
+                raise ValueError(
+                    f"kfac_approx must be 'expand', 'reduce' or a "
+                    f'{{pattern: approx}} dict, got {kfac_approx!r}')
+        elif not isinstance(kfac_approx, dict):
+            raise ValueError(
+                f"kfac_approx must be 'expand', 'reduce' or a dict, "
+                f'got {type(kfac_approx).__name__}')
+        self.kfac_approx = kfac_approx if kfac_approx is not None \
+            else 'expand'
+        self._approx_mod = _approx
+        # Tied-embedding handling (one factor pair + one inverse for an
+        # in/out-tied Embed; the attend call site's statistics join the
+        # lookup's). None follows the sharing subsystem: engaged when
+        # the setting actually names 'reduce' anywhere, off otherwise —
+        # so 'expand', AND an all-expand dict like {'embed': 'expand'},
+        # keep the bit-identical pre-sharing path (an explicit
+        # per-layer pin must not silently change the capture program).
+        if tied_embeddings is None:
+            if isinstance(self.kfac_approx, dict):
+                tied_embeddings = any(v == 'reduce'
+                                      for v in self.kfac_approx.values())
+            else:
+                tied_embeddings = self.kfac_approx == 'reduce'
+        self.tied_embeddings = bool(tied_embeddings)
         self.capture = KFACCapture(model, skip_layers=skip_layers,
                                    capture_dtype=capture_dtype,
-                                   trainable=trainable)
+                                   trainable=trainable,
+                                   tied_embeddings=self.tied_embeddings)
         self.model = model
         self.damping = damping
         self.factor_decay = factor_decay
@@ -432,6 +500,7 @@ class KFAC:
                   'factor_compute_dtype', 'inv_dtype',
                   'precond_compute_dtype', 'precond_bucketing',
                   'inv_pipeline_chunks',
+                  'kfac_approx', 'tied_embeddings',
                   'symmetry_aware_comm',
                   'assignment_strategy', 'comm_method',
                   'grad_worker_fraction', 'collect_metrics',
@@ -591,11 +660,21 @@ class KFAC:
         variables, specs = self.capture.init(rng, *args,
                                              init_model=init_model,
                                              **kwargs)
-        self._specs = specs
+        # Resolve the weight-sharing approximation per layer and bake
+        # it into the registry (sharing.annotate_specs) — after this,
+        # every factor-math consumer reads spec.kfac_approx. The
+        # capture object keeps its own unannotated copy (it only needs
+        # call/tied counts for pairing).
+        self._specs = self._approx_mod.annotate_specs(specs,
+                                                      self.kfac_approx)
+        specs = self._specs
         if self.verbose:
             for name, spec in specs.items():
                 print(f'Registered {name}: {spec.kind} '
-                      f'(bias={spec.has_bias}, calls={spec.num_calls})')
+                      f'(bias={spec.has_bias}, calls={spec.num_calls}, '
+                      f'approx={spec.kfac_approx}'
+                      + (f', tied_calls={spec.tied_calls}'
+                         if spec.tied_calls else '') + ')')
             for name, reason in self.capture.skipped_modules.items():
                 print(f'Skipped {name}: {reason}')
         state = self.init_state(variables['params'])
@@ -606,6 +685,16 @@ class KFAC:
         if self._specs is None:
             raise ValueError('call init() first')
         return self._specs
+
+    def approx_summary(self) -> dict[str, str]:
+        """{layer name: resolved approx} for run provenance.
+
+        The per-layer map the observability meta records (the JSONL
+        ``kind='meta'`` record the CLIs append after registration) —
+        tied registrations are labeled ``<approx>+tied``. See
+        ``sharing.approx_summary``.
+        """
+        return self._approx_mod.approx_summary(self.specs)
 
     def init_state(self, params) -> dict:
         """Fresh K-FAC state pytree for the registered layers.
@@ -740,6 +829,14 @@ class KFAC:
                                        compute_dtype=cdt)
             g_new = L.compute_g_factor(spec, captures[name]['g'],
                                        compute_dtype=cdt)
+            extras = L.compute_tied_factor_extras(spec, captures[name],
+                                                  compute_dtype=cdt)
+            if extras is not None:
+                # Tied embedding: the attend call site folds into the
+                # SAME factor pair (single-chip captures are global, so
+                # no world rescale — cf. the SPMD path's g_scale).
+                a_new = a_new + extras['A_g2']
+                g_new = g_new + extras['G_a']
             old = state['factors'][name]
             a_new = a_new.astype(old['A'].dtype)
             g_new = g_new.astype(old['G'].dtype)

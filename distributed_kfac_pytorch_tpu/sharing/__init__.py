@@ -1,0 +1,23 @@
+"""KFAC-expand/reduce weight-sharing approximations (arXiv:2311.00636).
+
+Policy layer for transformer/ViT preconditioning: which registered
+layer treats its sequence/patch axis as extra batch (expand, the
+exact-parity default) vs reducing over it before the covariance
+(reduce, a factor-T cheaper statistic). See ``sharing.approx``.
+"""
+
+from distributed_kfac_pytorch_tpu.sharing.approx import (
+    annotate_specs,
+    approx_summary,
+    is_patch_conv,
+    layer_is_shared,
+    resolve_approx,
+)
+
+__all__ = [
+    'annotate_specs',
+    'approx_summary',
+    'is_patch_conv',
+    'layer_is_shared',
+    'resolve_approx',
+]

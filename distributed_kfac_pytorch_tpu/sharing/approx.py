@@ -1,0 +1,181 @@
+"""Weight-sharing Kronecker approximation policy (KFAC-expand/reduce).
+
+*K-FAC for Modern Neural Network Architectures* (arXiv:2311.00636)
+formalizes the choice a weight-shared layer (one whose weight sees
+every sequence position / image patch of each example) forces on any
+Kronecker factorization:
+
+  - **expand** — treat every shared-axis position as an independent
+    covariance row: flatten ``(B, T, d)`` to ``B*T`` rows. This is this
+    repo's historical ``collapse_batch_dims`` behavior and the
+    exact-parity default (all-expand is bit-identical to the
+    pre-sharing code path, test-pinned).
+  - **reduce** — reduce over the shared axis BEFORE the covariance:
+    activations are *averaged* and output-grads *summed* over T, so the
+    factor contraction sees ``B`` rows. The mean/sum split is the
+    paper's Eq. 22 convention — with mean-reduced activations the
+    appended bias column stays exactly 1, and the summed grads keep the
+    bias gradient ``sum_t g_t`` exact. A factor ``T`` cheaper per
+    factor update, and exact whenever activations are constant across
+    the shared axis (pinned against a dense-Fisher oracle in
+    tests/test_sharing.py).
+
+This module is pure host-side policy: which registered layer gets which
+approximation. The resolved choice is carried in the capture registry
+(``capture.LayerSpec.kfac_approx``) so the factor math
+(``layers.compute_a_factor`` / ``compute_g_factor``) dispatches on the
+spec alone — static program structure, zero retraces, and the
+single-chip and SPMD paths cannot drift (both read the same specs).
+
+Setting grammar (``KFAC(kfac_approx=...)``):
+
+  - ``'expand'`` (default): every layer expand — bit-identical.
+  - ``'reduce'``: the automatic by-module-kind policy — reduce for
+    sequence/patch-shared Denses (attention q/k/v/o, MLP in/out — any
+    Dense registered with a >2-D input) and for patch-embedding convs
+    (stride == kernel, zero padding: the ViT signature, the paper's ViT
+    treatment); expand everywhere else (embeddings, grouped convs,
+    overlapping convs, 2-D-input Denses — where reduce is either
+    undefined or degenerate).
+  - ``{pattern: 'expand' | 'reduce'}``: explicit per-layer control.
+    A pattern matches a layer when it equals the layer name or is a
+    substring of it (the ``skip_layers`` matching idiom); unmatched
+    layers stay expand. A pattern that matches nothing, or forces
+    reduce onto a kind without a reduce path, raises at init — silence
+    here would hide a mis-preconditioned model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from distributed_kfac_pytorch_tpu.capture import (
+    CONV2D,
+    KFAC_APPROXES,
+    KFAC_EXPAND,
+    KFAC_REDUCE,
+    LINEAR,
+    LayerSpec,
+)
+
+
+def is_patch_conv(spec: LayerSpec) -> bool:
+    """True for a non-overlapping patch-embedding conv (stride ==
+    kernel, zero padding — the ViT ``patch_embed`` signature).
+
+    Only this conv family gets the automatic reduce treatment: its
+    "shared axis" is the clean set of disjoint patches the paper's ViT
+    experiments reduce over. Overlapping convs keep the reference
+    conv2d factor convention (their spatial sharing is already folded
+    into that math's normalization).
+    """
+    if spec.kind != CONV2D or spec.kernel_size is None:
+        return False
+    if tuple(spec.strides or ()) != tuple(spec.kernel_size):
+        return False
+    pad = spec.padding
+    if pad == 'VALID':
+        return True
+    if isinstance(pad, str):
+        return False
+    try:
+        return all(int(lo) == 0 and int(hi) == 0 for lo, hi in pad)
+    except (TypeError, ValueError):
+        return False
+
+
+def layer_is_shared(spec: LayerSpec) -> bool:
+    """Does this layer's weight see multiple shared-axis positions?
+
+    The automatic policy's eligibility test: a Dense registered with a
+    sequence/patch axis (>2-D input at registration), or a
+    patch-embedding conv. Reduce degenerates to expand at T=1, so
+    non-shared layers simply have nothing to gain.
+    """
+    if spec.kind == LINEAR:
+        return spec.shared_positions > 1
+    return is_patch_conv(spec)
+
+
+def _supports_reduce(spec: LayerSpec) -> bool:
+    """Kinds with an implemented reduce path (dense + patch conv)."""
+    return spec.kind == LINEAR or is_patch_conv(spec)
+
+
+def resolve_approx(setting, specs: dict[str, LayerSpec]
+                   ) -> dict[str, str]:
+    """Per-layer approximation map for a registered spec dict.
+
+    ``setting`` follows the module-docstring grammar. Deterministic
+    (registration order), host-side, and validated loudly: every trace
+    — and the single-chip vs SPMD paths — sees the identical map.
+    """
+    if setting is None:
+        setting = KFAC_EXPAND
+    if isinstance(setting, str):
+        if setting not in KFAC_APPROXES:
+            raise ValueError(
+                f'kfac_approx={setting!r}: expected one of '
+                f'{KFAC_APPROXES} or a {{pattern: approx}} dict')
+        if setting == KFAC_EXPAND:
+            return {name: KFAC_EXPAND for name in specs}
+        # 'reduce': the automatic by-module-kind policy.
+        return {name: (KFAC_REDUCE if layer_is_shared(spec)
+                       else KFAC_EXPAND)
+                for name, spec in specs.items()}
+    if not isinstance(setting, dict):
+        raise ValueError(
+            f'kfac_approx must be a string or dict, got '
+            f'{type(setting).__name__}')
+    out = {name: KFAC_EXPAND for name in specs}
+    for pattern, approx in setting.items():
+        if approx not in KFAC_APPROXES:
+            raise ValueError(
+                f'kfac_approx[{pattern!r}]={approx!r}: expected one of '
+                f'{KFAC_APPROXES}')
+        matched = [name for name in specs
+                   if pattern == name or pattern in name]
+        if not matched:
+            raise ValueError(
+                f'kfac_approx pattern {pattern!r} matches no registered '
+                f'layer (have {sorted(specs)})')
+        for name in matched:
+            if approx == KFAC_REDUCE and not _supports_reduce(
+                    specs[name]):
+                raise ValueError(
+                    f'kfac_approx[{pattern!r}]=reduce: layer {name!r} '
+                    f'(kind {specs[name].kind!r}) has no reduce path — '
+                    'reduce is defined for Dense layers and '
+                    'non-overlapping patch-embedding convs')
+            out[name] = approx
+    return out
+
+
+def annotate_specs(specs: dict[str, LayerSpec], setting
+                   ) -> dict[str, LayerSpec]:
+    """Rebuild a spec dict with each layer's resolved ``kfac_approx``.
+
+    The one mutation point of the registry: after this, every consumer
+    (factor math, observability meta, repr) reads the spec field.
+    """
+    resolved = resolve_approx(setting, specs)
+    return {name: (spec if spec.kfac_approx == resolved[name]
+                   else dataclasses.replace(
+                       spec, kfac_approx=resolved[name]))
+            for name, spec in specs.items()}
+
+
+def approx_summary(specs: dict[str, LayerSpec]) -> dict[str, str]:
+    """{layer name: approx} for the metrics meta / run provenance.
+
+    Tied-embedding registrations are labeled ``expand+tied`` so the
+    recorded meta distinguishes a lookup-only embedding from the
+    in/out-tied pair sharing one factor pair.
+    """
+    out = {}
+    for name, spec in specs.items():
+        label = spec.kfac_approx
+        if spec.tied_calls:
+            label += '+tied'
+        out[name] = label
+    return out

@@ -88,6 +88,13 @@ class OptimConfig:
     # decomposition spike across the window (step-time uniformity).
     # 1 (default) = reference parity, monolithic firing, bit-identical.
     inv_pipeline_chunks: int = 1
+    # Weight-sharing Kronecker approximation (r13, arXiv:2311.00636):
+    # 'expand' (default — bit-identical pre-sharing path) or 'reduce'
+    # (sequence/patch-shared Denses + patch-embed convs reduce over the
+    # shared axis before the covariance: a factor-T cheaper factor
+    # update; tied in/out embeddings then also share one factor pair).
+    # See KFAC.kfac_approx / sharing.approx.
+    kfac_approx: str = 'expand'
     # r7 observability: carry an on-device K-FAC metrics pytree in the
     # state (damping, KL-clip nu, grad/precond norms, firing counts —
     # see observability.metrics). Off (default) = bit-identical step.
@@ -122,6 +129,7 @@ TUNABLE_FIELDS = (
     'kfac_cov_update_freq',
     'kfac_inv_update_freq',
     'eigh_polish_iters',
+    'kfac_approx',
 )
 
 
@@ -211,6 +219,7 @@ def get_optimizer(model, cfg: OptimConfig):
             precond_compute_dtype=(jnp.bfloat16 if cfg.bf16_precond
                                    else None),
             inv_pipeline_chunks=cfg.inv_pipeline_chunks,
+            kfac_approx=cfg.kfac_approx,
             skip_layers=list(cfg.skip_layers) or None,
             symmetry_aware_comm=cfg.symmetry_aware_comm,
             comm_method=COMM_METHODS[cfg.comm_method.lower()],

@@ -96,6 +96,15 @@ def parse_args(argv=None):
                         'divide --kfac-update-freq and not exceed the '
                         "model's inverse bucket count")
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
+    p.add_argument('--kfac-approx', default='expand',
+                   choices=['expand', 'reduce'],
+                   help='weight-sharing Kronecker approximation (r13, '
+                        'arXiv:2311.00636): expand (default) is the '
+                        'bit-identical historical path; reduce '
+                        'collapses the shared patch axis before the '
+                        'covariance — the paper\'s ViT treatment '
+                        '(patch-embed conv + every encoder Dense); a '
+                        'no-op for plain conv nets')
     p.add_argument('--kfac-update-freq-alpha', type=float, default=10)
     p.add_argument('--kfac-update-freq-decay', type=int, nargs='+',
                    default=[])
@@ -192,6 +201,7 @@ def main(argv=None):
         kfac_inv_update_freq=args.kfac_update_freq,
         kfac_cov_update_freq=args.kfac_cov_update_freq,
         inv_pipeline_chunks=args.inv_pipeline_chunks,
+        kfac_approx=args.kfac_approx,
         damping=args.damping, factor_decay=args.stat_decay,
         # Default (flag absent) -> None -> the per-dim 'auto' dispatch;
         # identical to eigen at CIFAR factor dims (all <= 577 < cutoff).
@@ -235,6 +245,7 @@ def main(argv=None):
     x0 = jnp.zeros((2, 32, 32, 3), jnp.float32)
     if kfac is not None:
         variables, _ = kfac.init(jax.random.PRNGKey(args.seed), x0)
+        obs.cli.emit_layer_meta(metrics_sink, kfac)
     else:
         variables = model.init(jax.random.PRNGKey(args.seed), x0)
     params = variables['params']

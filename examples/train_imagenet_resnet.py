@@ -104,6 +104,15 @@ def parse_args(argv=None):
                         'divide --kfac-update-freq and not exceed the '
                         "model's inverse bucket count")
     p.add_argument('--kfac-cov-update-freq', type=int, default=10)
+    p.add_argument('--kfac-approx', default='expand',
+                   choices=['expand', 'reduce'],
+                   help='weight-sharing Kronecker approximation (r13, '
+                        'arXiv:2311.00636): expand (default) is the '
+                        'bit-identical historical path; reduce '
+                        'collapses the shared patch axis before the '
+                        'covariance — the paper\'s ViT treatment '
+                        '(patch-embed conv + every encoder Dense); a '
+                        'no-op for plain conv nets')
     p.add_argument('--kfac-update-freq-alpha', type=float, default=10)
     p.add_argument('--kfac-update-freq-decay', type=int, nargs='+',
                    default=[])
@@ -253,6 +262,7 @@ def main(argv=None):
         kfac_inv_update_freq=args.kfac_update_freq,
         kfac_cov_update_freq=args.kfac_cov_update_freq,
         inv_pipeline_chunks=args.inv_pipeline_chunks,
+        kfac_approx=args.kfac_approx,
         damping=args.damping, factor_decay=args.stat_decay,
         kl_clip=args.kl_clip, inverse_method=args.inverse_method,
         eigh_method=args.eigh_method,
@@ -294,6 +304,7 @@ def main(argv=None):
     x0 = jnp.zeros((2, args.image_size, args.image_size, 3), jnp.float32)
     if kfac is not None:
         variables, _ = kfac.init(jax.random.PRNGKey(args.seed), x0)
+        obs.cli.emit_layer_meta(metrics_sink, kfac)
     else:
         variables = model.init(jax.random.PRNGKey(args.seed), x0)
     params = variables['params']

@@ -107,6 +107,18 @@ def parse_args(argv=None):
                         'divide --kfac-update-freq and not exceed the '
                         "model's inverse bucket count")
     p.add_argument('--kfac-cov-update-freq', type=int, default=1)
+    p.add_argument('--kfac-approx', default='expand',
+                   choices=['expand', 'reduce'],
+                   help='weight-sharing Kronecker approximation '
+                        '(r13, arXiv:2311.00636): expand (default) '
+                        'flattens the sequence axis into covariance '
+                        'rows — the bit-identical historical path; '
+                        'reduce averages activations / sums grads '
+                        'over it first — a factor-seq cheaper factor '
+                        'update on every attention/MLP Dense, with '
+                        'tied in/out embeddings sharing one factor '
+                        'pair (see README "Transformer & ViT '
+                        'preconditioning")')
     p.add_argument('--inverse-method', default='auto',
                    choices=['auto', 'eigen', 'cholesky', 'newton'],
                    help='auto = per-dim dispatch: eigen below the '
@@ -233,6 +245,7 @@ def main(argv=None):
         kfac_inv_update_freq=args.kfac_update_freq,
         kfac_cov_update_freq=args.kfac_cov_update_freq,
         inv_pipeline_chunks=args.inv_pipeline_chunks,
+        kfac_approx=args.kfac_approx,
         damping=args.damping, factor_decay=args.stat_decay,
         kl_clip=args.kl_clip, inverse_method=args.inverse_method,
         eigh_method=args.eigh_method,
@@ -286,6 +299,10 @@ def main(argv=None):
     if kfac is not None:
         variables, _ = kfac.init(jax.random.PRNGKey(args.seed), ids0,
                                  train=False, init_model=twin)
+        # Registry provenance (r13): the per-layer resolved approx map
+        # rides as a meta record so the recorded run says which layers
+        # actually ran reduce/tied (asserted by sharing_smoke.sh).
+        obs.cli.emit_layer_meta(metrics_sink, kfac)
     else:
         variables = model.init(jax.random.PRNGKey(args.seed), ids0,
                                train=False)

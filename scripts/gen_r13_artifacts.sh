@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# r13 artifact generation (CPU provenance — see PERF.md r13): the
+# d512->d2048 expand/reduce ladder evidence. Rung sizes shrink with d
+# so the single-core CPU run stays bounded; every JSONL row records
+# its own config, so mixed-rung files are self-describing. Rerun on
+# v5e with the full sizes before promoting a default (decision rule:
+# PERF.md r13).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+Q=FLAGSHIP_LM_r13_APPROX.jsonl
+C=BENCH_r13_APPROX_COST.jsonl
+: > "$Q.tmp"; : > "$C.tmp"
+
+# Quality ladder: expand vs reduce vs SGD loss curves per rung.
+JAX_PLATFORMS=cpu python benchmarks/flagship_lm.py --approx-ab \
+    --ladder 512 --ab-steps 48 --ab-seq 64 --ab-batch 8 \
+    --ab-vocab 512 --ab-layers 2 >> "$Q.tmp"
+JAX_PLATFORMS=cpu python benchmarks/flagship_lm.py --approx-ab \
+    --ladder 1024 --ab-steps 32 --ab-seq 64 --ab-batch 4 \
+    --ab-vocab 512 --ab-layers 2 >> "$Q.tmp"
+JAX_PLATFORMS=cpu python benchmarks/flagship_lm.py --approx-ab \
+    --ladder 2048 --ab-steps 12 --ab-seq 32 --ab-batch 2 \
+    --ab-vocab 256 --ab-layers 1 --ab-f 2 --ab-i 12 >> "$Q.tmp"
+
+# Factor-update cost rows: the ~T x reduce claim, per rung.
+JAX_PLATFORMS=cpu python benchmarks/step_breakdown.py --lm-approx \
+    --lm-d 512 1024 --lm-seq 128 --lm-batch 4 --lm-vocab 512 \
+    --iters 4 >> "$C.tmp"
+JAX_PLATFORMS=cpu python benchmarks/step_breakdown.py --lm-approx \
+    --lm-d 2048 --lm-seq 64 --lm-batch 2 --lm-vocab 256 \
+    --iters 2 >> "$C.tmp"
+
+mv "$Q.tmp" "$Q"; mv "$C.tmp" "$C"
+echo "r13 artifacts written: $Q $C"

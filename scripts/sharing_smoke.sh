@@ -1,0 +1,74 @@
+#!/usr/bin/env bash
+# Fast-tier sharing smoke (r13): the expand/reduce subsystem end to end
+# on CPU through the REAL LM entry point —
+#   1. one tiny synthetic-corpus epoch per approximation (d64
+#      transformer, --kfac-approx expand | reduce) with the metrics
+#      sink on;
+#   2. assert the per-layer resolved approx landed in the stream's
+#      kind='meta' records (the registry provenance emit_layer_meta
+#      appends after registration) — expand everywhere on the expand
+#      leg, reduce on every attention/MLP Dense (+ tied embedding) on
+#      the reduce leg;
+#   3. observability-gate self-check over the reduce leg's stream
+#      (write a baseline from it, re-gate against itself) — the CI
+#      plumbing path, like autotune_smoke.sh's leg 4.
+# The same checks run in the suite as tests/test_sharing.py; this
+# wrapper is the standalone/CI-pipeline form (see autotune_smoke.sh).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="$(mktemp -d)"
+trap 'rm -rf "$out"' EXIT
+
+run_lm() {  # $1 = approx, $2 = metrics path
+    JAX_PLATFORMS=cpu KFAC_COMPILE_CACHE=0 KFAC_SYNTHETIC_LM=2048 \
+    python examples/train_language_model.py \
+        --arch transformer --emsize 64 --nlayers 1 --nheads 2 \
+        --bptt 16 --batch-size 4 --epochs 1 --tied \
+        --kfac-update-freq 4 --no-resume \
+        --log-dir "$out/logs-$1" --checkpoint-dir "$out/ckpt-$1" \
+        --kfac-metrics "$2" --metrics-interval 1 \
+        --kfac-approx "$1"
+}
+
+run_lm expand "$out/expand.jsonl"
+run_lm reduce "$out/reduce.jsonl"
+
+python - "$out/expand.jsonl" "$out/reduce.jsonl" <<'EOF'
+import sys
+from distributed_kfac_pytorch_tpu.observability import sink as obs_sink
+
+def layer_meta(path):
+    records, _ = obs_sink.read_jsonl_tolerant(path)
+    for r in records:
+        if r.get('kind') == 'meta' and 'kfac_approx' in r.get('meta', {}):
+            return r['meta']
+    raise SystemExit(f'{path}: no kfac_approx meta record')
+
+m = layer_meta(sys.argv[1])
+assert m['kfac_approx_setting'] == 'expand', m
+assert set(m['kfac_approx'].values()) == {'expand'}, m['kfac_approx']
+
+m = layer_meta(sys.argv[2])
+assert m['kfac_approx_setting'] == 'reduce', m
+per = m['kfac_approx']
+assert per['block0/attn/q_proj'] == 'reduce', per
+assert per['block0/mlp_in'] == 'reduce', per
+assert per['embed'] == 'expand+tied', per
+assert m['tied_embeddings'] is True, m
+print('per-layer approx meta OK')
+EOF
+
+# Gate self-check: the reduce leg's stream gates green against itself.
+python -m distributed_kfac_pytorch_tpu.observability.gate \
+    "$out/reduce.jsonl" --write-baseline "$out/B.json"
+python -m distributed_kfac_pytorch_tpu.observability.gate \
+    "$out/reduce.jsonl" --baseline "$out/B.json" --allow-missing \
+    --json > "$out/gate.json"
+python - "$out/gate.json" <<'EOF'
+import json, sys
+v = json.load(open(sys.argv[1]))
+assert v['pass'] is True, v
+print('gate self-check OK')
+EOF
+echo "sharing smoke OK"

@@ -58,7 +58,8 @@ def test_space_enumeration_respects_constraints():
     space = at_space.default_space(
         {'inv_pipeline_chunks': [1, 2, 3],
          'factor_batch_fraction': [1.0],
-         'kfac_cov_update_freq': [1]})
+         'kfac_cov_update_freq': [1],
+         'kfac_approx': ['expand']})
     base = _base_knobs()  # inv freq 4: chunks 3 cannot divide
     cands = space.enumerate(base)
     assert all(c['inv_pipeline_chunks'] in (1, 2) for c in cands)
@@ -426,7 +427,8 @@ def test_driver_halving_commits_full_length_winner(tmp_path,
         space_overrides={'bf16_precond': [False, True],
                          'factor_batch_fraction': [1.0],
                          'kfac_cov_update_freq': [1],
-                         'inv_pipeline_chunks': [1]},
+                         'inv_pipeline_chunks': [1],
+                         'kfac_approx': ['expand']},
         mesh=_one_dev_mesh(), self_check=True, self_check_tol=0.5,
         log=lambda *a: None)
     # The halving survivor (bf16=False, which won its short rungs) was
@@ -438,7 +440,8 @@ def test_driver_halving_commits_full_length_winner(tmp_path,
     assert artifact['best_metrics']['step_p50_ms'] == 20.0
     # The nominee's full-length probe actually ran.
     assert ({'bf16_precond': False, 'factor_batch_fraction': 1.0,
-             'kfac_cov_update_freq': 1, 'inv_pipeline_chunks': 1},
+             'kfac_cov_update_freq': 1, 'inv_pipeline_chunks': 1,
+             'kfac_approx': 'expand'},
             8) in probed
     # Short-rung rows survive in the table as provenance, with their
     # n_steps making them self-describing.

@@ -30,6 +30,32 @@ class SharedTower(nn.Module):
         return shared(a).sum(-1) - shared(b).sum(-1)
 
 
+class SharedSeqTower(nn.Module):
+    """Siamese sharing over SEQUENCE-valued inputs ``(B, T, d)`` — the
+    r13 fixture combining both sharing axes at once: the Dense is
+    multi-call (two call sites, LinearMultiLayer semantics) AND each
+    call is sequence-shared (the kfac_approx expand/reduce choice).
+    Used by tests/test_sharing.py."""
+
+    @nn.compact
+    def __call__(self, pair):
+        shared = nn.Dense(6, name='shared')
+        a, b = pair
+        return shared(a).sum((-2, -1)) - shared(b).sum((-2, -1))
+
+
+class TiedLM(nn.Module):
+    """Embed + attend tied decoder (the register_shared_module pair in
+    flax form) — shared by the tied-registration pin below and the r13
+    tied-statistics tests in tests/test_sharing.py."""
+
+    @nn.compact
+    def __call__(self, ids):
+        embed = nn.Embed(17, 8, name='embed')
+        x = embed(ids)
+        return embed.attend(x)
+
+
 def test_shared_module_registers_two_calls_and_sums_factors():
     model = SharedTower()
     kfac = KFAC(model, factor_update_freq=1, inv_update_freq=1,
@@ -63,13 +89,6 @@ def test_shared_module_registers_two_calls_and_sums_factors():
 def test_tied_embedding_decoder_single_registration():
     """Embed + attend decoder: one embedding registration, grads flow
     through both uses, step stays finite."""
-    class TiedLM(nn.Module):
-        @nn.compact
-        def __call__(self, ids):
-            embed = nn.Embed(17, 8, name='embed')
-            x = embed(ids)
-            return embed.attend(x)
-
     model = TiedLM()
     kfac = KFAC(model, factor_update_freq=1, inv_update_freq=1,
                 damping=0.01)

@@ -1,0 +1,538 @@
+"""KFAC-expand/reduce weight-sharing approximations (r13).
+
+Pins the sharing subsystem's contracts (ISSUE r13, arXiv:2311.00636):
+
+  - all-expand (the default) is BIT-IDENTICAL to the historical
+    flatten path — per-step losses pinned single-chip and 8-dev SPMD;
+  - reduce matches a dense-Fisher oracle on a tiny weight-shared MLP
+    (exact where the approximation is exact: T-constant activations),
+    and the hand-computed Eq. 22 convention in general (activation
+    mean / grad sum, bias column exactly 1);
+  - tied embeddings (Embed.attend) keep ONE factor pair and ONE
+    inverse entry, with both call sites' statistics summed in;
+  - an 8-dev SPMD HYBRID (KAISA) mesh reproduces the single-chip
+    factors for a reduce attention block, with the attention
+    projections living in the ordinary row-sharded buckets;
+  - mixing expand/reduce layers in one model keeps the variant cache's
+    zero-retrace contract (approx is static program structure).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_kfac_pytorch_tpu import KFAC, CommMethod, sharing
+from distributed_kfac_pytorch_tpu import layers as L
+from distributed_kfac_pytorch_tpu.capture import (
+    KFAC_REDUCE,
+    subsample_captures,
+)
+from distributed_kfac_pytorch_tpu.models import transformer_lm, vit
+from distributed_kfac_pytorch_tpu.ops import factors as F
+from distributed_kfac_pytorch_tpu.parallel import distributed as D
+from tests.test_shared_weights import SharedSeqTower, TiedLM
+
+
+def _tiny_lm(vocab=37, d=16, layers=1, heads=2, seq=8, tied=True):
+    return transformer_lm.TransformerLM(
+        vocab_size=vocab, d_model=d, num_layers=layers,
+        num_heads=heads, max_len=seq, dropout=0.0, tie_weights=tied)
+
+
+def _lm_batch(vocab=37, b=4, seq=8, seed=0):
+    r = np.random.RandomState(seed)
+    return (jnp.asarray(r.randint(0, vocab, (b, seq))),
+            jnp.asarray(r.randint(0, vocab, (b, seq))))
+
+
+# ---------------------------------------------------------------------------
+# Reduce math vs hand-computed convention + dense-Fisher oracle
+# ---------------------------------------------------------------------------
+
+def test_reduce_factors_match_eq22_convention():
+    """A-reduce = cov of sequence-MEAN rows with a bias column of
+    exactly 1; G-reduce = cov of sequence-SUM rows."""
+    r = np.random.RandomState(0)
+    a = jnp.asarray(r.randn(4, 6, 5), jnp.float32)
+    g = jnp.asarray(r.randn(4, 6, 3), jnp.float32)
+    abar = np.asarray(a).mean(1)
+    rows = np.concatenate([abar, np.ones((4, 1))], 1)
+    np.testing.assert_allclose(
+        np.asarray(F.linear_a_factor_reduced(a, True)),
+        rows.T @ rows / 4, rtol=1e-5, atol=1e-6)
+    ghat = np.asarray(g).sum(1)
+    np.testing.assert_allclose(
+        np.asarray(F.linear_g_factor_reduced(g)),
+        ghat.T @ ghat / 4, rtol=1e-5, atol=1e-6)
+
+
+def test_reduce_equals_expand_at_t1_bitwise_linear():
+    r = np.random.RandomState(1)
+    a = jnp.asarray(r.randn(6, 1, 5), jnp.float32)
+    g = jnp.asarray(r.randn(6, 1, 4), jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(F.linear_a_factor_reduced(a, True)),
+        np.asarray(F.linear_a_factor(a, True)))
+    np.testing.assert_array_equal(
+        np.asarray(F.linear_g_factor_reduced(g)),
+        np.asarray(F.linear_g_factor(g)))
+
+
+class SharedMLP(nn.Module):
+    """One Dense applied across a shared sequence axis."""
+    features: int = 3
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.features, name='shared')(x)
+
+
+def test_reduce_matches_dense_fisher_oracle():
+    """Where reduce is exact (activations constant across the shared
+    axis, B=1), its Kronecker product equals the empirical dense
+    Fisher of the weight-shared layer."""
+    model = SharedMLP()
+    kfac = KFAC(model, factor_update_freq=1, inv_update_freq=1,
+                damping=0.0, kl_clip=None,
+                kfac_approx={'shared': 'reduce'})
+    r = np.random.RandomState(2)
+    # B=1, T=5, activations CONSTANT across T (broadcast one row).
+    x = jnp.asarray(np.broadcast_to(r.randn(1, 1, 4), (1, 5, 4)),
+                    jnp.float32)
+    y = jnp.asarray(r.randn(1, 5, 3), jnp.float32)
+    variables, state = kfac.init(jax.random.PRNGKey(0), x)
+    assert kfac.specs['shared'].kfac_approx == KFAC_REDUCE
+    assert kfac.specs['shared'].shared_positions == 5
+
+    def loss_fn(out):
+        return ((out - y) ** 2).sum()
+
+    _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+        loss_fn, variables['params'], x)
+    spec = kfac.specs['shared']
+    a_fac = np.asarray(L.compute_a_factor(spec, captures['shared']['a']))
+    g_fac = np.asarray(L.compute_g_factor(spec, captures['shared']['g']))
+    # Dense empirical Fisher of the single sample: vec(dW) vec(dW)^T
+    # in the (out, in+1) matrix basis the preconditioner uses.
+    gmat = np.asarray(L.grads_to_matrix(spec, grads['shared']))
+    fisher = np.outer(gmat.reshape(-1), gmat.reshape(-1))
+    kron = np.kron(g_fac, a_fac)  # vec over (out, in+1) row-major
+    np.testing.assert_allclose(kron, fisher, rtol=1e-4, atol=1e-5)
+
+
+def test_reduce_conv_patch_embed_matches_expand_at_one_patch():
+    """ViT patch-embed parity rung: a patch conv whose output grid is a
+    single position — reduce and expand coincide (the expand leg IS
+    the unchanged historical conv2d path)."""
+    r = np.random.RandomState(3)
+    x = jnp.asarray(r.randn(3, 4, 4, 2), jnp.float32)
+    e = F.conv2d_a_factor(x, (4, 4), (4, 4), 'VALID', True)
+    red = F.conv2d_a_factor_reduced(x, (4, 4), (4, 4), 'VALID', True)
+    np.testing.assert_allclose(np.asarray(red), np.asarray(e),
+                               rtol=1e-5, atol=1e-6)
+    g = jnp.asarray(r.randn(3, 1, 1, 5), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(F.conv2d_g_factor_reduced(g)),
+        np.asarray(F.conv2d_g_factor(g)), rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Policy resolution
+# ---------------------------------------------------------------------------
+
+def test_auto_policy_reduces_shared_denses_and_patch_embed():
+    model = vit.VisionTransformer(num_classes=5, patch_size=4,
+                                  d_model=16, num_layers=1,
+                                  num_heads=2, dropout=0.0)
+    kfac = KFAC(model, kfac_approx='reduce')
+    x = jnp.zeros((2, 8, 8, 3), jnp.float32)
+    kfac.init(jax.random.PRNGKey(0), x, train=False)
+    summary = kfac.approx_summary()
+    # Patch-embed conv + every encoder Dense reduce; the classifier
+    # head sees a 2-D (pooled) input -> expand.
+    assert summary['patch_embed'] == 'reduce'
+    assert summary['block0/attn/q_proj'] == 'reduce'
+    assert summary['block0/mlp_in'] == 'reduce'
+    assert summary['head'] == 'expand'
+    assert sharing.is_patch_conv(kfac.specs['patch_embed'])
+
+
+def test_all_expand_is_the_default_and_annotates_nothing():
+    model = _tiny_lm()
+    kfac = KFAC(model)
+    ids, _ = _lm_batch()
+    kfac.init(jax.random.PRNGKey(0), ids, train=False)
+    assert set(kfac.approx_summary().values()) == {'expand'}
+    assert kfac.tied_embeddings is False
+
+
+def test_dict_setting_validation():
+    model = _tiny_lm()
+    kfac = KFAC(model, kfac_approx={'nope': 'reduce'})
+    ids, _ = _lm_batch()
+    with pytest.raises(ValueError, match='matches no registered'):
+        kfac.init(jax.random.PRNGKey(0), ids, train=False)
+    kfac = KFAC(model, kfac_approx={'embed': 'reduce'})
+    with pytest.raises(ValueError, match='no reduce path'):
+        kfac.init(jax.random.PRNGKey(0), ids, train=False)
+    with pytest.raises(ValueError, match='kfac_approx'):
+        KFAC(model, kfac_approx='bogus')
+
+
+# ---------------------------------------------------------------------------
+# Default-path bit-identity (all-expand == pre-sharing behavior)
+# ---------------------------------------------------------------------------
+
+def _run_steps(kfac, n=4):
+    ids, tgt = _lm_batch()
+    variables, state = kfac.init(jax.random.PRNGKey(0), ids,
+                                 train=False)
+    params = variables['params']
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def loss_fn(out):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            out, tgt).mean()
+
+    losses = []
+    for i in range(n):
+        loss, _, grads, captures, _ = kfac.capture.loss_and_grads(
+            loss_fn, params, ids, train=False)
+        g, state = kfac.step(state, grads, captures,
+                             factor_update=True, inv_update=i == 0)
+        updates, opt_state = tx.update(g, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        losses.append(np.asarray(loss))
+    return np.asarray(losses)
+
+def test_explicit_expand_bit_identical_to_default():
+    """kfac_approx='expand' (and the no-arg default) run the identical
+    program: per-step losses pinned bitwise over several steps."""
+    model = _tiny_lm()
+    base = _run_steps(KFAC(model, factor_update_freq=1,
+                           inv_update_freq=1, damping=0.01))
+    explicit = _run_steps(KFAC(model, factor_update_freq=1,
+                               inv_update_freq=1, damping=0.01,
+                               kfac_approx='expand'))
+    np.testing.assert_array_equal(base, explicit)
+
+
+def test_reduce_changes_statistics_but_not_layout():
+    model = _tiny_lm()
+    ids, _ = _lm_batch()
+    ke = KFAC(model, factor_update_freq=1, inv_update_freq=1,
+              damping=0.01, kfac_approx='expand', tied_embeddings=False)
+    kr = KFAC(model, factor_update_freq=1, inv_update_freq=1,
+              damping=0.01, kfac_approx='reduce', tied_embeddings=False)
+    _, se = ke.init(jax.random.PRNGKey(0), ids, train=False)
+    _, sr = kr.init(jax.random.PRNGKey(0), ids, train=False)
+    # Factor dims are approximation-invariant: identical state trees.
+    assert jax.tree_util.tree_structure(se) == \
+        jax.tree_util.tree_structure(sr)
+    for (p1, l1), (p2, l2) in zip(
+            jax.tree_util.tree_leaves_with_path(se),
+            jax.tree_util.tree_leaves_with_path(sr)):
+        assert l1.shape == l2.shape, (p1, p2)
+
+
+# ---------------------------------------------------------------------------
+# Tied embeddings: one factor pair, one inverse
+# ---------------------------------------------------------------------------
+
+def test_tied_embedding_single_inverse_and_summed_statistics():
+    model = TiedLM()
+    kfac = KFAC(model, factor_update_freq=1, inv_update_freq=1,
+                damping=0.01, tied_embeddings=True)
+    r = np.random.RandomState(4)
+    ids = jnp.asarray(r.randint(0, 17, (4, 6)))
+    y = jnp.asarray(r.randint(0, 17, (4, 6)))
+    variables, state = kfac.init(jax.random.PRNGKey(0), ids)
+    # ONE registration, ONE inverse entry, attend call site counted.
+    assert list(kfac.specs) == ['embed']
+    assert kfac.specs['embed'].tied_calls == 1
+    assert len(state['inverses']) == 1
+
+    def loss_fn(out):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            out, y).mean()
+
+    _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+        loss_fn, variables['params'], ids)
+    assert len(captures['embed']['a_tied']) == 1
+    assert len(captures['embed']['g_tied']) == 1
+    _, state = kfac.step(state, grads, captures)
+    # A = lookup one-hot frequency + diag cov of attend output-grads.
+    counts = np.bincount(np.asarray(ids).reshape(-1), minlength=17)
+    freq = counts / ids.size
+    g_att = np.asarray(captures['embed']['g_tied'][0]).reshape(-1, 17)
+    diag = (g_att ** 2).mean(0)
+    a_fac = np.asarray(state['factors']['embed']['A'])
+    expect_a = 0.95 * np.ones(17) + 0.05 * (freq + diag)
+    np.testing.assert_allclose(a_fac, expect_a, rtol=1e-5, atol=1e-6)
+    # G = cov(lookup output grads) + cov(attend inputs).
+    g_look = np.asarray(captures['embed']['g'][0]).reshape(-1, 8)
+    x_att = np.asarray(captures['embed']['a_tied'][0]).reshape(-1, 8)
+    expect_g = (0.95 * np.eye(8)
+                + 0.05 * (g_look.T @ g_look / g_look.shape[0]
+                          + x_att.T @ x_att / x_att.shape[0]))
+    np.testing.assert_allclose(np.asarray(state['factors']['embed']['G']),
+                               expect_g, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_tied_capture_off_by_default_is_bit_identical():
+    """tied_embeddings defaults OFF under pure expand: the tied model's
+    default step matches an explicitly-disabled one bitwise."""
+    model = _tiny_lm(tied=True)
+    base = _run_steps(KFAC(model, factor_update_freq=1,
+                           inv_update_freq=1, damping=0.01))
+    off = _run_steps(KFAC(model, factor_update_freq=1,
+                          inv_update_freq=1, damping=0.01,
+                          tied_embeddings=False))
+    np.testing.assert_array_equal(base, off)
+
+
+def test_subsample_preserves_tied_streams():
+    model = TiedLM()
+    kfac = KFAC(model, tied_embeddings=True)
+    r = np.random.RandomState(5)
+    ids = jnp.asarray(r.randint(0, 17, (8, 6)))
+    variables, _ = kfac.init(jax.random.PRNGKey(0), ids)
+    _, _, _, captures, _ = kfac.capture.loss_and_grads(
+        lambda out: (out ** 2).mean(), variables['params'], ids)
+    thin = subsample_captures(captures, 0.5)
+    assert set(thin['embed']) == {'a', 'g', 'a_tied', 'g_tied'}
+    assert thin['embed']['a_tied'][0].shape[0] == 4
+
+
+def test_shared_seq_tower_fixture_reduce_sums_per_call():
+    """Multi-call weight sharing composes with reduce: per-call reduced
+    factors sum (LinearMultiLayer semantics across calls, reduce within
+    each call's sequence axis)."""
+    model = SharedSeqTower()
+    kfac = KFAC(model, factor_update_freq=1, inv_update_freq=1,
+                damping=0.01, kl_clip=None,
+                kfac_approx={'shared': 'reduce'})
+    r = np.random.RandomState(6)
+    pair = (jnp.asarray(r.randn(4, 3, 5), jnp.float32),
+            jnp.asarray(r.randn(4, 3, 5), jnp.float32))
+    variables, state = kfac.init(jax.random.PRNGKey(0), pair)
+    spec = kfac.specs['shared']
+    assert spec.num_calls == 2 and spec.kfac_approx == KFAC_REDUCE
+    _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+        lambda out: (out ** 2).mean(), variables['params'], pair)
+    a_fac = L.compute_a_factor(spec, captures['shared']['a'])
+    expect = sum(np.asarray(F.linear_a_factor_reduced(a, True))
+                 for a in captures['shared']['a'])
+    np.testing.assert_allclose(np.asarray(a_fac), expect,
+                               rtol=1e-6, atol=1e-6)
+    precond, _ = kfac.step(state, grads, captures)
+    assert all(np.isfinite(x).all() for x in jax.tree.leaves(precond))
+
+
+# ---------------------------------------------------------------------------
+# SPMD: KAISA buckets + factor parity on 8 devices
+# ---------------------------------------------------------------------------
+
+def _spmd_factor_state(kfac, model, params, grads, ids, tgt, mesh):
+    dkfac = D.DistributedKFAC(kfac, mesh, params)
+    dstate = dkfac.shard_state(dkfac.init_state(params))
+
+    def local(dstate, grads, ids, tgt):
+        def lf(out):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                out, tgt).mean()
+        _, _, _, caps, _ = kfac.capture.loss_and_grads(
+            lf, params, ids, train=False)
+        return dkfac.spmd_step(dstate, grads, caps,
+                               factor_update=True, inv_update=True)
+
+    kspecs = dkfac.state_pspecs(dstate)
+    gspec = jax.tree.map(lambda _: P(), grads)
+    step = jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(kspecs, gspec, P(D.KFAC_AXES), P(D.KFAC_AXES)),
+        out_specs=(gspec, kspecs), check_vma=False))
+    _, dstate1 = step(dstate, grads, ids, tgt)
+    return dkfac, dstate1
+
+
+@pytest.mark.slow
+def test_spmd_kaisa_reduce_attention_factor_parity():
+    """8-dev HYBRID (KAISA) mesh: a reduce attention block's factor
+    update matches the single-chip path, and the q/k/v/o projections
+    land in the ordinary row-sharded buckets (dims unchanged by the
+    approximation)."""
+    model = _tiny_lm(tied=True)
+    kfac = KFAC(model, factor_update_freq=1, inv_update_freq=1,
+                damping=0.01, kfac_approx='reduce',
+                comm_method=CommMethod.HYBRID_OPT,
+                grad_worker_fraction=0.5)
+    ids, tgt = _lm_batch(b=8)
+    variables, state = kfac.init(jax.random.PRNGKey(0), ids,
+                                 train=False)
+    params = variables['params']
+
+    def loss_fn(out):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            out, tgt).mean()
+
+    _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+        loss_fn, params, ids, train=False)
+    _, state1 = kfac.step(state, grads, captures)
+
+    mesh = D.make_kfac_mesh(comm_method=CommMethod.HYBRID_OPT,
+                            grad_worker_fraction=0.5)
+    dkfac, dstate1 = _spmd_factor_state(kfac, model, params, grads,
+                                        ids, tgt, mesh)
+    # Attention projection factors (dims 16/17) occupy row-sharded
+    # bucket slots exactly as under expand.
+    assert ('block0/attn/q_proj', 'A') in \
+        dkfac.assignment.buckets[17].slot
+    assert ('block0/attn/q_proj', 'G') in \
+        dkfac.assignment.buckets[16].slot
+    for name in state1['factors']:
+        for w in ('A', 'G'):
+            np.testing.assert_allclose(
+                np.asarray(state1['factors'][name][w]),
+                np.asarray(jax.device_get(
+                    dstate1['factors'][name][w])),
+                rtol=2e-4, atol=2e-5, err_msg=f'{name}/{w}')
+
+
+@pytest.mark.slow
+def test_spmd_default_expand_bit_identity():
+    """8-dev SPMD: the no-arg default and kfac_approx='expand' run the
+    identical program — per-step preconditioned grads pinned bitwise
+    over a factor+inverse firing step (the acceptance pin that
+    all-expand is the pre-sharing path on the distributed step too)."""
+    model = _tiny_lm(tied=True)
+    ids, tgt = _lm_batch(b=8)
+
+    def run(kfac):
+        variables, _ = kfac.init(jax.random.PRNGKey(0), ids,
+                                 train=False)
+        params = variables['params']
+
+        def loss_fn(out):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                out, tgt).mean()
+
+        _, _, grads, _, _ = kfac.capture.loss_and_grads(
+            loss_fn, params, ids, train=False)
+        mesh = D.make_kfac_mesh()
+        _, dstate1 = _spmd_factor_state(kfac, model, params, grads,
+                                        ids, tgt, mesh)
+        return grads, dstate1
+
+    k_default = KFAC(_tiny_lm(tied=True), factor_update_freq=1,
+                     inv_update_freq=1, damping=0.01)
+    k_expand = KFAC(_tiny_lm(tied=True), factor_update_freq=1,
+                    inv_update_freq=1, damping=0.01,
+                    kfac_approx='expand')
+    _, s1 = run(k_default)
+    _, s2 = run(k_expand)
+    for (p1, l1), (p2, l2) in zip(
+            jax.tree_util.tree_leaves_with_path(s1['factors']),
+            jax.tree_util.tree_leaves_with_path(s2['factors'])):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(l1)),
+                                      np.asarray(jax.device_get(l2)),
+                                      err_msg=str(p1))
+
+
+# ---------------------------------------------------------------------------
+# CI fast-tier smoke: the LM CLI under --kfac-approx reduce
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_lm_cli_reduce_smoke(tmp_path):
+    """The sharing_smoke.sh core as a suite test: one tiny LM CLI epoch
+    under --kfac-approx reduce with the metrics sink on, asserting the
+    per-layer resolved approx map landed in the stream's meta records
+    (expand nowhere, reduce on every attention/MLP Dense, the tied
+    embedding labeled '+tied'). Subprocess on a fresh single-device CPU
+    backend for the same reasons as test_cifar_cli_metrics_smoke."""
+    import os
+    import subprocess
+    import sys
+
+    from distributed_kfac_pytorch_tpu.observability import (
+        sink as obs_sink,
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mpath = tmp_path / 'metrics.jsonl'
+    env = {**os.environ,
+           'PYTHONPATH': repo,
+           'JAX_PLATFORMS': 'cpu',
+           'KFAC_COMPILE_CACHE': '0',
+           'KFAC_SYNTHETIC_LM': '2048'}
+    env['XLA_FLAGS'] = ' '.join(
+        f for f in env.get('XLA_FLAGS', '').split()
+        if 'xla_force_host_platform_device_count' not in f)
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, 'examples', 'train_language_model.py'),
+         '--arch', 'transformer', '--emsize', '32', '--nlayers', '1',
+         '--nheads', '2', '--bptt', '16', '--batch-size', '4',
+         '--epochs', '1', '--tied', '--kfac-update-freq', '1',
+         '--no-resume',
+         '--log-dir', str(tmp_path / 'logs'),
+         '--checkpoint-dir', str(tmp_path / 'ckpt'),
+         '--kfac-metrics', str(mpath), '--metrics-interval', '1',
+         '--kfac-approx', 'reduce'],
+        env=env, capture_output=True, text=True, timeout=480)
+    assert proc.returncode == 0, \
+        f'CLI smoke failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-3000:]}'
+    records = obs_sink.read_jsonl(str(mpath))  # schema-validated
+    metas = [r['meta'] for r in records if r['kind'] == 'meta'
+             and 'kfac_approx' in r.get('meta', {})]
+    assert len(metas) == 1, metas
+    per = metas[0]['kfac_approx']
+    assert metas[0]['kfac_approx_setting'] == 'reduce'
+    assert metas[0]['tied_embeddings'] is True
+    assert per['block0/attn/q_proj'] == 'reduce'
+    assert per['block0/mlp_in'] == 'reduce'
+    assert per['embed'] == 'expand+tied'
+    assert any(r['kind'] == 'step' for r in records)
+
+
+# ---------------------------------------------------------------------------
+# Zero-retrace guard: approx is static program structure
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_mixed_approx_zero_retraces_through_variant_cache():
+    model = _tiny_lm(tied=True)
+    kfac = KFAC(model, factor_update_freq=2, inv_update_freq=4,
+                damping=0.01, kfac_approx='reduce')
+    ids, tgt = _lm_batch(b=8)
+    variables, _ = kfac.init(jax.random.PRNGKey(0), ids, train=False)
+    params = variables['params']
+    mesh = D.make_kfac_mesh()
+    dkfac = D.DistributedKFAC(kfac, mesh, params)
+    kstate = dkfac.shard_state(dkfac.init_state(params))
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def loss_fn(out, batch):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            out, batch[1]).mean()
+
+    step = dkfac.build_train_step(
+        loss_fn, tx, model_kwargs_fn=lambda b: {'train': False})
+    from distributed_kfac_pytorch_tpu.training import engine
+    hyper = {'lr': 0.1, 'damping': 0.01, 'factor_update_freq': 2,
+             'inv_update_freq': 4}
+    extra = {}
+    for i in range(8):
+        flags = engine.cadence_flags(i, 2, 4)
+        params, opt_state, kstate, extra, _ = step(
+            params, opt_state, kstate, extra, (ids, tgt), hyper,
+            **flags)
+    assert all(v == 1 for v in step.trace_counts.values()), \
+        step.trace_counts
