@@ -75,6 +75,8 @@ def run_one(use_kfac: bool, args, data):
         workers=1,
         kfac_inv_update_freq=args.kfac_update_freq if use_kfac else 0,
         inv_pipeline_chunks=args.inv_pipeline_chunks,
+        deferred_factor_reduction=args.deferred_factor_reduction,
+        inv_staleness=args.inv_staleness,
         kfac_cov_update_freq=1, damping=args.damping,
         kl_clip=0.001, eigh_method=args.eigh_method,
         eigh_polish_iters=args.eigh_polish_iters,
@@ -276,6 +278,15 @@ def main(argv=None):
                         'A/B arm for the step-time-uniformity knob '
                         '(chunked firings see fresher factors but '
                         'layer inverses are no longer simultaneous)')
+    p.add_argument('--deferred-factor-reduction', action='store_true',
+                   help='r14 deferred window-boundary factor '
+                        'reduction (exact by EMA linearity; the A/B '
+                        'arm only checks the composed schedule)')
+    p.add_argument('--inv-staleness', type=int, default=0,
+                   choices=[0, 1],
+                   help='r14 one-window-stale off-critical-path '
+                        'inverses — the staleness convergence A/B arm '
+                        '(PERF.md r14 decision rule)')
     p.add_argument('--damping', type=float, default=0.003)
     # KFACParamScheduler knobs (the round-3 analysis prescribed a
     # damping/update-freq schedule for the conv/BN study; VERDICT r3 #6).
@@ -383,6 +394,8 @@ def main(argv=None):
         'label_noise': args.label_noise,
         'damping': args.damping,
         'inv_pipeline_chunks': args.inv_pipeline_chunks,
+        'deferred_factor_reduction': args.deferred_factor_reduction,
+        'inv_staleness': args.inv_staleness,
         'target_val_acc': round(target, 4),
     }
     if args.only:

@@ -352,11 +352,16 @@ def run_quality_leg(args):
     ms/iter: legs 'sgd' (momentum baseline), 'expand' and 'reduce'
     (K-FAC under each weight-sharing approximation, identical
     hyperparameters otherwise — the curve difference isolates the
-    approximation). Static cadence f=--ab-f / i=--ab-i through
-    ``engine.cadence_flags`` like a production run; one jit variant per
-    flag combination; step 0's compile wall is excluded from ms/iter.
-    Quality curves, not microbenches — the PERF.md r13 decision rule
-    consumes these next to step_breakdown's factor-cost rows.
+    approximation), plus the r14 staleness pair 'eager' (the default
+    firing schedule) and 'stale' (``inv_staleness=1`` +
+    ``deferred_factor_reduction=True`` — the composed overlap config a
+    promotion would ship; the curve difference isolates the one-window
+    inverse staleness, since deferred reduce is exact). Static cadence
+    f=--ab-f / i=--ab-i through ``engine.cadence_flags`` like a
+    production run; one jit variant per flag combination; step 0's
+    compile wall is excluded from ms/iter. Quality curves, not
+    microbenches — the PERF.md r13/r14 decision rules consume these
+    next to step_breakdown's cost rows.
     """
     import time as _time
 
@@ -387,6 +392,13 @@ def run_quality_leg(args):
 
     tx = optax.sgd(args.ab_lr, momentum=0.9)
     f_freq, i_freq = args.ab_f, args.ab_i
+    # Steps whose wall time is a jit trace+compile (each variant's
+    # FIRST invocation), excluded from the spike stat below — every
+    # flag combination compiles lazily mid-run, and a multi-second
+    # compile wall would drown the eigh spike the metric exists to
+    # show.
+    compiled_at: set = set()
+    cur_step = [0]
     if leg == 'sgd':
         variables = model.init(jax.random.PRNGKey(0),
                                jnp.zeros((1, args.ab_seq), jnp.int32),
@@ -404,14 +416,23 @@ def run_quality_leg(args):
             return optax.apply_updates(params, updates), opt_state, l
 
         def step(st, x, y, flags):
+            if not compiled_at:
+                compiled_at.add(cur_step[0])
             p, o, l = sgd_step(st[0], st[1], x, y)
             return (p, o), l
         state0 = (params, opt_state)
     else:
+        # 'stale' = the composed r14 overlap config (staleness + the
+        # exact deferred reduce); 'eager' = its matched default-
+        # schedule baseline; 'expand'/'reduce' = the r13 approx legs.
+        overlap = (dict(deferred_factor_reduction=True,
+                        inv_staleness=1) if leg == 'stale' else {})
         kfac = KFAC(model, factor_update_freq=f_freq,
                     inv_update_freq=i_freq, damping=0.003,
                     lr=args.ab_lr, kl_clip=0.001,
-                    kfac_approx=leg)
+                    kfac_approx=(leg if leg in ('expand', 'reduce')
+                                 else 'expand'),
+                    **overlap)
         variables, kstate = kfac.init(
             jax.random.PRNGKey(0),
             jnp.zeros((1, args.ab_seq), jnp.int32), train=False)
@@ -420,17 +441,19 @@ def run_quality_leg(args):
         variants = {}
 
         def step(st, x, y, flags):
-            key = (flags['factor_update'], flags['inv_update'])
+            key = tuple(sorted(flags.items()))
             if key not in variants:
+                compiled_at.add(cur_step[0])
                 def impl(params, opt_state, kstate, x, y,
-                         _f=key[0], _i=key[1]):
+                         _flags=dict(flags)):
                     l, _, grads, captures, _ = (
                         kfac.capture.loss_and_grads(
                             lambda out: loss_of(out, y), params, x,
-                            train=False, intercept=_f))
+                            train=False,
+                            intercept=_flags.get('factor_update',
+                                                 True)))
                     g, kstate = kfac.step(kstate, grads, captures,
-                                          factor_update=_f,
-                                          inv_update=_i)
+                                          **_flags)
                     updates, opt_state = tx.update(g, opt_state,
                                                    params)
                     params = optax.apply_updates(params, updates)
@@ -440,6 +463,12 @@ def run_quality_leg(args):
             return (p, o, k), l
         state0 = (params, opt_state, kstate)
 
+    def leg_flags(i):
+        return engine.cadence_flags(
+            i, f_freq, i_freq,
+            deferred_reduce=leg == 'stale',
+            inv_staleness=1 if leg == 'stale' else 0)
+
     losses, times = [], []
     st = state0
     batches = datasets.bptt_batches(train_ids, args.ab_batch,
@@ -447,23 +476,32 @@ def run_quality_leg(args):
     for i, (x, y) in enumerate(batches):
         if i >= args.ab_steps:
             break
-        flags = engine.cadence_flags(i, f_freq, i_freq)
+        flags = leg_flags(i)
+        cur_step[0] = i
         t0 = _time.perf_counter()
         st, l = step(st, jnp.asarray(x), jnp.asarray(y), flags)
         jax.block_until_ready(l)
         times.append((_time.perf_counter() - t0) * 1000.0)
         losses.append(float(l))
     tail = losses[-max(len(losses) // 4, 1):]
-    # Steady-state ms/iter over plain (non-fired, post-warm) steps.
+    # Steady-state ms/iter over plain (non-fired, non-compile) steps.
     plain = [t for i, t in enumerate(times)
-             if i > 0 and engine.fired_stage(
-                 engine.cadence_flags(i, f_freq, i_freq)) is None]
+             if i not in compiled_at
+             and engine.fired_stage(leg_flags(i)) is None]
+    # Spike stat over every non-compile step: fired steps stay IN (the
+    # spike is what staleness re-times), compile walls stay OUT.
+    post = [t for i, t in enumerate(times) if i not in compiled_at]
     emit({'phase_result': round(float(np.mean(tail)), 4),
           'losses': [round(v, 4) for v in losses],
           'final_loss': round(float(np.mean(tail)), 4),
           'first_loss': round(losses[0], 4),
           'ms_per_iter_plain': (round(float(np.median(plain)), 2)
                                 if plain else None),
+          # Firing-spike uniformity (the number staleness moves):
+          # max/median over post-warm steps.
+          'spike_max_over_median': (
+              round(float(np.max(post) / np.median(post)), 2)
+              if post else None),
           'steps': len(losses)})
 
 
@@ -691,10 +729,21 @@ def main(argv=None):
                    help='--approx-ab inverse-update cadence')
     p.add_argument('--ab-d', type=int, default=512,
                    help='internal: quality-phase d_model')
+    p.add_argument('--staleness-ab', action='store_true',
+                   help='r14 inv_staleness convergence A/B: for each '
+                        '--ladder d_model, run a short REAL training '
+                        'leg with the default firing schedule '
+                        '("eager") and one with inv_staleness=1 + '
+                        'deferred_factor_reduction ("stale"), '
+                        'identical hyperparameters — the loss-curve '
+                        'difference isolates the one-window inverse '
+                        'staleness (PERF.md r14 decision rule; '
+                        'committed FLAGSHIP_LM_r14_STALENESS.jsonl)')
     p.add_argument('--quality-leg', default=None,
-                   choices=['sgd', 'expand', 'reduce'],
-                   help='internal: which --approx-ab leg this '
-                        'subprocess runs')
+                   choices=['sgd', 'expand', 'reduce', 'eager',
+                            'stale'],
+                   help='internal: which --approx-ab/--staleness-ab '
+                        'leg this subprocess runs')
     p.add_argument('--obs-baseline', default=None, metavar='PATH',
                    help='record a per-step metrics stream at this '
                         'config and reduce it to a committed '
@@ -715,11 +764,14 @@ def main(argv=None):
     if args.phase:
         return run_phase(args)
 
-    if args.approx_ab:
+    if args.approx_ab or args.staleness_ab:
         import jax as _jax
         backend = _jax.default_backend()
+        legs = (('sgd', 'expand', 'reduce') if args.approx_ab
+                else ('eager', 'stale'))
+        ab_label = 'kfac_approx' if args.approx_ab else 'inv_staleness'
         for d in args.ladder:
-            for leg in ('sgd', 'expand', 'reduce'):
+            for leg in legs:
                 cmd = [sys.executable, os.path.abspath(__file__),
                        '--phase', 'quality', '--quality-leg', leg,
                        '--ab-d', str(d),
@@ -731,7 +783,7 @@ def main(argv=None):
                        '--ab-lr', str(args.ab_lr),
                        '--ab-f', str(args.ab_f),
                        '--ab-i', str(args.ab_i)]
-                row = {'config': 4, 'ab': 'kfac_approx',
+                row = {'config': 4, 'ab': ab_label,
                        'd_model': d, 'leg': leg, 'backend': backend,
                        'seq': args.ab_seq, 'batch': args.ab_batch,
                        'vocab': args.ab_vocab,

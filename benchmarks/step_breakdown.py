@@ -10,6 +10,11 @@ every pipeline stage is a recorded number, not an inference:
   precond        + preconditioning with frozen inverses + KL clip
                  (factor_update=False, inv_update=False)
   factors        + factor EWMA every iter (factor_update=True)
+  factors_deferred  the 'factors' phase under r14 deferred reduction:
+                 per-iter local accumulation, the EWMA boundary update
+                 once per ``inv_freq`` window (single-chip: the delta
+                 vs 'factors' is the accumulate-vs-EWMA program cost —
+                 the collective saving only exists on a mesh)
   full           + amortized inverse updates every ``inv_freq`` iters
   full_polishN   full with eigh_polish_iters=N variants
   precond_bf16   the 'precond' phase with precond_compute_dtype=bf16
@@ -52,6 +57,8 @@ def build(model, x, y, inv_freq, n_iters, mode, polish_iters=None,
           precond_dtype=None, kfac_kwargs=None):
     """One scanned runner for a cumulative phase ``mode``."""
     kw = dict(kfac_kwargs or {})
+    if mode == 'factors_deferred':
+        kw.setdefault('deferred_factor_reduction', True)
     if polish_iters is not None:
         kw['eigh_polish_iters'] = polish_iters
     if precond_dtype is not None:
@@ -112,6 +119,43 @@ def build(model, x, y, inv_freq, n_iters, mode, polish_iters=None,
         body = make_body(False, False, use_precond=True)
     elif mode == 'factors':
         body = make_body(True, False, use_precond=True)
+    elif mode == 'factors_deferred':
+        # r14 deferred reduction at the same cadence shape as
+        # 'factors': accumulate every iter, apply (factor_reduce) once
+        # per inv_freq window — no firing, so the row isolates the
+        # factor-statistics path like 'factors' does.
+        def make_deferred_body(reduce_flag):
+            def body(carry, _):
+                params, opt_state, kstate, extra = carry
+                loss_v, _, grads, captures, updated = (
+                    kfac.capture.loss_and_grads(
+                        loss, params, x, extra_vars=extra,
+                        mutable_cols=('batch_stats',)))
+                g, kstate2 = kfac.step(kstate, grads, captures,
+                                       factor_update=True,
+                                       inv_update=False,
+                                       factor_reduce=reduce_flag)
+                updates, opt_state = tx.update(g, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state, kstate2,
+                        {**extra, **updated}), loss_v
+            return body
+
+        reduce_body = make_deferred_body(True)
+        accum_body = make_deferred_body(False)
+
+        def block(carry, _):
+            carry, l0 = reduce_body(carry, None)
+            carry, ls = jax.lax.scan(accum_body, carry, None,
+                                     length=inv_freq - 1)
+            return carry, ls[-1]
+
+        @jax.jit
+        def run(carry):
+            carry, losses = jax.lax.scan(block, carry, None,
+                                         length=n_iters // inv_freq)
+            return carry, losses[-1]
+        return run, (params, opt_state, kstate, extra)
     elif mode == 'full':
         inv_body = make_body(True, True, use_precond=True)
         plain_body = make_body(True, False, use_precond=True)
@@ -366,7 +410,8 @@ def main(argv=None):
                                 mutable_cols=('batch_stats',))
 
     rows = {}
-    for mode in ('sgd', 'capture', 'precond', 'factors', 'full'):
+    for mode in ('sgd', 'capture', 'precond', 'factors',
+                 'factors_deferred', 'full'):
         run, carry = build(model, x, y, inv_freq, n_iters, mode)
         ms = B.time_chained(run, carry, n_iters, floor_ms=floor_ms,
                             leg=mode)
@@ -399,6 +444,10 @@ def main(argv=None):
         'precond_bf16_saving': round(rows['precond']
                                      - rows['precond_bf16'], 2),
         'factor_cost': round(rows['factors'] - rows['precond'], 2),
+        # r14: single-chip program-cost delta of deferring the EWMA to
+        # the window boundary (the collective saving needs a mesh).
+        'deferred_reduce_delta': round(rows['factors_deferred']
+                                       - rows['factors'], 2),
         'inverse_amortized_cost': round(rows['full'] - rows['factors'], 2),
     }
     print(json.dumps({'summary': rows, 'deltas': deltas}), flush=True)

@@ -210,6 +210,15 @@ def kfac_overrides(knobs: dict) -> tuple[dict, int | None, list[str]]:
             kwargs['kfac_approx'] = str(value)
         elif name == 'kfac_inv_update_freq':
             inv_freq = int(value)
+        elif name in ('deferred_factor_reduction', 'inv_staleness'):
+            # Engine-scheduled knobs (window-boundary reduce /
+            # frozen-snapshot chunk phases): a bare-KFAC scan harness
+            # fires monolithically with no factor_reduce/
+            # factor_snapshot schedule, so constructing with them on
+            # would leave the accumulator un-reduced forever. Surfaced
+            # as ignored, never silently dropped.
+            if value:
+                ignored.append(name)
         else:
             ignored.append(name)
     return kwargs, inv_freq, sorted(ignored)

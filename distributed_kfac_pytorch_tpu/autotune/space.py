@@ -67,6 +67,18 @@ def _divides_inv_freq(cfg: dict) -> bool:
     return k >= 1 and (k == 1 or (freq > 0 and freq % k == 0))
 
 
+def _staleness_fits_window(cfg: dict) -> bool:
+    # inv_staleness=1 fires chunk j at phase j*stride+1, which needs
+    # inv_update_freq/inv_pipeline_chunks >= 2 (the KFAC constructor's
+    # constraint, checked here so invalid candidates are pruned before
+    # a probe is paid for them).
+    if int(cfg.get('inv_staleness', 0) or 0) == 0:
+        return True
+    k = max(1, int(cfg.get('inv_pipeline_chunks', 1)))
+    freq = int(cfg.get('kfac_inv_update_freq', 0))
+    return freq > 0 and freq % k == 0 and freq // k >= 2
+
+
 def _bf16_dispatch_supported(cfg: dict) -> bool:
     # bf16 precondition operands require the r6 dispatch branches;
     # every in-tree inverse method threads precond_compute_dtype, so
@@ -91,6 +103,13 @@ BASE_CONSTRAINTS = (
     Constraint("kfac_approx must be 'expand' or 'reduce'",
                lambda c: c.get('kfac_approx', 'expand') in ('expand',
                                                             'reduce')),
+    Constraint('inv_staleness must be 0 or 1',
+               lambda c: int(c.get('inv_staleness', 0) or 0) in (0, 1)),
+    Constraint('inv_staleness=1 needs kfac_inv_update_freq/'
+               'inv_pipeline_chunks >= 2', _staleness_fits_window),
+    Constraint('deferred_factor_reduction must be a bool',
+               lambda c: isinstance(
+                   c.get('deferred_factor_reduction', False), bool)),
 )
 
 
@@ -145,6 +164,15 @@ def default_space(overrides: dict[str, Sequence] | None = None
              'collapses the shared sequence/patch axis before the '
              'covariance — factor-T cheaper factor updates on '
              'transformer/ViT workloads, a no-op elsewhere'),
+        Knob('deferred_factor_reduction', (False, True),
+             'deferred window-boundary factor reduction (r14): one '
+             'bucketed collective per cadence window instead of a '
+             'per-factor-step pmean; exact by EMA linearity'),
+        Knob('inv_staleness', (0, 1),
+             'one-window-stale off-critical-path inverses (r14): '
+             'chunk-fire decompositions of the frozen window-head '
+             'snapshot across plain steps — convergence-gated like '
+             'the r9 chunk knob'),
     ]
     if overrides:
         unknown = set(overrides) - {k.name for k in stock}

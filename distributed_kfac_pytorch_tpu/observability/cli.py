@@ -78,6 +78,17 @@ def add_observability_args(p) -> None:
                         'completion each step — costs async-dispatch '
                         'pipelining, so only enable when hunting '
                         'skew. Requires --kfac-metrics')
+    p.add_argument('--straggler-sample-every', type=int, default=1,
+                   metavar='N',
+                   help='run the barrier-wait probe only every Nth '
+                        'step (r14): amortizes the probe\'s host-sync '
+                        'cost to 1/N so straggler attribution can '
+                        'stay on in long runs. Every rank samples the '
+                        'same steps (a pure function of the global '
+                        'step), so the merged skew analysis still '
+                        'lines up; non-sampled steps carry no wait '
+                        'field. 1 = the r10 every-step probe. '
+                        'Requires --straggler-shards')
 
 
 def wants_guard(args) -> bool:
@@ -102,6 +113,13 @@ def make_metrics_sink(args, info, meta: dict | None = None):
     if getattr(args, 'straggler_shards', False) and not args.kfac_metrics:
         raise SystemExit('--straggler-shards requires --kfac-metrics '
                          '(shards live next to the metrics path)')
+    if getattr(args, 'straggler_sample_every', 1) < 1:
+        raise SystemExit('--straggler-sample-every must be >= 1')
+    if (getattr(args, 'straggler_sample_every', 1) > 1
+            and not getattr(args, 'straggler_shards', False)):
+        raise SystemExit('--straggler-sample-every requires '
+                         '--straggler-shards (it paces the barrier '
+                         'probe those shards record)')
     if not args.kfac_metrics:
         return None
     path = metrics_path(args)

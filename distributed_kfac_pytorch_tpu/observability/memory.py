@@ -43,6 +43,12 @@ STATE_GROUPS = {
     'diag_inv': 'inverses',
     'grouped_inv': 'inverses',
     'metrics': 'metrics',
+    # r14 overlap state: the deferred-reduction accumulator is a full
+    # factor-sized copy per device, and the staleness snapshot another
+    # replicated factor copy — worth their own rows in the footprint
+    # (they are the knobs' HBM price).
+    'factor_accum': 'factor_accum',
+    'frozen_factors': 'frozen_factors',
 }
 
 

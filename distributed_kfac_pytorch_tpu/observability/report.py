@@ -366,6 +366,17 @@ def print_report(s: dict, out=None, torn: int = 0,
             w(f"  rank {rank}: {pr['n_steps']} steps  "
               f"p50 {_fmt(pr['p50_ms'], ' ms')}  "
               f"p95 {_fmt(pr['p95_ms'], ' ms')}{wait}")
+        wbs = stragglers.get('wait_by_stage')
+        if wbs:
+            # Comm-wait attribution (r14): the factor-step vs plain-
+            # step barrier-wait split is where a deferred-reduce /
+            # staleness overlap win shows up, readable from the JSONL
+            # alone (PERF.md r7 rule).
+            parts = [f"{cls} mean {_fmt(v['mean_wait_ms'], ' ms')}"
+                     f" max {_fmt(v['max_wait_ms'], ' ms')}"
+                     f" (n={v['n']})"
+                     for cls, v in sorted(wbs.items())]
+            w('  comm wait by stage: ' + '  |  '.join(parts))
         if stragglers['n_common_steps']:
             counts = ', '.join(
                 f'r{r}x{n}' for r, n in sorted(

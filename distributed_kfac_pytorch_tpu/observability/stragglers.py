@@ -119,6 +119,61 @@ def merge_shards(path: str, validate: bool = True
     return shards, torn, errors
 
 
+def stage_class(fired) -> str:
+    """Comm-wait attribution class of a step's ``fired`` label.
+
+    'factor' = steps that pay a factor-statistics collective (the
+    eager per-step pmean, the r14 deferred window-boundary 'reduce',
+    and compound firing+reduce labels); 'firing' = collective-free
+    inverse/chunk decomposition steps; 'compile'
+    = first-call compile steps (their timing is compile wall, not
+    steady state); 'plain' = everything else. The factor-vs-plain wait
+    split is how an overlap win (r14 deferred reduce / staleness)
+    reads directly from the JSONL, without a profile timeline
+    (PERF.md r7 rule).
+    """
+    if isinstance(fired, str) and 'reduce' in fired:
+        # 'reduce' alone, or a compound 'inverse+reduce'/'chunkJ+reduce'
+        # firing step: the step pays the per-window factor collective,
+        # which is the wait the factor class exists to attribute.
+        return 'factor'
+    if fired == 'factor':
+        return 'factor'
+    if fired == 'inverse' or (isinstance(fired, str)
+                              and fired.startswith('chunk')):
+        return 'firing'
+    if fired == 'compile':
+        return 'compile'
+    return 'plain'
+
+
+def wait_attribution(shards: dict[int, list[dict]]) -> dict | None:
+    """Barrier-wait stats per stage class, over every rank's shard.
+
+    ``{class: {'n', 'mean_wait_ms', 'max_wait_ms'}}`` for the classes
+    that recorded any wait (sampled probes — ``--straggler-sample-every``
+    — simply contribute fewer points; steps without a wait field are
+    skipped, so sparse shards merge cleanly). None when no step
+    carried a wait.
+    """
+    buckets: dict[str, list[float]] = {}
+    for records in shards.values():
+        for r in records:
+            if r.get('kind') != 'step':
+                continue
+            w = _num(r.get('metrics', {}).get(BARRIER_WAIT_KEY))
+            if w != w:  # NaN: no wait recorded on this step
+                continue
+            buckets.setdefault(stage_class(r.get('fired')),
+                               []).append(w)
+    if not buckets:
+        return None
+    return {cls: {'n': len(vals),
+                  'mean_wait_ms': sum(vals) / len(vals),
+                  'max_wait_ms': max(vals)}
+            for cls, vals in sorted(buckets.items())}
+
+
 def straggler_summary(shards: dict[int, list[dict]]) -> dict | None:
     """Cross-host skew analysis over merged rank shards.
 
@@ -173,6 +228,10 @@ def straggler_summary(shards: dict[int, list[dict]]) -> dict | None:
         'slowest_counts': slowest,
         'mean_skew_ms': (sum(skews) / len(skews) if skews else None),
         'max_skew_ms': (max(skews) if skews else None),
+        # Comm-wait attribution by fired-stage class (r14): how much
+        # of the barrier wait sits on factor-collective steps vs plain
+        # steps — the number the deferred-reduce overlap moves.
+        'wait_by_stage': wait_attribution(shards),
     }
 
 

@@ -384,11 +384,14 @@ class DistributedKFAC:
         balancer as the single-chip per-matrix plan) packs the offsets
         plus the grouped/diagonal items into ``k`` chunks. Returns
         ``{'offsets': {dim: {chunk: (m, ...)}}, 'diag': {name: chunk},
-        'grouped': {name: chunk}}``; ``None`` when ``k == 1``.
+        'grouped': {name: chunk}}``; ``None`` when the chunk-firing
+        machinery is off (``k == 1`` without ``inv_staleness`` — at
+        staleness=1 even ``k == 1`` builds a one-chunk plan so the
+        whole firing can run mid-window from the frozen snapshot).
         """
         kfac = self.kfac
         k = kfac.inv_pipeline_chunks
-        if k == 1:
+        if not kfac.pipelined_firing:
             return None
         from distributed_kfac_pytorch_tpu.ops.linalg import (
             decomposition_cost,
@@ -551,6 +554,23 @@ class DistributedKFAC:
                  # Pipelined-firing position (next chunk due; constant 0
                  # under inv_pipeline_chunks=1) — see KFAC.init_state.
                  'inv_chunk_phase': base['inv_chunk_phase']}
+        if self.kfac.deferred_factor_reduction:
+            # Per-DEVICE local accumulators (deferred reduce, r14):
+            # each device folds its own un-reduced contributions, so
+            # the leaves carry a leading device dim sharded over the
+            # data axes (state_pspecs) — a replicated spec would
+            # silently collapse device-varying values. The decay
+            # product is identical on every device (replicated).
+            state['factor_accum'] = jax.tree.map(
+                lambda x: jnp.zeros((self.data_size,) + x.shape,
+                                    x.dtype),
+                base['factors'])
+            state['accum_decay'] = jnp.ones((), jnp.float32)
+        if self.kfac.inv_staleness:
+            # Replicated window-head factor snapshot (post-reduce
+            # factors are replicated like the factors themselves).
+            state['frozen_factors'] = jax.tree.map(lambda x: x,
+                                                   base['factors'])
         if self.kfac.collect_metrics:
             # Replicated on-device metrics scalars (the single-chip
             # slot; state_pspecs' default P() covers them).
@@ -564,6 +584,11 @@ class DistributedKFAC:
         specs = jax.tree.map(lambda _: P(), state)
         specs['inv_stacks'] = jax.tree.map(
             lambda _: P(INV_GROUP_AXIS), state['inv_stacks'])
+        if 'factor_accum' in state:
+            # Leading device dim sharded over every data-bearing axis:
+            # each device owns exactly its own accumulator slice.
+            specs['factor_accum'] = jax.tree.map(
+                lambda _: P(self.data_axes), state['factor_accum'])
         return specs
 
     def shard_state(self, state: dict) -> dict:
@@ -660,6 +685,100 @@ class DistributedKFAC:
                                           old['A'], alpha),
                 'G': F.update_running_avg(g_new.astype(old['G'].dtype),
                                           old['G'], alpha)}
+        return new_factors
+
+    def _local_combined_contribs(self, contribs) -> dict:
+        """World-scale one batch's local contributions into combined
+        per-layer ``{'A', 'G'}`` parts.
+
+        The scaling half of :meth:`_spmd_update_factors`, applied
+        LOCALLY (every scale is a constant, so scaling before or after
+        the mean is the same linear map): grad-quadratic parts ('G',
+        tied 'A_g2' — ``L.GRAD_QUADRATIC_KEYS``) get the
+        ``1/world**2`` local-mean-loss correction, activation parts
+        ('A', 'G_a') none, and the tied extras fold into their sides.
+        Feeds the deferred-reduction accumulator, whose boundary pmean
+        then needs no per-key bookkeeping.
+        """
+        g_scale = 1.0 / self.data_size ** 2
+        out = {}
+        for name in self.kfac.specs:
+            c = contribs[name]
+            a_new = c['A']
+            g_new = g_scale * c['G']
+            if 'A_g2' in c:
+                a_new = a_new + g_scale * c['A_g2']
+                g_new = g_new + c['G_a']
+            out[name] = {'A': a_new, 'G': g_new}
+        return out
+
+    @profiling.scope('kfac/factors')
+    def _spmd_accumulate_factors(self, state, contribs, factor_decay
+                                 ) -> tuple[dict, jax.Array]:
+        """Deferred-reduction factor step: fold this device's batch
+        contribution into ITS slice of the accumulator — NO collective.
+
+        The per-step factor ``pmean`` of the eager path
+        (:meth:`_spmd_update_factors`) is exactly what this defers:
+        ``acc ← α·acc + (1-α)·c_local`` and ``decay ← α·decay``
+        per device; :meth:`_spmd_reduce_factors` pmeans the
+        accumulators once per window. By linearity
+        ``pmean(Σ w_i c_i) = Σ w_i pmean(c_i)``, so the boundary value
+        matches the per-step recursion up to fp associativity
+        (test-pinned). Returns ``(new_accum, new_decay)``; inside
+        shard_map the accumulator leaves are this device's ``(1, ...)``
+        slice of the sharded stack.
+        """
+        kfac = self.kfac
+        alpha = kfac.factor_decay if factor_decay is None else factor_decay
+        combined = self._local_combined_contribs(contribs)
+        acc = state['factor_accum']
+        new_acc = {}
+        for name in kfac.specs:
+            old = acc[name]
+            new_acc[name] = {
+                which: F.update_running_avg(
+                    combined[name][which].astype(
+                        old[which].dtype)[None],
+                    old[which], alpha)
+                for which in ('A', 'G')}
+        return new_acc, alpha * state['accum_decay']
+
+    @profiling.scope('kfac/factors')
+    def _spmd_reduce_factors(self, state, acc, decay) -> dict:
+        """Window-boundary deferred reduction: ONE bucketed pmean of
+        the whole accumulator tree, then the EMA boundary update.
+
+        This is the single collective that replaces the eager path's
+        per-factor-step ``pmean`` (``kfac/comm/factor_reduce`` — the
+        r14 overlap win's comm attribution scope). The tree is reduced
+        in one ``lax.pmean`` call so XLA buckets the transfers;
+        ``symmetry_aware_comm`` packs 2-D matrices before the wire
+        exactly like the eager path's ``factor_pmean``.
+        """
+        kfac = self.kfac
+
+        def pack(m):
+            m = m[0]  # this device's slice of the sharded stack
+            if kfac.symmetry_aware_comm and m.ndim == 2:
+                return F.pack_symmetric(m)
+            return m
+
+        packed = {name: {k: pack(v) for k, v in entry.items()}
+                  for name, entry in acc.items()}
+        with profiling.annotate('kfac/comm/factor_reduce'):
+            reduced = jax.lax.pmean(packed, self.data_axes)
+        new_factors = {}
+        for name in kfac.specs:
+            old = state['factors'][name]
+            entry = {}
+            for which in ('A', 'G'):
+                r = reduced[name][which]
+                if kfac.symmetry_aware_comm and old[which].ndim == 2:
+                    r = F.unpack_symmetric(r, old[which].shape[-1])
+                entry[which] = (decay * old[which]
+                                + r).astype(old[which].dtype)
+            new_factors[name] = entry
         return new_factors
 
     def _build_bucket_stack(self, factors, plan: BucketPlan) -> jax.Array:
@@ -1108,7 +1227,9 @@ class DistributedKFAC:
                   factor_update_freq=None, inv_update_freq=None,
                   factor_update: bool | None = None,
                   inv_update: bool | None = None,
-                  inv_chunk: int | None = None) -> tuple[dict, dict]:
+                  inv_chunk: int | None = None,
+                  factor_reduce: bool = False,
+                  factor_snapshot: bool = False) -> tuple[dict, dict]:
         """One distributed K-FAC update; call inside ``shard_map``.
 
         Same contract and cadence semantics as :meth:`KFAC.step`
@@ -1135,6 +1256,11 @@ class DistributedKFAC:
         ``j``'s buckets this step, pass the rest of the (row-sharded)
         stacks through untouched — see :meth:`KFAC.step` and
         :meth:`_spmd_update_inverses`.
+
+        ``factor_reduce`` / ``factor_snapshot``: the r14 overlap flags
+        (deferred window-boundary factor reduction / frozen-snapshot
+        refresh) — static-cadence only, same contract as
+        :meth:`KFAC.step`.
         """
         kfac = self.kfac
         damping = kfac.damping if damping is None else damping
@@ -1157,23 +1283,82 @@ class DistributedKFAC:
                 factor_decay)
 
         track = kfac.collect_metrics or kfac.nonfinite_guard
-        if track:
-            # Tracked form: finiteness of the candidate factors rides
-            # out of the gate (guard skip + metrics count); semantics
-            # shared with the single-chip step via
-            # preconditioner.guard_nonfinite_factors.
-            def do_factors_tracked():
-                return guard_nonfinite_factors(
-                    do_factors(), state['factors'],
-                    kfac.nonfinite_guard)
-
-            factors, finite_f = cadence_gate(
-                factor_update, step, f_freq, do_factors_tracked,
-                lambda: (state['factors'], jnp.ones((), jnp.int32)))
+        overlap_state = {}
+        if kfac.deferred_factor_reduction:
+            # Deferred reduce (r14): factor steps fold into this
+            # device's local accumulator slice — no collective; the
+            # window-boundary reduce step pays ONE bucketed pmean.
+            # Static cadence only (the reduce is program structure).
+            if factor_update is None:
+                raise ValueError(
+                    'deferred_factor_reduction requires static cadence '
+                    'flags (Python-bool factor_update/factor_reduce) — '
+                    'the window-boundary reduce is static program '
+                    'structure, like inv_chunk')
+            acc, decay = state['factor_accum'], state['accum_decay']
+            if factor_update:
+                acc, decay = self._spmd_accumulate_factors(
+                    state,
+                    (contribs if contribs is not None
+                     else self.local_factor_contribs(captures)),
+                    factor_decay)
+            if factor_reduce:
+                candidate = self._spmd_reduce_factors(state, acc, decay)
+                # Post-pmean candidate check: collective-safe (every
+                # device sees the same averaged values), exactly like
+                # the eager path's guard — moved to the reduce point.
+                factors, finite_f = guard_nonfinite_factors(
+                    candidate, state['factors'], kfac.nonfinite_guard)
+                acc = jax.tree.map(jnp.zeros_like, acc)
+                decay = jnp.ones((), jnp.float32)
+            else:
+                factors = state['factors']
+                finite_f = jnp.ones((), jnp.int32)
+            overlap_state['factor_accum'] = acc
+            overlap_state['accum_decay'] = decay
         else:
-            # Metrics/guard off: the historical program, untouched.
-            factors = cadence_gate(factor_update, step, f_freq,
-                                   do_factors, lambda: state['factors'])
+            if factor_reduce:
+                raise ValueError(
+                    'factor_reduce requires '
+                    'deferred_factor_reduction=True')
+            if track:
+                # Tracked form: finiteness of the candidate factors
+                # rides out of the gate (guard skip + metrics count);
+                # semantics shared with the single-chip step via
+                # preconditioner.guard_nonfinite_factors.
+                def do_factors_tracked():
+                    return guard_nonfinite_factors(
+                        do_factors(), state['factors'],
+                        kfac.nonfinite_guard)
+
+                factors, finite_f = cadence_gate(
+                    factor_update, step, f_freq, do_factors_tracked,
+                    lambda: (state['factors'], jnp.ones((), jnp.int32)))
+            else:
+                # Metrics/guard off: the historical program, untouched.
+                factors = cadence_gate(factor_update, step, f_freq,
+                                       do_factors,
+                                       lambda: state['factors'])
+        if kfac.inv_staleness:
+            if inv_update is None:
+                raise ValueError(
+                    'inv_staleness=1 requires static cadence flags '
+                    '(the frozen-snapshot firing schedule is static '
+                    'program structure, like inv_chunk)')
+            # Window heads (and monolithic firings — the step-0
+            # warmup) refresh the snapshot from this step's
+            # post-update factors; in-window chunk firings decompose
+            # the carried one, breaking the data dependency on this
+            # step's forward/backward/factor work.
+            frozen = (factors if factor_snapshot or inv_update
+                      else state['frozen_factors'])
+            overlap_state['frozen_factors'] = frozen
+            fire_factors = frozen
+        else:
+            if factor_snapshot:
+                raise ValueError(
+                    'factor_snapshot requires inv_staleness=1')
+            fire_factors = factors
         if inv_chunk is not None:
             k = kfac.inv_pipeline_chunks
             if inv_update:
@@ -1188,7 +1373,7 @@ class DistributedKFAC:
             with profiling.annotate(f'kfac/inverse/chunk{inv_chunk}'):
                 inv_stacks, diag_inv, grouped_inv = (
                     self._spmd_update_inverses(
-                        factors, damping,
+                        fire_factors, damping,
                         prev_stacks=state['inv_stacks'],
                         chunk=inv_chunk,
                         prev_diag=state['diag_inv'],
@@ -1198,7 +1383,8 @@ class DistributedKFAC:
             inv_stacks, diag_inv, grouped_inv = cadence_gate(
                 inv_update, step, i_freq,
                 lambda: self._spmd_update_inverses(
-                    factors, damping, prev_stacks=state['inv_stacks']),
+                    fire_factors, damping,
+                    prev_stacks=state['inv_stacks']),
                 lambda: (state['inv_stacks'], state['diag_inv'],
                          state.get('grouped_inv', {})))
             chunk_phase = (jnp.zeros((), jnp.int32) if inv_update
@@ -1210,7 +1396,8 @@ class DistributedKFAC:
             new_state = {'step': step + 1, 'factors': factors,
                          'inv_stacks': inv_stacks, 'diag_inv': diag_inv,
                          'grouped_inv': grouped_inv,
-                         'inv_chunk_phase': chunk_phase}
+                         'inv_chunk_phase': chunk_phase,
+                         **overlap_state}
             return precond, new_state
 
         precond, stats = self._spmd_precondition(
@@ -1232,6 +1419,7 @@ class DistributedKFAC:
                      'inv_stacks': inv_stacks, 'diag_inv': diag_inv,
                      'grouped_inv': grouped_inv,
                      'inv_chunk_phase': chunk_phase,
+                     **overlap_state,
                      'metrics': obs_metrics.update_metrics(
                          state['metrics'], damping=damping, stats=stats,
                          did_factor=did_f, did_inv=did_i,
@@ -1257,6 +1445,13 @@ class DistributedKFAC:
         out = {'step': state['step'], 'factors': state['factors'],
                'inv_chunk_phase': state.get(
                    'inv_chunk_phase', jnp.zeros((), jnp.int32))}
+        # r14 overlap state (deferred accumulators are device-sharded;
+        # orbax writes each device's slice): present only when the
+        # knobs are on — default checkpoints keep the historical
+        # layout (MIGRATION.md).
+        for key in ('factor_accum', 'accum_decay', 'frozen_factors'):
+            if key in state:
+                out[key] = state[key]
         if include_inverses:
             out['inv_stacks'] = state['inv_stacks']
             out['diag_inv'] = state['diag_inv']
@@ -1282,6 +1477,10 @@ class DistributedKFAC:
                  # schedule from the step counter (MIGRATION.md).
                  'inv_chunk_phase': jnp.asarray(
                      sd.get('inv_chunk_phase', 0), jnp.int32)}
+        from distributed_kfac_pytorch_tpu.preconditioner import (
+            _overlay_overlap_state,
+        )
+        state = _overlay_overlap_state(state, sd)
         # Layout compatibility: a checkpoint written under a different
         # inverse dispatch (e.g. 'eigen' stacks loaded into an 'auto'
         # config whose large buckets are 'inv'-typed) — or under a
@@ -1604,7 +1803,8 @@ class DistributedKFAC:
             return (mean(loss_sum), mean(extras_sum), mean(grads_sum),
                     contribs, updated)
 
-        def make_local_step(factor_update, inv_update, inv_chunk):
+        def make_local_step(factor_update, inv_update, inv_chunk,
+                            factor_reduce=False, factor_snapshot=False):
             def local_step(params, opt_state, kstate, extra_vars, batch,
                            hyper):
                 if dynamic_ls:
@@ -1649,7 +1849,8 @@ class DistributedKFAC:
                     factor_update_freq=hyper.get('factor_update_freq'),
                     inv_update_freq=hyper.get('inv_update_freq'),
                     factor_update=factor_update, inv_update=inv_update,
-                    inv_chunk=inv_chunk)
+                    inv_chunk=inv_chunk, factor_reduce=factor_reduce,
+                    factor_snapshot=factor_snapshot)
                 updates, new_opt_state = tx.update(precond, opt_state,
                                                    params)
                 new_params = jax.tree.map(
@@ -1722,8 +1923,10 @@ class DistributedKFAC:
                         metrics)
             return local_step
 
-        def make_step_impl(factor_update, inv_update, inv_chunk):
-            key = (factor_update, inv_update, inv_chunk)
+        def make_step_impl(factor_update, inv_update, inv_chunk,
+                           factor_reduce=False, factor_snapshot=False):
+            key = _variant_key(factor_update, inv_update, inv_chunk,
+                               factor_reduce, factor_snapshot)
 
             def step_impl(params, opt_state, kstate, extra_vars, batch,
                           hyper):
@@ -1765,7 +1968,8 @@ class DistributedKFAC:
                 )
                 fn = jax.shard_map(
                     make_local_step(factor_update, inv_update,
-                                    inv_chunk),
+                                    inv_chunk, factor_reduce,
+                                    factor_snapshot),
                     mesh=self.mesh, in_specs=in_specs,
                     out_specs=out_specs, check_vma=False)
                 return fn(params, opt_state, kstate, extra_vars, batch,
@@ -1787,25 +1991,55 @@ class DistributedKFAC:
         trace_counts: dict[tuple, int] = {}
         compile_events: list[dict] = []
 
+        deferred = self.kfac.deferred_factor_reduction
+        staleness = self.kfac.inv_staleness
+
+        def _variant_key(f, i, c, r=False, s=False):
+            """Variant-cache key. Both knobs off keeps the historical
+            3-tuple (the trace_counts guard tests pin that shape); each
+            engaged knob appends its flag — per-builder the key length
+            is constant, so lookups stay unambiguous."""
+            key = (f, i, c)
+            if deferred:
+                key += (bool(r),)
+            if staleness:
+                key += (bool(s),)
+            return key
+
         def _variant_label(key) -> str:
-            f, i, c = key
-            return f'factor={f},inv={i},chunk={c}'
+            f, i, c = key[:3]
+            label = f'factor={f},inv={i},chunk={c}'
+            extra = key[3:]
+            if deferred:
+                label += f',reduce={extra[0]}'
+                extra = extra[1:]
+            if staleness:
+                label += f',snapshot={extra[0]}'
+            return label
 
         def step(params, opt_state, kstate, extra_vars, batch, hyper,
                  factor_update: bool | None = None,
                  inv_update: bool | None = None,
-                 inv_chunk: int | None = None):
+                 inv_chunk: int | None = None,
+                 factor_reduce: bool = False,
+                 factor_snapshot: bool = False):
             """``factor_update`` / ``inv_update``: static cadence flags
             (see :meth:`KFAC.step`). ``None`` = dynamic on-device conds;
             host-driven bools select one of the statically-compiled
             program variants (the TPU fast path). ``inv_chunk``: fire
             only pipelined chunk ``j`` of the inverse work (static int;
-            requires ``inv_update`` falsy — see ``KFAC.step``)."""
-            key = (factor_update, inv_update, inv_chunk)
+            requires ``inv_update`` falsy — see ``KFAC.step``).
+            ``factor_reduce`` / ``factor_snapshot``: the r14 overlap
+            flags (see :meth:`spmd_step`) — each engaged knob's flag is
+            part of the variant key."""
+            key = _variant_key(factor_update, inv_update, inv_chunk,
+                               factor_reduce, factor_snapshot)
             first = key not in variants
             if first:
-                variants[key] = jax.jit(make_step_impl(*key),
-                                        donate_argnums=donate_argnums)
+                variants[key] = jax.jit(
+                    make_step_impl(factor_update, inv_update, inv_chunk,
+                                   factor_reduce, factor_snapshot),
+                    donate_argnums=donate_argnums)
                 t0 = time.perf_counter()
             out = variants[key](params, opt_state, kstate, extra_vars,
                                 batch, hyper)
@@ -1829,6 +2063,8 @@ class DistributedKFAC:
         # compile_events additionally feeds the r10 compile/retrace
         # telemetry (drained by engine.train_epoch).
         step.inv_pipeline_chunks = self.kfac.inv_pipeline_chunks
+        step.deferred_factor_reduction = deferred
+        step.inv_staleness = staleness
         step.trace_counts = trace_counts
         step.compile_events = compile_events
         return step

@@ -306,6 +306,52 @@ class KFAC:
         the model (validated at plan time): ms and the dim^3 proxy are
         different units and a partial dict would silently un-balance
         the packing.
+      deferred_factor_reduction: accumulate factor-statistic
+        contributions LOCALLY on factor steps and apply them to the
+        running averages only at the cadence-window boundary where the
+        inverses consume them (default False = reference parity: the
+        EWMA advances — and, under SPMD, the cross-replica factor
+        ``pmean`` fires — on every factor step). The decayed EMA is
+        linear, so the deferred form is mathematically exact at every
+        consumption point: with per-step decay ``α_i`` the boundary
+        update ``F ← (Π α_i) · F + Σ_i (Π_{j>i} α_j)(1-α_i) · c_i``
+        equals the per-step recursion, and (under SPMD)
+        ``pmean(Σ w_i c_i) = Σ w_i pmean(c_i)`` — equal up to fp
+        associativity (the summation order differs). The win is on the
+        mesh: the per-factor-step collective on the critical path
+        collapses to ONE bucketed reduction per cadence window
+        (``kfac/comm/factor_reduce``; arXiv:2107.06533's smart-overlap
+        framing, ROADMAP item 2). Static-cadence only — the reduce is
+        static program structure like ``inv_chunk`` (the engine passes
+        ``factor_reduce=True`` on window-head steps). Scope notes:
+        mid-window chunk firings (``inv_pipeline_chunks > 1``) see the
+        factors as of the last window-head reduction (the staleness
+        profile of ``inv_staleness=1`` rather than r9's
+        fresher-mid-window factors); with ``nonfinite_guard`` the
+        finiteness check moves to the reduce point's post-average
+        candidate (collective-safe, unchanged), so a poisoned window
+        is skipped WHOLE — the accumulator resets either way.
+      inv_staleness: 0 (default) or 1. At 1, the decompositions
+        consumed during cadence window ``w+1`` are computed from
+        factors FROZEN at the end of window ``w`` (a snapshot carried
+        in ``state['frozen_factors']``, refreshed on window-head
+        steps) and fired across the window's plain steps: chunk ``j``
+        fires at phase ``j * inv_update_freq/k + 1`` instead of r9's
+        ``j * stride`` (with ``inv_pipeline_chunks == 1`` the whole
+        firing runs as one chunk at phase 1). Because the firing reads
+        the snapshot, it has NO data dependency on the firing step's
+        forward/backward or factor update — XLA can overlap the eigh
+        with the step's compute and collectives instead of serializing
+        behind them (arXiv:2206.15143's off-critical-path inverses),
+        and the +1 phase offset keeps the spike off the window-head
+        step that pays the factor reduction. Preconditioning applies a
+        one-window-stale inverse (the monolithic k=1 staleness
+        profile; strictly staler than r9's mid-window chunks) — gate
+        promotion on a convergence A/B exactly like r9's (PERF.md
+        r14). Step 0 still fires monolithically from the fresh
+        snapshot (slots are zero-seeded). Static-cadence only.
+        Requires ``inv_update_freq / inv_pipeline_chunks >= 2`` so the
+        shifted phases stay inside the window.
       nonfinite_guard: skip the factor EWMA update when the candidate
         factors are non-finite (a NaN/Inf gradient/capture batch would
         otherwise poison the running averages forever — EWMA keeps
@@ -344,6 +390,8 @@ class KFAC:
                  precond_bucketing: bool = True,
                  inv_pipeline_chunks: int = 1,
                  inv_pipeline_costs: dict | None = None,
+                 deferred_factor_reduction: bool = False,
+                 inv_staleness: int = 0,
                  kfac_approx: Any = 'expand',
                  tied_embeddings: bool | None = None,
                  skip_layers: str | Sequence[str] | None = None,
@@ -379,6 +427,21 @@ class KFAC:
                     'firings will reuse stale factors '
                     f'({inv_update_freq=} {inv_pipeline_chunks=} '
                     f'{factor_update_freq=})')
+        if inv_staleness not in (0, 1):
+            raise ValueError(
+                f'{inv_staleness=} must be 0 or 1 (one-window-stale '
+                'off-critical-path inverses; deeper staleness is not '
+                'supported)')
+        if inv_staleness == 1:
+            k = max(1, inv_pipeline_chunks)
+            if inv_update_freq % k != 0 or inv_update_freq // k < 2:
+                raise ValueError(
+                    'inv_staleness=1 fires chunk j at phase '
+                    'j*(inv_update_freq/inv_pipeline_chunks)+1 of each '
+                    'window, which needs inv_update_freq/'
+                    'inv_pipeline_chunks >= 2 so the shifted phases '
+                    f'stay inside the window ({inv_update_freq=} '
+                    f'{inv_pipeline_chunks=})')
         if assignment_strategy not in ('compute', 'memory'):
             raise ValueError("assignment_strategy must be 'compute' or "
                              "'memory'")
@@ -480,6 +543,8 @@ class KFAC:
         self.inv_pipeline_chunks = inv_pipeline_chunks
         self.inv_pipeline_costs = (dict(inv_pipeline_costs)
                                    if inv_pipeline_costs else None)
+        self.deferred_factor_reduction = bool(deferred_factor_reduction)
+        self.inv_staleness = int(inv_staleness)
         self.symmetry_aware_comm = symmetry_aware_comm
         self.assignment_strategy = assignment_strategy
         self.comm_method = comm_method
@@ -500,6 +565,7 @@ class KFAC:
                   'factor_compute_dtype', 'inv_dtype',
                   'precond_compute_dtype', 'precond_bucketing',
                   'inv_pipeline_chunks',
+                  'deferred_factor_reduction', 'inv_staleness',
                   'kfac_approx', 'tied_embeddings',
                   'symmetry_aware_comm',
                   'assignment_strategy', 'comm_method',
@@ -546,6 +612,16 @@ class KFAC:
     # ------------------------------------------------------------------
     # Pipelined inverse firing: chunk planning
     # ------------------------------------------------------------------
+
+    @property
+    def pipelined_firing(self) -> bool:
+        """True when the in-window chunk-firing machinery is engaged:
+        ``inv_pipeline_chunks > 1`` (r9), or ``inv_staleness == 1`` —
+        which chunk-fires even a single chunk mid-window from the
+        frozen snapshot (at ``k == 1`` the plan is one chunk holding
+        every work item, so the per-firing program keeps the
+        monolithic shape)."""
+        return self.inv_pipeline_chunks > 1 or self.inv_staleness == 1
 
     def inverse_chunk_items(self, factors: dict
                             ) -> list[tuple[tuple, float]]:
@@ -763,7 +839,24 @@ class KFAC:
                  # restore of pre-r9 bundles defaults it to 0
                  # (MIGRATION.md).
                  'inv_chunk_phase': jnp.zeros((), jnp.int32)}
-        if self.inv_pipeline_chunks > 1:
+        if self.deferred_factor_reduction:
+            # Local pre-reduction accumulator (the decayed sum of
+            # factor contributions since the last window-boundary
+            # reduce) + the matching running decay product. Zero/one
+            # seeds = "nothing accumulated" (the boundary update is
+            # then the identity).
+            state['factor_accum'] = jax.tree.map(jnp.zeros_like,
+                                                 factors)
+            state['accum_decay'] = jnp.ones((), jnp.float32)
+        if self.inv_staleness:
+            # The window-head factor snapshot the in-window firings
+            # decompose (refreshed on factor_snapshot/inv_update
+            # steps). Seeded with the identity-seeded factors — step 0
+            # fires monolithically from a fresh snapshot before any
+            # slot is consumed.
+            state['frozen_factors'] = jax.tree.map(lambda x: x,
+                                                   factors)
+        if self.pipelined_firing:
             # Eager validation: the chunk count must not exceed the
             # model's inverse work buckets (raises with the bucket
             # count); the plan itself is recomputed statically at trace
@@ -811,6 +904,34 @@ class KFAC:
     # The pipeline stages (pure; called under jit)
     # ------------------------------------------------------------------
 
+    def factor_contribs(self, captures: dict) -> dict:
+        """Combined per-layer covariance contribution of one batch.
+
+        The pre-EWMA half of :meth:`update_factors`: ``{name: {'A',
+        'G'}}`` with the tied-embedding attend extras already folded in
+        (single-chip captures are global, so no world rescale — cf.
+        the SPMD path's g_scale). Shared by the eager EWMA path and the
+        deferred-reduction accumulator so the contribution math cannot
+        drift between them.
+        """
+        cdt = self.factor_compute_dtype
+        captures = subsample_captures(captures, self.factor_batch_fraction)
+        out = {}
+        for name, spec in self.specs.items():
+            a_new = L.compute_a_factor(spec, captures[name]['a'],
+                                       compute_dtype=cdt)
+            g_new = L.compute_g_factor(spec, captures[name]['g'],
+                                       compute_dtype=cdt)
+            extras = L.compute_tied_factor_extras(spec, captures[name],
+                                                  compute_dtype=cdt)
+            if extras is not None:
+                # Tied embedding: the attend call site folds into the
+                # SAME factor pair.
+                a_new = a_new + extras['A_g2']
+                g_new = g_new + extras['G_a']
+            out[name] = {'A': a_new, 'G': g_new}
+        return out
+
     @profiling.scope('kfac/factors')
     def update_factors(self, state: dict, captures: dict,
                        factor_decay=None) -> dict:
@@ -821,28 +942,54 @@ class KFAC:
         contraction over the batch-sharded captures.
         """
         alpha = self.factor_decay if factor_decay is None else factor_decay
-        cdt = self.factor_compute_dtype
-        captures = subsample_captures(captures, self.factor_batch_fraction)
+        contribs = self.factor_contribs(captures)
         new_factors = {}
-        for name, spec in self.specs.items():
-            a_new = L.compute_a_factor(spec, captures[name]['a'],
-                                       compute_dtype=cdt)
-            g_new = L.compute_g_factor(spec, captures[name]['g'],
-                                       compute_dtype=cdt)
-            extras = L.compute_tied_factor_extras(spec, captures[name],
-                                                  compute_dtype=cdt)
-            if extras is not None:
-                # Tied embedding: the attend call site folds into the
-                # SAME factor pair (single-chip captures are global, so
-                # no world rescale — cf. the SPMD path's g_scale).
-                a_new = a_new + extras['A_g2']
-                g_new = g_new + extras['G_a']
+        for name in self.specs:
             old = state['factors'][name]
-            a_new = a_new.astype(old['A'].dtype)
-            g_new = g_new.astype(old['G'].dtype)
+            a_new = contribs[name]['A'].astype(old['A'].dtype)
+            g_new = contribs[name]['G'].astype(old['G'].dtype)
             new_factors[name] = {
                 'A': F.update_running_avg(a_new, old['A'], alpha),
                 'G': F.update_running_avg(g_new, old['G'], alpha)}
+        return new_factors
+
+    @profiling.scope('kfac/factors')
+    def accumulate_factors(self, state: dict, captures: dict,
+                           factor_decay=None) -> tuple[dict, jax.Array]:
+        """Deferred-reduction factor step: fold one batch's contribution
+        into the local accumulator, leave the running averages alone.
+
+        ``acc ← α·acc + (1-α)·c`` and ``decay ← α·decay``; at the
+        window boundary :meth:`reduce_factors` applies
+        ``F ← decay·F + acc`` — by EMA linearity exactly the per-step
+        recursion's value at the boundary (up to fp associativity).
+        Returns ``(new_accum, new_decay)``.
+        """
+        alpha = self.factor_decay if factor_decay is None else factor_decay
+        contribs = self.factor_contribs(captures)
+        acc = state['factor_accum']
+        new_acc = {}
+        for name in self.specs:
+            old = acc[name]
+            new_acc[name] = {
+                which: F.update_running_avg(
+                    contribs[name][which].astype(old[which].dtype),
+                    old[which], alpha)
+                for which in ('A', 'G')}
+        return new_acc, alpha * state['accum_decay']
+
+    @profiling.scope('kfac/factors')
+    def reduce_factors(self, state: dict, acc: dict, decay) -> dict:
+        """Deferred-reduction window boundary: apply the accumulated
+        contributions to the running averages (single-chip form — no
+        collective; the SPMD analogue pmeans ``acc`` first)."""
+        new_factors = {}
+        for name in self.specs:
+            old = state['factors'][name]
+            new_factors[name] = {
+                which: (decay * old[which]
+                        + acc[name][which]).astype(old[which].dtype)
+                for which in ('A', 'G')}
         return new_factors
 
     def _bucketed_eigh(self, mats: dict[str, jax.Array],
@@ -916,9 +1063,10 @@ class KFAC:
         firing (test-pinned).
         """
         plan = (self.inverse_chunk_plan(state['factors'])
-                if self.inv_pipeline_chunks > 1 else None)
+                if self.pipelined_firing else None)
         if chunk is not None and plan is None:
-            raise ValueError('inv_chunk requires inv_pipeline_chunks > 1')
+            raise ValueError('inv_chunk requires inv_pipeline_chunks > 1 '
+                             'or inv_staleness=1')
 
         def fires(key: tuple) -> bool:
             return chunk is None or plan[key] == chunk
@@ -1161,7 +1309,9 @@ class KFAC:
              factor_update_freq=None, inv_update_freq=None,
              factor_update: bool | None = None,
              inv_update: bool | None = None,
-             inv_chunk: int | None = None) -> tuple[dict, dict]:
+             inv_chunk: int | None = None,
+             factor_reduce: bool = False,
+             factor_snapshot: bool = False) -> tuple[dict, dict]:
         """One K-FAC update: returns (preconditioned_grads, new_state).
 
         The analogue of reference KFAC.step() (preconditioner.py:472-523).
@@ -1192,6 +1342,17 @@ class KFAC:
         dynamic (``None``-flag) path always fires monolithically —
         chunking is a static-program-structure feature by design
         (PERF.md pitfall 2).
+
+        ``factor_reduce`` (requires ``deferred_factor_reduction``,
+        static): apply the locally-accumulated factor contributions to
+        the running averages this step — the single collective per
+        window on the SPMD path. ``factor_snapshot`` (requires
+        ``inv_staleness=1``, static): refresh ``frozen_factors`` from
+        this step's post-update factors (window-head steps); in-window
+        chunk firings always decompose the carried snapshot, and a
+        monolithic ``inv_update=True`` firing snapshots-then-fires
+        (eager semantics — the step-0 warmup). Both features are
+        static-cadence only: dynamic (``None``) flags raise.
         """
         damping = self.damping if damping is None else damping
         lr = self.lr if lr is None else lr
@@ -1202,22 +1363,80 @@ class KFAC:
         step = state['step']
 
         track = self.collect_metrics or self.nonfinite_guard
-        if track:
-            # Tracked form: the factor branch additionally yields the
-            # candidate factors' finiteness flag (guard + metrics).
-            factors, finite_f = cadence_gate(
-                factor_update, step, f_freq,
-                lambda: self._tracked_factor_update(state, captures,
-                                                    factor_decay),
-                lambda: (state['factors'], jnp.ones((), jnp.int32)))
+        if self.deferred_factor_reduction:
+            # Deferred reduce: the EWMA (and, under SPMD, the factor
+            # collective) advances only on factor_reduce steps; factor
+            # steps fold into the local accumulator. Static cadence
+            # only — the boundary update is program structure.
+            if factor_update is None:
+                raise ValueError(
+                    'deferred_factor_reduction requires static cadence '
+                    'flags (Python-bool factor_update/factor_reduce) — '
+                    'the window-boundary reduce is static program '
+                    'structure, like inv_chunk')
+            acc, decay = state['factor_accum'], state['accum_decay']
+            if factor_update:
+                acc, decay = self.accumulate_factors(state, captures,
+                                                     factor_decay)
+            if factor_reduce:
+                candidate = self.reduce_factors(state, acc, decay)
+                # Guard/metrics check the post-accumulation candidate
+                # at the reduce point (the collective-safe analogue of
+                # the eager per-step check); a non-finite window is
+                # skipped WHOLE and the accumulator resets either way.
+                factors, finite_f = guard_nonfinite_factors(
+                    candidate, state['factors'], self.nonfinite_guard)
+                acc = jax.tree.map(jnp.zeros_like, acc)
+                decay = jnp.ones((), jnp.float32)
+            else:
+                factors = state['factors']
+                finite_f = jnp.ones((), jnp.int32)
+            state_f = {**state, 'factors': factors,
+                       'factor_accum': acc, 'accum_decay': decay}
         else:
-            # Metrics/guard off: the historical program, untouched
-            # (bit-identity pinned by tests/test_observability.py).
-            factors = cadence_gate(
-                factor_update, step, f_freq,
-                lambda: self.update_factors(state, captures, factor_decay),
-                lambda: state['factors'])
-        state_f = {**state, 'factors': factors}
+            if factor_reduce:
+                raise ValueError(
+                    'factor_reduce requires '
+                    'deferred_factor_reduction=True')
+            if track:
+                # Tracked form: the factor branch additionally yields
+                # the candidate factors' finiteness flag
+                # (guard + metrics).
+                factors, finite_f = cadence_gate(
+                    factor_update, step, f_freq,
+                    lambda: self._tracked_factor_update(state, captures,
+                                                        factor_decay),
+                    lambda: (state['factors'], jnp.ones((), jnp.int32)))
+            else:
+                # Metrics/guard off: the historical program, untouched
+                # (bit-identity pinned by tests/test_observability.py).
+                factors = cadence_gate(
+                    factor_update, step, f_freq,
+                    lambda: self.update_factors(state, captures,
+                                                factor_decay),
+                    lambda: state['factors'])
+            state_f = {**state, 'factors': factors}
+
+        if self.inv_staleness:
+            if inv_update is None:
+                raise ValueError(
+                    'inv_staleness=1 requires static cadence flags '
+                    '(the frozen-snapshot firing schedule is static '
+                    'program structure, like inv_chunk)')
+            # Window-head steps (and a monolithic firing — the step-0
+            # warmup, which must decompose the step's fresh factors,
+            # not the identity seeds) refresh the snapshot; everything
+            # else decomposes the carried one.
+            frozen = (state_f['factors']
+                      if factor_snapshot or inv_update
+                      else state['frozen_factors'])
+            state_f = {**state_f, 'frozen_factors': frozen}
+            fire_state = {**state_f, 'factors': frozen}
+        else:
+            if factor_snapshot:
+                raise ValueError(
+                    'factor_snapshot requires inv_staleness=1')
+            fire_state = state_f
 
         if inv_chunk is not None:
             k = self.inv_pipeline_chunks
@@ -1231,13 +1450,13 @@ class KFAC:
                     f'{inv_chunk=} out of range for '
                     f'inv_pipeline_chunks={k}')
             with profiling.annotate(f'kfac/inverse/chunk{inv_chunk}'):
-                inverses = self.update_inverses(state_f, damping,
+                inverses = self.update_inverses(fire_state, damping,
                                                 chunk=inv_chunk)
             chunk_phase = jnp.asarray((inv_chunk + 1) % k, jnp.int32)
         else:
             inverses = cadence_gate(
                 inv_update, step, i_freq,
-                lambda: self.update_inverses(state_f, damping),
+                lambda: self.update_inverses(fire_state, damping),
                 lambda: state['inverses'])
             # Static monolithic firing resets the pipeline position;
             # otherwise (no firing, or the dynamic cond path — which
@@ -1294,6 +1513,11 @@ class KFAC:
         out = {'step': state['step'], 'factors': state['factors'],
                'inv_chunk_phase': state.get(
                    'inv_chunk_phase', jnp.zeros((), jnp.int32))}
+        # r14 overlap state: present only when the knobs are on, so
+        # default checkpoints keep the historical layout (MIGRATION.md).
+        for key in ('factor_accum', 'accum_decay', 'frozen_factors'):
+            if key in state:
+                out[key] = state[key]
         if include_inverses:
             out['inverses'] = state['inverses']
         return out
@@ -1317,6 +1541,7 @@ class KFAC:
                  # engine re-derives the schedule from the step counter).
                  'inv_chunk_phase': jnp.asarray(
                      sd.get('inv_chunk_phase', 0), jnp.int32)}
+        state = _overlay_overlap_state(state, sd)
         # A checkpoint written under a different inverse layout (e.g.
         # 'eigen' saved, 'auto' loading) is structurally incompatible —
         # rebuild from factors instead of splicing mismatched slots in.
@@ -1333,6 +1558,49 @@ class KFAC:
                      'inverses': self.update_inverses(state, self.damping,
                                                       warm=False)}
         return state
+
+
+def _overlay_overlap_state(state: dict, sd: dict) -> dict:
+    """Restore the r14 compute/communication-overlap state fields.
+
+    ``factor_accum``/``accum_decay`` (deferred factor reduction) and
+    ``frozen_factors`` (inv_staleness=1) are overlaid from the
+    checkpoint when the live config carries them AND the saved shapes
+    match; otherwise the init seeds stand — pre-r14 bundles (and
+    cross-topology elastic restores, whose per-device accumulator
+    stacks cannot transfer) resume as "eager reduce / snapshot =
+    restored factors": at most one window of un-reduced statistics is
+    dropped, and the snapshot seeds from the factors the checkpoint
+    DID reduce (never the identity). The accumulator and its decay
+    product move together — splicing one without the other would
+    decay the factors without the compensating contributions
+    (MIGRATION.md). Single point of truth for the single-chip and
+    SPMD loaders.
+    """
+    import numpy as np
+    out = dict(state)
+    if 'frozen_factors' in state:
+        frozen = sd.get('frozen_factors')
+        compatible = frozen is not None and jax.tree.structure(
+            frozen) == jax.tree.structure(state['frozen_factors'])
+        out['frozen_factors'] = (frozen if compatible
+                                 else jax.tree.map(lambda x: x,
+                                                   out['factors']))
+    if 'factor_accum' in state:
+        acc = sd.get('factor_accum')
+        compatible = (
+            acc is not None and 'accum_decay' in sd
+            and jax.tree.structure(acc) == jax.tree.structure(
+                state['factor_accum'])
+            and all(tuple(np.shape(a)) == tuple(np.shape(b))
+                    for a, b in zip(jax.tree.leaves(acc),
+                                    jax.tree.leaves(
+                                        state['factor_accum']))))
+        if compatible:
+            out['factor_accum'] = acc
+            out['accum_decay'] = jnp.asarray(sd['accum_decay'],
+                                             jnp.float32)
+    return out
 
 
 def guard_nonfinite_factors(new_factors: dict, old_factors: dict,

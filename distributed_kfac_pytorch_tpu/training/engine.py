@@ -22,7 +22,9 @@ from distributed_kfac_pytorch_tpu.training.utils import Metric, accuracy
 
 
 def cadence_flags(step: int, factor_update_freq, inv_update_freq,
-                  inv_pipeline_chunks: int = 1) -> dict:
+                  inv_pipeline_chunks: int = 1, *,
+                  deferred_reduce: bool = False,
+                  inv_staleness: int = 0) -> dict:
     """Static cadence flags for one host step (single point of truth).
 
     The classic schedule fires the whole inverse update at
@@ -36,29 +38,62 @@ def cadence_flags(step: int, factor_update_freq, inv_update_freq,
     over from the first window's later phases onward. Each distinct
     flag combination is its own statically-compiled program variant
     (PERF.md pitfalls 2-3).
+
+    r14 overlap knobs (read off the step builder's attributes by
+    ``train_epoch``): ``deferred_reduce`` adds ``factor_reduce=True``
+    on window-head steps — the one bucketed factor collective per
+    window. ``inv_staleness=1`` re-times the firing schedule: window
+    heads (past step 0) take a factor SNAPSHOT instead of firing, and
+    chunk ``j`` fires at phase ``j * stride + 1`` from that snapshot —
+    one step after the head, so the decomposition never shares a step
+    with the window's factor reduction and carries no data dependency
+    on its own step's factor work (with ``k == 1`` the whole firing
+    runs as chunk 0 at phase 1). Step 0 stays a monolithic warmup
+    either way.
     """
     f_freq, i_freq = int(factor_update_freq), int(inv_update_freq)
     k = int(inv_pipeline_chunks)
+    phase = step % i_freq
     flags = {'factor_update': step % f_freq == 0}
-    if k > 1 and i_freq % k == 0:
+    if int(inv_staleness) == 1 and i_freq % k == 0 and i_freq // k >= 2:
         stride = i_freq // k
-        phase = step % i_freq
+        flags['inv_update'] = step == 0
+        if step != 0:
+            if phase == 0:
+                flags['factor_snapshot'] = True
+            elif (phase - 1) % stride == 0 and (phase - 1) // stride < k:
+                flags['inv_chunk'] = (phase - 1) // stride
+    elif k > 1 and i_freq % k == 0:
+        stride = i_freq // k
         flags['inv_update'] = step == 0
         if step != 0 and phase % stride == 0:
             flags['inv_chunk'] = phase // stride
     else:
         flags['inv_update'] = step % i_freq == 0
+    if deferred_reduce:
+        flags['factor_reduce'] = phase == 0
     return flags
 
 
 def fired_stage(flags: dict) -> str | None:
     """Most expensive stage a step's static flags fire (for step-time
     attribution in the metrics stream): 'inverse' > 'chunk<j>' >
-    'factor' > None. The report's outlier attribution consumes this."""
+    'reduce' (the deferred window-boundary factor collective, r14) >
+    'factor' > None. A firing step that ALSO pays the deferred reduce
+    (the non-staleness combos put both on the window head) gets a
+    compound label ('inverse+reduce' / 'chunk<j>+reduce') so the
+    straggler merger's comm-wait split can still see the factor
+    collective — classing those steps as collective-free 'firing'
+    would hide the one real factor reduction per window from exactly
+    the attribution the r14 decision rule reads. The report's outlier
+    attribution and the merger's split consume this."""
+    reduce_tag = '+reduce' if flags.get('factor_reduce') else ''
     if flags.get('inv_update'):
-        return 'inverse'
+        return 'inverse' + reduce_tag
     if flags.get('inv_chunk') is not None:
-        return f"chunk{flags['inv_chunk']}"
+        return f"chunk{flags['inv_chunk']}" + reduce_tag
+    if flags.get('factor_reduce'):
+        return 'reduce'
     if flags.get('factor_update'):
         return 'factor'
     return None
@@ -86,6 +121,7 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
                 metrics_sink=None, checkpointer=None,
                 start_step_in_epoch: int = 0,
                 rank_sink=None, barrier_probe=None,
+                straggler_sample_every: int = 1,
                 memory_interval: int = 0,
                 cadence_policy=None) -> dict[str, float]:
     """One training epoch; returns averaged metrics.
@@ -142,6 +178,15 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
     on device completion each step (that is what it measures), so it
     costs async-dispatch pipelining — only wired when straggler
     attribution is requested.
+
+    ``straggler_sample_every``: probe only on steps where
+    ``step % N == 0`` (r14) — the probe's host-sync cost then
+    amortizes to 1/N of the run, cheap enough to leave on in long
+    runs. Every rank samples the SAME steps (the schedule is a pure
+    function of the global step), so the merger's common-step skew
+    analysis still lines up; non-sampled steps simply carry no wait
+    field (report/merge handle the sparse shards). 1 (default) = the
+    r10 every-step probe.
 
     ``memory_interval``: every Nth step, emit a ``kind='memory'``
     record into ``metrics_sink`` — device allocator watermarks plus the
@@ -206,7 +251,8 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
     # cannot divide evenly (e.g. a KFACParamScheduler freq decay)
     # falls back to monolithic firing for the epoch rather than
     # mis-phasing the pipeline.
-    chunks = int(getattr(step_fn, 'inv_pipeline_chunks', 1) or 1)
+    built_chunks = int(getattr(step_fn, 'inv_pipeline_chunks', 1) or 1)
+    chunks = built_chunks
     if (chunks > 1 and static_cadence is not None
             and int(static_cadence[1]) % chunks != 0):
         import warnings
@@ -215,6 +261,45 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
             f'epoch\'s inv_update_freq={static_cadence[1]} — firing '
             'monolithically for the epoch')
         chunks = 1
+    # r14 overlap knobs, advertised by the step builder like the chunk
+    # count. A schedule the shifted staleness phases cannot fit
+    # (stride < 2, or a non-dividing chunk count, after a
+    # KFACParamScheduler freq decay) falls back to eager MONOLITHIC
+    # window-head firing for the epoch: the inv_update=True program
+    # snapshots-then-fires (eager semantics), whereas any partial
+    # chunk schedule against the BUILT chunk count would either
+    # mis-phase the pipeline or leave the carried snapshot stale
+    # forever. The check uses ``built_chunks`` — the chunk plan baked
+    # into the compiled programs — not the fallen-back count.
+    deferred_reduce = bool(getattr(step_fn, 'deferred_factor_reduction',
+                                   False))
+    inv_staleness = int(getattr(step_fn, 'inv_staleness', 0) or 0)
+    if (deferred_reduce or inv_staleness) and static_cadence is None:
+        # Fail BEFORE the epoch with the real reason: the step itself
+        # would raise the same contract mid-epoch at trace time, right
+        # after the 'falling back to on-device cadence conds' warning
+        # promised a fallback that cannot exist for these knobs (a
+        # dynamic cond cannot host the window-boundary reduce or the
+        # frozen-snapshot firing schedule — both are static program
+        # structure).
+        raise RuntimeError(
+            'deferred_factor_reduction/inv_staleness require the '
+            'static-cadence fast path: pass static_cadence=(f, i) or '
+            "include 'factor_update_freq'/'inv_update_freq' in hyper "
+            '(the window-boundary reduce and the frozen-snapshot '
+            'firing schedule are static program structure)')
+    if (inv_staleness and static_cadence is not None
+            and (int(static_cadence[1]) % built_chunks != 0
+                 or int(static_cadence[1]) // built_chunks < 2)):
+        import warnings
+        warnings.warn(
+            f'inv_staleness=1 with inv_pipeline_chunks='
+            f'{built_chunks} does not fit this epoch\'s '
+            f'inv_update_freq={static_cadence[1]} (needs freq/chunks '
+            '>= 2) — firing eagerly/monolithically at window heads '
+            'for the epoch')
+        inv_staleness = 0
+        chunks = 1
     meters: dict[str, Metric] = {}
     t0 = time.perf_counter()
     n_batches = 0
@@ -222,11 +307,15 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
     for batch in batches:
         if static_cadence is not None:
             f_freq, i_freq = static_cadence
-            flags = cadence_flags(state.step, f_freq, i_freq, chunks)
+            flags = cadence_flags(state.step, f_freq, i_freq, chunks,
+                                  deferred_reduce=deferred_reduce,
+                                  inv_staleness=inv_staleness)
         else:
             flags = {}
         wait_ms = None
-        if barrier_probe is not None:
+        if barrier_probe is not None and (
+                straggler_sample_every <= 1
+                or state.step % straggler_sample_every == 0):
             # Straggler attribution: how long does THIS host wait for
             # the rest of the mesh before its next collective could
             # proceed? Measured before the dispatch so the wait is not

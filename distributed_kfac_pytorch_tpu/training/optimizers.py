@@ -88,6 +88,19 @@ class OptimConfig:
     # decomposition spike across the window (step-time uniformity).
     # 1 (default) = reference parity, monolithic firing, bit-identical.
     inv_pipeline_chunks: int = 1
+    # Deferred factor reduction (r14): accumulate factor statistics
+    # locally on factor steps and reduce across replicas once per
+    # cadence window (one bucketed collective where the eager path
+    # pays a per-factor-step pmean). Mathematically exact by EMA
+    # linearity; off (default) = bit-identical eager path.
+    deferred_factor_reduction: bool = False
+    # One-window-stale off-critical-path inverses (r14): 0 (default,
+    # bit-identical) or 1 — decompositions for window w+1 are computed
+    # from factors frozen at the end of window w and chunk-fired
+    # across w+1's plain steps, so the eigh spike overlaps plain
+    # compute instead of blocking the mesh. Convergence-gated like the
+    # r9 chunk knob (PERF.md r14).
+    inv_staleness: int = 0
     # Weight-sharing Kronecker approximation (r13, arXiv:2311.00636):
     # 'expand' (default — bit-identical pre-sharing path) or 'reduce'
     # (sequence/patch-shared Denses + patch-embed convs reduce over the
@@ -125,6 +138,8 @@ TUNABLE_FIELDS = (
     'bf16_factors',
     'bf16_inverses',
     'inv_pipeline_chunks',
+    'deferred_factor_reduction',
+    'inv_staleness',
     'factor_batch_fraction',
     'kfac_cov_update_freq',
     'kfac_inv_update_freq',
@@ -219,6 +234,8 @@ def get_optimizer(model, cfg: OptimConfig):
             precond_compute_dtype=(jnp.bfloat16 if cfg.bf16_precond
                                    else None),
             inv_pipeline_chunks=cfg.inv_pipeline_chunks,
+            deferred_factor_reduction=cfg.deferred_factor_reduction,
+            inv_staleness=cfg.inv_staleness,
             kfac_approx=cfg.kfac_approx,
             skip_layers=list(cfg.skip_layers) or None,
             symmetry_aware_comm=cfg.symmetry_aware_comm,

@@ -103,6 +103,23 @@ def parse_args(argv=None):
                         'reference parity (monolithic firing). K must '
                         'divide --kfac-update-freq and not exceed the '
                         "model's inverse bucket count")
+    p.add_argument('--deferred-factor-reduction', action='store_true',
+                   help='accumulate factor statistics locally and '
+                        'reduce across replicas once per cadence '
+                        'window instead of every factor step (r14 '
+                        'compute/communication overlap; exact by EMA '
+                        'linearity — off (default) keeps the '
+                        'bit-identical eager per-step reduction)')
+    p.add_argument('--inv-staleness', type=int, default=0,
+                   choices=[0, 1],
+                   help='1 = one-window-stale off-critical-path '
+                        'inverses (r14): decompositions fire across '
+                        "the window's plain steps from the frozen "
+                        'window-head factor snapshot, overlapping '
+                        'plain compute instead of blocking the mesh '
+                        '(needs update-freq/chunks >= 2). '
+                        'Convergence-gated like --inv-pipeline-chunks '
+                        '(PERF.md r14)')
     p.add_argument('--kfac-cov-update-freq', type=int, default=10)
     p.add_argument('--kfac-approx', default='expand',
                    choices=['expand', 'reduce'],
@@ -262,6 +279,8 @@ def main(argv=None):
         kfac_inv_update_freq=args.kfac_update_freq,
         kfac_cov_update_freq=args.kfac_cov_update_freq,
         inv_pipeline_chunks=args.inv_pipeline_chunks,
+        deferred_factor_reduction=args.deferred_factor_reduction,
+        inv_staleness=args.inv_staleness,
         kfac_approx=args.kfac_approx,
         damping=args.damping, factor_decay=args.stat_decay,
         kl_clip=args.kl_clip, inverse_method=args.inverse_method,
@@ -448,6 +467,7 @@ def main(argv=None):
                     metrics_sink=metrics_sink, checkpointer=step_ckpt,
                     start_step_in_epoch=skip,
                     rank_sink=rank_sink, barrier_probe=barrier_probe,
+                    straggler_sample_every=args.straggler_sample_every,
                     memory_interval=args.memory_interval,
                     cadence_policy=cadence_policy)
             if args.precise_bn_batches > 0:

@@ -1,0 +1,109 @@
+#!/usr/bin/env bash
+# r14 artifact generation (CPU provenance — see PERF.md r14): the
+# compute/communication-overlap evidence set. Rerun on v5e before
+# promoting either knob (decision rule: PERF.md r14).
+#   FLAGSHIP_LM_r14_STALENESS.jsonl  eager-vs-stale LM loss curves
+#   CONVERGENCE_R14_STALENESS_GN_S{0,1}.json  GN-conv A/B (S1 = both
+#       knobs on: inv_staleness=1 + deferred reduce)
+#   BENCH_r14_OVERLAP.json  straggler-shard before/after: per-leg
+#       step-time distribution + comm-wait-by-stage attribution from
+#       an 8-virtual-device run of the real LM CLI
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# 1) LM staleness convergence A/B (identical hyperparameters; the
+#    'stale' leg runs inv_staleness=1 + deferred_factor_reduction).
+JAX_PLATFORMS=cpu python benchmarks/flagship_lm.py --staleness-ab \
+    --ladder 128 256 --ab-steps 60 --ab-seq 64 --ab-batch 8 \
+    --ab-vocab 512 --ab-layers 2 --ab-f 5 --ab-i 20 \
+    > FLAGSHIP_LM_r14_STALENESS.jsonl.tmp
+mv FLAGSHIP_LM_r14_STALENESS.jsonl.tmp FLAGSHIP_LM_r14_STALENESS.jsonl
+
+# 2) GN-conv convergence A/B (the r4/r9 study's control model).
+python benchmarks/convergence.py --model resnet20gn --epochs 8 \
+    --batch-size 128 --synthetic-size 2048 --kfac-update-freq 10 \
+    --only kfac --platform cpu \
+    --out CONVERGENCE_R14_STALENESS_GN_S0.json
+python benchmarks/convergence.py --model resnet20gn --epochs 8 \
+    --batch-size 128 --synthetic-size 2048 --kfac-update-freq 10 \
+    --only kfac --inv-staleness 1 --deferred-factor-reduction \
+    --platform cpu --out CONVERGENCE_R14_STALENESS_GN_S1.json
+
+# 3) Straggler-shard before/after on the 8-virtual-device mesh: the
+#    factor-step barrier wait and firing-step spike the overlap moves.
+out="$(mktemp -d)"; trap 'rm -rf "$out"' EXIT
+run_leg() {  # $1 = leg name, extra CLI args follow
+    local leg="$1"; shift
+    JAX_PLATFORMS=cpu KFAC_COMPILE_CACHE=0 KFAC_SYNTHETIC_LM=4096 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
+    python - "$leg" "$out" "$@" <<'EOF'
+import sys
+from distributed_kfac_pytorch_tpu.utils import (
+    raise_cpu_collective_timeouts,
+)
+raise_cpu_collective_timeouts()
+from examples import train_language_model as lm
+
+leg, out, *extra = sys.argv[1:]
+rc = lm.main([
+    '--arch', 'transformer', '--emsize', '64', '--nlayers', '1',
+    '--nheads', '2', '--bptt', '16', '--batch-size', '8',
+    '--epochs', '2', '--no-resume', '--kfac-update-freq', '8',
+    '--kfac-cov-update-freq', '2',
+    '--log-dir', f'{out}/logs-{leg}',
+    '--checkpoint-dir', f'{out}/ckpt-{leg}',
+    '--kfac-metrics', f'{out}/{leg}.jsonl', '--metrics-interval', '1',
+    '--straggler-shards', *extra])
+sys.exit(rc)
+EOF
+}
+run_leg eager
+run_leg overlap --inv-pipeline-chunks 2 \
+    --deferred-factor-reduction --inv-staleness 1
+
+python - "$out" <<'EOF'
+import json, subprocess, sys
+
+out = sys.argv[1]
+legs = {}
+for leg in ('eager', 'overlap'):
+    rep = json.loads(subprocess.run(
+        [sys.executable, '-m',
+         'distributed_kfac_pytorch_tpu.observability.report',
+         f'{out}/{leg}.jsonl', '--json'],
+        capture_output=True, text=True, check=True,
+        env={**__import__('os').environ,
+             'JAX_PLATFORMS': 'cpu'}).stdout)
+    st = rep['step_time']
+    sg = rep['stragglers'] or {}
+    legs[leg] = {
+        'n_steps': st['n_steps'],
+        'p50_ms': st['p50_ms'], 'p95_ms': st['p95_ms'],
+        'p99_ms': st['p99_ms'], 'max_ms': st['max_ms'],
+        'max_over_median': st['max_over_median'],
+        'outlier_stages': {k: v for k, v in st['stages'].items()
+                           if v['outliers']},
+        'wait_by_stage': sg.get('wait_by_stage'),
+        'mean_skew_ms': sg.get('mean_skew_ms'),
+        'retraces': len(rep['retraces']),
+    }
+obj = {
+    'bench': 'r14_overlap_straggler_ab',
+    'provenance': 'CPU, 8 virtual devices on a shared host — wait/'
+                  'skew magnitudes are NOT v5e numbers (PERF.md r14); '
+                  'the comparison is the factor-step wait share and '
+                  'the firing-step spike, eager vs overlap',
+    'workload': 'transformer_lm d64 L1 bptt16 b8, f1/i8, 2 epochs '
+                '(64 steps), COMM_OPT 8-dev virtual mesh',
+    'overlap_flags': ['--inv-pipeline-chunks 2',
+                      '--deferred-factor-reduction',
+                      '--inv-staleness 1'],
+    'legs': legs,
+}
+with open('BENCH_r14_OVERLAP.json', 'w') as f:
+    json.dump(obj, f, indent=1, sort_keys=True)
+    f.write('\n')
+print(json.dumps(obj['legs'], indent=1, sort_keys=True))
+EOF
+echo "r14 artifacts written: FLAGSHIP_LM_r14_STALENESS.jsonl" \
+     "CONVERGENCE_R14_STALENESS_GN_S{0,1}.json BENCH_r14_OVERLAP.json"

@@ -1,0 +1,114 @@
+#!/usr/bin/env bash
+# Fast-tier compute/communication-overlap smoke (r14): both knobs end
+# to end on CPU through the REAL LM entry point —
+#   1. one tiny synthetic-corpus epoch with --deferred-factor-reduction
+#      and --inv-staleness 1 (chunked, k=2), straggler shards on with
+#      the sampled probe (--straggler-sample-every 2), metrics sink on;
+#   2. assert the stream shows the r14 schedule (fired='reduce' window
+#      heads, chunk firings, ZERO retrace events) and that the merged
+#      report carries the comm-wait-by-stage attribution from the
+#      sparse (sampled) shard;
+#   3. observability-gate self-check over the stream (the CI plumbing
+#      path, like autotune_smoke.sh's leg 4);
+#   4. fail-closed composition with --tuned-config: an artifact whose
+#      tuned knobs violate the staleness window constraint against the
+#      CLI's live cadence must fall back to flag defaults with exactly
+#      one autotune_fallback event — never half-apply.
+# The same contracts are pinned in tests/test_overlap.py; this wrapper
+# is the standalone/CI-pipeline form (see sharing_smoke.sh).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="$(mktemp -d)"
+trap 'rm -rf "$out"' EXIT
+
+run_lm() {  # $1 = leg name, $2 = metrics path, extra args follow
+    local leg="$1" metrics="$2"; shift 2
+    JAX_PLATFORMS=cpu KFAC_COMPILE_CACHE=0 KFAC_SYNTHETIC_LM=2048 \
+    python examples/train_language_model.py \
+        --arch transformer --emsize 64 --nlayers 1 --nheads 2 \
+        --bptt 16 --batch-size 4 --epochs 1 --no-resume \
+        --kfac-update-freq 8 --inv-pipeline-chunks 2 \
+        --deferred-factor-reduction --inv-staleness 1 \
+        --log-dir "$out/logs-$leg" --checkpoint-dir "$out/ckpt-$leg" \
+        --kfac-metrics "$metrics" --metrics-interval 1 "$@"
+}
+
+# Leg 1: both knobs + sampled straggler shards.
+run_lm overlap "$out/overlap.jsonl" \
+    --straggler-shards --straggler-sample-every 2
+
+python - "$out/overlap.jsonl" <<'EOF'
+import sys
+from distributed_kfac_pytorch_tpu.observability import sink as obs_sink
+from distributed_kfac_pytorch_tpu.observability import (
+    stragglers as obs_stragglers,
+)
+
+path = sys.argv[1]
+records, _ = obs_sink.read_jsonl_tolerant(path)
+fired = [r.get('fired') for r in records if r.get('kind') == 'step']
+assert 'reduce' in fired, fired  # deferred window-boundary reduce ran
+assert any(f and f.startswith('chunk') for f in fired), fired
+retraces = [r for r in records if r.get('event') == 'retrace']
+assert not retraces, retraces    # zero retraces with both knobs on
+
+shards, torn, errors = obs_stragglers.merge_shards(path)
+assert shards and not errors, (shards.keys(), errors)
+summary = obs_stragglers.straggler_summary(shards)
+wbs = summary['wait_by_stage']
+assert wbs, summary              # sampled probe still attributed
+n_steps = sum(1 for r in shards[0] if r.get('kind') == 'step')
+n_waits = sum(v['n'] for v in wbs.values())
+assert 0 < n_waits <= (n_steps + 1) // 2 + 1, (n_waits, n_steps)
+print('overlap schedule + sampled wait attribution OK '
+      f'(waits on {n_waits}/{n_steps} steps)')
+EOF
+
+# Leg 2: gate self-check (stream is gate-clean against itself).
+python -m distributed_kfac_pytorch_tpu.observability.gate \
+    "$out/overlap.jsonl" --write-baseline "$out/B.json"
+python -m distributed_kfac_pytorch_tpu.observability.gate \
+    "$out/overlap.jsonl" --baseline "$out/B.json" --allow-missing \
+    --json > "$out/gate.json"
+python - "$out/gate.json" <<'EOF'
+import json, sys
+v = json.load(open(sys.argv[1]))
+assert v['pass'] is True, v
+print('gate self-check OK')
+EOF
+
+# Leg 3: fail-closed --tuned-config composition. The artifact tunes
+# inv_staleness=1 with inv_pipeline_chunks=8 — invalid against the
+# CLI's --kfac-update-freq 8 window (stride < 2), so the merge must
+# fall back to the flag defaults with one autotune_fallback event.
+python - "$out/TUNED_bad.json" <<'EOF'
+import sys
+from distributed_kfac_pytorch_tpu.autotune import driver
+import jax
+driver.write_tuned(sys.argv[1], {
+    'workload': 'overlap_smoke',
+    'platform': jax.default_backend(),
+    'topology': {'topo_devices': jax.device_count(),
+                 'topo_processes': jax.process_count(),
+                 'topo_seq': 1},
+    'best': {'inv_staleness': 1, 'inv_pipeline_chunks': 8},
+    'best_score': 1.0, 'candidates': []})
+EOF
+run_lm fallback "$out/fallback.jsonl" --tuned-config "$out/TUNED_bad.json"
+
+python - "$out/fallback.jsonl" <<'EOF'
+import sys
+from distributed_kfac_pytorch_tpu.observability import sink as obs_sink
+
+records, _ = obs_sink.read_jsonl_tolerant(sys.argv[1])
+falls = [r for r in records if r.get('event') == 'autotune_fallback']
+applies = [r for r in records if r.get('event') == 'autotune_apply']
+assert len(falls) == 1 and not applies, (falls, applies)
+assert falls[0]['data']['reason'] == 'invalid_merge', falls[0]
+steps = [r for r in records if r.get('kind') == 'step']
+assert steps, 'fallback run still trained'
+print('tuned-config fail-closed OK')
+EOF
+
+echo "overlap smoke OK"

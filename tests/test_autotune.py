@@ -59,7 +59,9 @@ def test_space_enumeration_respects_constraints():
         {'inv_pipeline_chunks': [1, 2, 3],
          'factor_batch_fraction': [1.0],
          'kfac_cov_update_freq': [1],
-         'kfac_approx': ['expand']})
+         'kfac_approx': ['expand'],
+         'deferred_factor_reduction': [False],
+         'inv_staleness': [0]})
     base = _base_knobs()  # inv freq 4: chunks 3 cannot divide
     cands = space.enumerate(base)
     assert all(c['inv_pipeline_chunks'] in (1, 2) for c in cands)
@@ -363,7 +365,9 @@ def test_driver_tune_end_to_end(tmp_path):
         space_overrides={'bf16_precond': [False],
                          'factor_batch_fraction': [1.0],
                          'kfac_cov_update_freq': [1],
-                         'inv_pipeline_chunks': [1, 2]},
+                         'inv_pipeline_chunks': [1, 2],
+                         'deferred_factor_reduction': [False],
+                         'inv_staleness': [0]},
         mesh=mesh, self_check=True, self_check_tol=5.0,
         log=logs.append)
     assert artifact['format'] == at_driver.ARTIFACT_FORMAT
@@ -428,7 +432,9 @@ def test_driver_halving_commits_full_length_winner(tmp_path,
                          'factor_batch_fraction': [1.0],
                          'kfac_cov_update_freq': [1],
                          'inv_pipeline_chunks': [1],
-                         'kfac_approx': ['expand']},
+                         'kfac_approx': ['expand'],
+                         'deferred_factor_reduction': [False],
+                         'inv_staleness': [0]},
         mesh=_one_dev_mesh(), self_check=True, self_check_tol=0.5,
         log=lambda *a: None)
     # The halving survivor (bf16=False, which won its short rungs) was
@@ -441,7 +447,8 @@ def test_driver_halving_commits_full_length_winner(tmp_path,
     # The nominee's full-length probe actually ran.
     assert ({'bf16_precond': False, 'factor_batch_fraction': 1.0,
              'kfac_cov_update_freq': 1, 'inv_pipeline_chunks': 1,
-             'kfac_approx': 'expand'},
+             'kfac_approx': 'expand',
+             'deferred_factor_reduction': False, 'inv_staleness': 0},
             8) in probed
     # Short-rung rows survive in the table as provenance, with their
     # n_steps making them self-describing.

@@ -1,0 +1,572 @@
+"""r14 compute/communication overlap: deferred factor reduction and
+one-window-stale off-critical-path inverses.
+
+Pins the two contracts the knobs ship under:
+
+  - **Deferred reduce is exact.** The decayed EMA is linear, so
+    accumulating contributions locally and applying them at the window
+    boundary equals the per-step recursion at every consumption point
+    (and, under SPMD, ``pmean(Σ w_i c_i) = Σ w_i pmean(c_i)``) — up to
+    fp associativity, since the summation order differs. Parity is
+    pinned on per-step losses and on the factors themselves,
+    single-chip and 8-dev SPMD (including the r13 tied-embedding
+    grad-quadratic/activation split and grad-accum scaling).
+  - **Staleness fires from the frozen snapshot.** With
+    ``inv_staleness=1`` the in-window firing decomposes exactly the
+    window-head factor snapshot — bit-identical to an eager firing on
+    those frozen factors — and never this step's live factors.
+  - Defaults stay bit-identical (no new state keys, the historical
+    variant-key shape), and the both-knobs-on schedule compiles one
+    program per flag combination with ZERO retraces.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_kfac_pytorch_tpu import CommMethod, KFAC
+from distributed_kfac_pytorch_tpu.observability import (
+    stragglers as obs_stragglers,
+)
+from distributed_kfac_pytorch_tpu.parallel import distributed as D
+from distributed_kfac_pytorch_tpu.training import engine
+
+from tests.test_preconditioner import MLP, loss_fn
+
+
+# ---------------------------------------------------------------------------
+# Engine schedule (pure host-side)
+# ---------------------------------------------------------------------------
+
+def test_cadence_flags_deferred_reduce_at_window_heads():
+    flags = [engine.cadence_flags(i, 2, 4, deferred_reduce=True)
+             for i in range(9)]
+    assert [f['factor_reduce'] for f in flags] == [
+        True, False, False, False, True, False, False, False, True]
+    # The eager keys are untouched (factor/inv schedule unchanged).
+    assert flags[0]['inv_update'] and flags[4]['inv_update']
+
+
+def test_cadence_flags_staleness_schedule():
+    """k=2, i_freq=8: warmup at 0; snapshot at window heads; chunk j at
+    phase j*stride + 1 (plain steps when stride is a multiple of
+    f_freq)."""
+    got = {}
+    for i in range(17):
+        f = engine.cadence_flags(i, 2, 8, 2, inv_staleness=1)
+        got[i] = (f.get('inv_update'), f.get('factor_snapshot'),
+                  f.get('inv_chunk'))
+    assert got[0] == (True, None, None)          # monolithic warmup
+    assert got[8] == (False, True, None)         # snapshot, no firing
+    assert got[16] == (False, True, None)
+    assert got[1] == (False, None, 0)            # chunk 0 at phase 1
+    assert got[5] == (False, None, 1)            # chunk 1 at stride+1
+    assert got[9] == (False, None, 0)
+    assert got[13] == (False, None, 1)
+    for i in (2, 3, 4, 6, 7, 10, 11, 12, 14, 15):
+        assert got[i] == (False, None, None), (i, got[i])
+
+
+def test_cadence_flags_staleness_k1_fires_at_phase_one():
+    fired = [engine.cadence_flags(i, 1, 4, 1, inv_staleness=1)
+             for i in range(9)]
+    assert fired[0]['inv_update']
+    assert fired[1].get('inv_chunk') == 0
+    assert fired[5].get('inv_chunk') == 0
+    assert fired[4].get('factor_snapshot')
+    assert not any(f.get('inv_chunk') is not None
+                   for i, f in enumerate(fired) if i not in (1, 5))
+
+
+def test_fired_stage_reduce_label():
+    assert engine.fired_stage({'factor_update': True,
+                               'factor_reduce': True}) == 'reduce'
+    assert engine.fired_stage({'factor_update': True,
+                               'factor_reduce': False}) == 'factor'
+    # A firing step that also reduces keeps both facts in the label:
+    # outlier attribution leads with the firing, the comm-wait split
+    # still sees the factor collective (stage_class -> 'factor').
+    assert engine.fired_stage({'factor_reduce': True,
+                               'inv_chunk': 1}) == 'chunk1+reduce'
+    assert engine.fired_stage({'factor_reduce': True,
+                               'inv_update': True}) == 'inverse+reduce'
+    assert obs_stragglers.stage_class('chunk1+reduce') == 'factor'
+    assert obs_stragglers.stage_class('inverse+reduce') == 'factor'
+    assert obs_stragglers.stage_class('chunk1') == 'firing'
+
+
+# ---------------------------------------------------------------------------
+# Constructor validation / static-flag contract
+# ---------------------------------------------------------------------------
+
+def test_staleness_constructor_validation():
+    with pytest.raises(ValueError, match='0 or 1'):
+        KFAC(MLP(), inv_staleness=2)
+    # stride must be >= 2 so the +1-shifted phases fit the window.
+    with pytest.raises(ValueError, match='>= 2'):
+        KFAC(MLP(), inv_staleness=1, inv_update_freq=4,
+             inv_pipeline_chunks=4)
+    with pytest.raises(ValueError, match='>= 2'):
+        KFAC(MLP(), inv_staleness=1, inv_update_freq=1)
+    KFAC(MLP(), inv_staleness=1, inv_update_freq=4,
+         inv_pipeline_chunks=2)  # stride 2: ok
+
+
+def _setup(**kw):
+    kfac = KFAC(MLP(), factor_update_freq=1, inv_update_freq=4,
+                kl_clip=None, factor_decay=0.5, damping=0.01, lr=0.1,
+                **kw)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 6))
+    variables, state = kfac.init(jax.random.PRNGKey(0), x)
+    return kfac, variables['params'], state, x
+
+
+def test_overlap_flags_require_matching_knobs():
+    kfac, params, state, x = _setup()
+    _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+        loss_fn, params, x)
+    with pytest.raises(ValueError, match='deferred_factor_reduction'):
+        kfac.step(state, grads, captures, factor_update=True,
+                  inv_update=False, factor_reduce=True)
+    with pytest.raises(ValueError, match='inv_staleness'):
+        kfac.step(state, grads, captures, factor_update=True,
+                  inv_update=False, factor_snapshot=True)
+    dkfac, _, dstate, _ = _setup(deferred_factor_reduction=True)
+    with pytest.raises(ValueError, match='static cadence'):
+        dkfac.step(dstate, grads, captures)  # dynamic flags
+    skfac, _, sstate, _ = _setup(inv_staleness=1)
+    with pytest.raises(ValueError, match='static cadence'):
+        skfac.step(sstate, grads, captures)
+
+
+def test_default_state_has_no_overlap_keys():
+    """Both knobs off = the historical state layout, key for key (the
+    checkpoint-format bit of the defaults-bit-identical contract)."""
+    _, _, state, _ = _setup()
+    assert set(state) == {'step', 'factors', 'inverses',
+                          'inv_chunk_phase'}
+
+
+# ---------------------------------------------------------------------------
+# Deferred-reduce exactness (EMA linearity), single chip
+# ---------------------------------------------------------------------------
+
+def _run_single_chip(deferred, n_steps=9, f_freq=1, i_freq=4,
+                     stale=0, chunks=1):
+    kfac = KFAC(MLP(), factor_update_freq=f_freq,
+                inv_update_freq=i_freq, kl_clip=None, factor_decay=0.5,
+                damping=0.01, lr=0.1,
+                deferred_factor_reduction=deferred,
+                inv_staleness=stale, inv_pipeline_chunks=chunks)
+    x0 = jax.random.normal(jax.random.PRNGKey(1), (16, 6))
+    variables, state = kfac.init(jax.random.PRNGKey(0), x0)
+    params = variables['params']
+    tx = optax.sgd(0.1)
+    opt_state = tx.init(params)
+    losses = []
+    step_jit = jax.jit(kfac.step, static_argnames=(
+        'factor_update', 'inv_update', 'inv_chunk', 'factor_reduce',
+        'factor_snapshot'))
+    for i in range(n_steps):
+        # Distinct batches: factors drift every step, so a wrong
+        # consumption point would show at percent-of-norm scale.
+        x = jax.random.normal(jax.random.PRNGKey(100 + i), (16, 6))
+        loss, _, grads, captures, _ = kfac.capture.loss_and_grads(
+            loss_fn, params, x)
+        flags = engine.cadence_flags(
+            i, f_freq, i_freq, chunks,
+            deferred_reduce=deferred,
+            inv_staleness=stale)
+        precond, state = step_jit(state, grads, captures, **flags)
+        updates, opt_state = tx.update(precond, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        losses.append(float(loss))
+    return np.asarray(losses), params, state
+
+
+def test_deferred_reduce_exact_single_chip():
+    l_eager, p_eager, s_eager = _run_single_chip(False)
+    l_def, p_def, s_def = _run_single_chip(True)
+    np.testing.assert_allclose(l_def, l_eager, rtol=1e-4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0,
+            atol=1e-4 * max(float(np.abs(np.asarray(b)).max()), 1e-6)),
+        p_def, p_eager)
+    # Factors agree at the boundary (step 8 reduced; both include the
+    # same contributions c_0..c_8).
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0, atol=1e-5),
+        s_def['factors'], s_eager['factors'])
+    # The accumulator reset at the step-8 reduce.
+    assert float(s_def['accum_decay']) == 1.0
+
+
+def test_deferred_reduce_guard_skips_whole_window():
+    """A NaN batch inside the window poisons the accumulator; the
+    window-boundary guard keeps the previous factors and resets the
+    accumulator (no NaN persists)."""
+    kfac = KFAC(MLP(), factor_update_freq=1, inv_update_freq=2,
+                kl_clip=None, factor_decay=0.5, damping=0.01, lr=0.1,
+                deferred_factor_reduction=True, nonfinite_guard=True)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 6))
+    variables, state = kfac.init(jax.random.PRNGKey(0), x)
+    params = variables['params']
+    _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+        loss_fn, params, x)
+    bad = jax.tree.map(lambda v: v * jnp.nan, captures)
+    # Step 0: clean reduce (warmup window).
+    _, state = kfac.step(state, grads, captures, factor_update=True,
+                         inv_update=True, factor_reduce=True)
+    good_factors = state['factors']
+    # Step 1 accumulates NaN; step 2's reduce must skip and reset.
+    _, state = kfac.step(state, grads, bad, factor_update=True,
+                         inv_update=False)
+    _, state = kfac.step(state, grads, captures, factor_update=True,
+                         inv_update=False, factor_reduce=True)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), state['factors'], good_factors)
+    assert all(np.isfinite(np.asarray(v)).all()
+               for v in jax.tree.leaves(state['factor_accum']))
+    assert float(state['accum_decay']) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Staleness: the firing decomposes the frozen snapshot
+# ---------------------------------------------------------------------------
+
+def test_staleness_fires_from_frozen_snapshot_single_chip():
+    kfac = KFAC(MLP(), factor_update_freq=1, inv_update_freq=4,
+                kl_clip=None, factor_decay=0.5, damping=0.01, lr=0.1,
+                inv_staleness=1)
+    x0 = jax.random.normal(jax.random.PRNGKey(1), (16, 6))
+    variables, state = kfac.init(jax.random.PRNGKey(0), x0)
+    params = variables['params']
+    for i in range(5):  # 0 = warmup, 4 = window head (snapshot)
+        x = jax.random.normal(jax.random.PRNGKey(100 + i), (16, 6))
+        _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+            loss_fn, params, x)
+        flags = engine.cadence_flags(i, 1, 4, 1, inv_staleness=1)
+        _, state = kfac.step(state, grads, captures, **flags)
+    frozen = state['frozen_factors']
+    # The snapshot is the head step's post-update factors — and the
+    # NEXT factor step drifts the live factors away from it.
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), frozen, state['factors'])
+    pre_fire = state
+    x = jax.random.normal(jax.random.PRNGKey(105), (16, 6))
+    _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+        loss_fn, params, x)
+    flags = engine.cadence_flags(5, 1, 4, 1, inv_staleness=1)
+    assert flags.get('inv_chunk') == 0
+    _, state = kfac.step(state, grads, captures, **flags)
+    drift = max(float(np.abs(np.asarray(a) - np.asarray(b)).max())
+                for a, b in zip(jax.tree.leaves(state['factors']),
+                                jax.tree.leaves(frozen)))
+    assert drift > 1e-4  # live factors moved; the snapshot did not
+    # The fired inverses are EXACTLY an eager chunk firing on the
+    # frozen factors (same warm-start state) — not the live ones.
+    expected = kfac.update_inverses(
+        {**pre_fire, 'factors': frozen}, 0.01, chunk=0)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), state['inverses'], expected)
+    live = kfac.update_inverses(
+        {**pre_fire, 'factors': state['factors']}, 0.01, chunk=0)
+    diffs = [float(np.abs(np.asarray(a) - np.asarray(b)).max())
+             for a, b in zip(jax.tree.leaves(state['inverses']),
+                             jax.tree.leaves(live))]
+    assert max(diffs) > 0.0  # decomposing live factors would differ
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint format
+# ---------------------------------------------------------------------------
+
+def test_overlap_state_roundtrip_and_pre_r14_default():
+    kfac = KFAC(MLP(), factor_update_freq=1, inv_update_freq=4,
+                kl_clip=None, factor_decay=0.5, damping=0.01, lr=0.1,
+                deferred_factor_reduction=True, inv_staleness=1)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 6))
+    variables, state = kfac.init(jax.random.PRNGKey(0), x)
+    params = variables['params']
+    _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+        loss_fn, params, x)
+    _, state = kfac.step(state, grads, captures, factor_update=True,
+                         inv_update=True, factor_reduce=True)
+    _, state = kfac.step(state, grads, captures, factor_update=True,
+                         inv_update=False)  # mid-window accumulation
+    sd = kfac.state_dict(state, include_inverses=True)
+    assert {'factor_accum', 'accum_decay', 'frozen_factors'} <= set(sd)
+    restored = kfac.load_state_dict(sd, params)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)),
+        restored['factor_accum'], state['factor_accum'])
+    assert float(restored['accum_decay']) == float(
+        state['accum_decay'])
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)),
+        restored['frozen_factors'], state['frozen_factors'])
+    # Pre-r14 bundle (keys absent): eager-reduce seeds + snapshot from
+    # the RESTORED factors, never the identity.
+    old = {k: v for k, v in sd.items()
+           if k not in ('factor_accum', 'accum_decay',
+                        'frozen_factors')}
+    restored = kfac.load_state_dict(old, params)
+    assert float(restored['accum_decay']) == 1.0
+    assert all(float(np.abs(np.asarray(v)).max()) == 0.0
+               for v in jax.tree.leaves(restored['factor_accum']))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)),
+        restored['frozen_factors'], restored['factors'])
+
+
+def test_staleness_fallback_is_monolithic_for_incompatible_freq():
+    """A scheduler-decayed inv freq the BUILT chunk count cannot fit
+    must fall back to eager monolithic window-head firing — partial
+    chunk flags against a k>1 builder would leave the carried snapshot
+    (and half the slots) stale forever."""
+    seen = []
+
+    def step_fn(params, opt_state, kstate, extra, batch, hyper,
+                **flags):
+        seen.append(dict(flags))
+        return params, opt_state, kstate, extra, {'loss': 0.0}
+
+    step_fn.inv_pipeline_chunks = 2
+    step_fn.deferred_factor_reduction = True
+    step_fn.inv_staleness = 1
+    state = engine.TrainState({}, {}, {}, {})
+    with pytest.warns(UserWarning, match='inv_staleness'):
+        engine.train_epoch(step_fn, state, [0] * 6, {'lr': 0.1},
+                           static_cadence=(1, 3))
+    assert all(f.get('inv_chunk') is None for f in seen)
+    assert [f['inv_update'] for f in seen] == [
+        True, False, False, True, False, False]
+    assert [f['factor_reduce'] for f in seen] == [
+        True, False, False, True, False, False]
+
+
+# ---------------------------------------------------------------------------
+# Sampled straggler probe + comm-wait attribution (satellites)
+# ---------------------------------------------------------------------------
+
+def test_sampled_straggler_probe_paces_and_records_sparse():
+    calls = []
+
+    def probe():
+        calls.append(True)
+        return 7.5
+
+    recorded = []
+
+    class FakeShard:
+        def step_record(self, step, metrics, **kw):
+            recorded.append((step, dict(metrics)))
+
+        def flush(self):
+            pass
+
+    def step_fn(params, opt_state, kstate, extra, batch, hyper):
+        return params, opt_state, kstate, extra, {'loss': 0.0}
+
+    state = engine.TrainState(params={}, opt_state={}, kfac_state={},
+                              extra_vars={})
+    engine.train_epoch(step_fn, state, [(0,)] * 7, {'lr': 0.1},
+                       static_cadence=None, rank_sink=FakeShard(),
+                       barrier_probe=probe, straggler_sample_every=3)
+    assert len(calls) == 3  # steps 0, 3, 6
+    waits = {s: obs_stragglers.BARRIER_WAIT_KEY in m
+             for s, m in recorded}
+    assert waits == {0: True, 1: False, 2: False, 3: True, 4: False,
+                     5: False, 6: True}
+
+
+def test_wait_attribution_splits_factor_vs_plain():
+    key = obs_stragglers.BARRIER_WAIT_KEY
+
+    def rec(step, wait, fired=None):
+        r = {'kind': 'step', 'step': step, 'host_step_ms': 1.0,
+             'metrics': {} if wait is None else {key: wait}}
+        if fired:
+            r['fired'] = fired
+        return r
+
+    shards = {0: [rec(0, 8.0, 'factor'), rec(1, 2.0),
+                  rec(2, 6.0, 'reduce'), rec(3, None),
+                  rec(4, 3.0, 'chunk0'), rec(5, 1.0, 'compile')],
+              1: [rec(0, 4.0, 'factor'), rec(1, 2.0)]}
+    wbs = obs_stragglers.wait_attribution(shards)
+    assert wbs['factor']['n'] == 3   # factor x2 + reduce
+    assert wbs['factor']['mean_wait_ms'] == pytest.approx(6.0)
+    assert wbs['factor']['max_wait_ms'] == 8.0
+    assert wbs['plain'] == {'n': 2, 'mean_wait_ms': 2.0,
+                            'max_wait_ms': 2.0}
+    assert wbs['firing']['n'] == 1
+    assert wbs['compile']['n'] == 1
+    # Sparse shards (step 3 carried no wait) merge cleanly, and the
+    # summary carries the split through to report --json.
+    summary = obs_stragglers.straggler_summary(shards)
+    assert summary['wait_by_stage'] == wbs
+    assert obs_stragglers.wait_attribution({0: [rec(0, None)]}) is None
+
+
+# ---------------------------------------------------------------------------
+# SPMD: exactness, zero retraces, both knobs composed
+# ---------------------------------------------------------------------------
+
+def _spmd_run(deferred, stale, chunks, *, n_steps=9, f_freq=1,
+              i_freq=4, comm=CommMethod.HYBRID_OPT, tied=False,
+              grad_accum_steps=1):
+    if tied:
+        from distributed_kfac_pytorch_tpu.models import transformer_lm
+        model = transformer_lm.TransformerLM(
+            vocab_size=32, d_model=16, num_layers=1, num_heads=2,
+            max_len=8, dropout=0.0, tie_weights=True)
+        kfac = KFAC(model, factor_update_freq=f_freq,
+                    inv_update_freq=i_freq, damping=0.01, lr=0.05,
+                    kfac_approx='reduce',
+                    deferred_factor_reduction=deferred,
+                    inv_staleness=stale, inv_pipeline_chunks=chunks)
+        x = jax.random.randint(jax.random.PRNGKey(1), (16, 8), 0, 32)
+        y = jax.random.randint(jax.random.PRNGKey(2), (16, 8), 0, 32)
+        variables, _ = kfac.init(jax.random.PRNGKey(0), x,
+                                 train=False)
+        model_kwargs_fn = lambda batch: {'train': False}
+
+        def loss(out, batch):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                out, batch[1]).mean()
+    else:
+        kfac = KFAC(MLP(), factor_update_freq=f_freq,
+                    inv_update_freq=i_freq, damping=0.01, lr=0.05,
+                    deferred_factor_reduction=deferred,
+                    inv_staleness=stale, inv_pipeline_chunks=chunks)
+        x = jax.random.normal(jax.random.PRNGKey(1), (16, 6))
+        y = jnp.zeros((16,), jnp.int32)
+        variables, _ = kfac.init(jax.random.PRNGKey(0), x)
+        model_kwargs_fn = None
+
+        def loss(out, batch):
+            return jnp.mean(out ** 2)
+
+    params = variables['params']
+    mesh = D.make_kfac_mesh(jax.devices(), comm_method=comm,
+                            grad_worker_fraction=0.5)
+    dkfac = D.DistributedKFAC(kfac, mesh, params)
+    dstate = dkfac.init_state(params)
+    tx = optax.sgd(0.05)
+    step = dkfac.build_train_step(loss, tx, donate=False,
+                                  model_kwargs_fn=model_kwargs_fn,
+                                  grad_accum_steps=grad_accum_steps)
+    state = engine.TrainState(params, tx.init(params), dstate, {})
+    hyper = {'lr': 0.05, 'damping': 0.01,
+             'factor_update_freq': f_freq, 'inv_update_freq': i_freq}
+    losses = []
+    for _ in range(n_steps):
+        m = engine.train_epoch(step, state, [(x, y)], hyper)
+        losses.append(m['loss'])
+    return np.asarray(losses), state, step
+
+
+def test_deferred_reduce_exact_spmd():
+    """8-dev HYBRID: deferred-reduce per-step losses and factors match
+    the eager per-step pmean (EMA linearity; fp-associativity
+    tolerance). Monolithic k=1 so every consumption point is a window
+    head in both runs."""
+    l_eager, s_eager, _ = _spmd_run(False, 0, 1)
+    l_def, s_def, step = _spmd_run(True, 0, 1)
+    np.testing.assert_allclose(l_def, l_eager, rtol=1e-4, atol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0,
+            atol=1e-4 * max(float(np.abs(np.asarray(b)).max()), 1e-6)),
+        s_def.kfac_state['factors'], s_eager.kfac_state['factors'])
+    # Deferred-state bookkeeping: accumulator sharded per device.
+    acc = s_def.kfac_state['factor_accum']
+    assert all(np.asarray(v).shape[0] == 8
+               for v in jax.tree.leaves(acc))
+    assert all(n == 1 for n in step.trace_counts.values()), \
+        step.trace_counts
+
+
+def test_both_knobs_zero_retraces_and_variant_shape():
+    """Both knobs on, chunked (k=2): a multi-window run compiles one
+    program per flag combination — warmup, accumulate, reduce+snapshot
+    head, two chunk phases, plain — and never retraces (the r9
+    trace_counts guard extended to the r14 flags)."""
+    losses, state, step = _spmd_run(True, 1, 2, n_steps=9)
+    assert np.isfinite(losses).all()
+    assert all(n == 1 for n in step.trace_counts.values()), \
+        step.trace_counts
+    assert set(step.trace_counts) == {
+        # (factor, inv, chunk, reduce, snapshot)
+        (True, True, None, True, False),    # step 0 warmup
+        (True, False, None, False, False),  # plain accumulating step
+        (True, False, None, True, True),    # window head
+        (True, False, 0, False, False),     # chunk 0 (phase 1)
+        (True, False, 1, False, False),     # chunk 1 (phase 3)
+    }, step.trace_counts
+    # Defaults keep the historical 3-tuple keys (pinned separately in
+    # test_inv_pipeline); engaged knobs append their flags.
+    assert step.deferred_factor_reduction is True
+    assert step.inv_staleness == 1
+
+
+@pytest.mark.slow
+def test_deferred_reduce_exact_spmd_tied_and_grad_accum():
+    """The r13 world-scaling split (grad-quadratic 'A_g2'/'G' vs
+    activation 'A'/'G_a' parts of a tied-reduce transformer) and the
+    1/accum**2 grad-accum correction both commute with deferral: the
+    locally-combined accumulator matches the eager per-step pmean."""
+    l_eager, s_eager, _ = _spmd_run(False, 0, 1, tied=True,
+                                    grad_accum_steps=2, n_steps=5)
+    l_def, s_def, _ = _spmd_run(True, 0, 1, tied=True,
+                                grad_accum_steps=2, n_steps=5)
+    np.testing.assert_allclose(l_def, l_eager, rtol=1e-4, atol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0,
+            atol=1e-4 * max(float(np.abs(np.asarray(b)).max()), 1e-6)),
+        s_def.kfac_state['factors'], s_eager.kfac_state['factors'])
+
+
+@pytest.mark.slow
+def test_spmd_checkpoint_roundtrip_with_overlap_state():
+    """state_dict -> load_state_dict carries the sharded accumulator
+    and snapshot; a bundle stripped of them (pre-r14) restores with
+    eager-reduce seeds and factors-seeded snapshot."""
+    _, state, _ = _spmd_run(True, 1, 2, n_steps=6)
+    kstate = state.kfac_state
+    # Rebuild the distributed wrapper exactly as a resume would.
+    kfac = KFAC(MLP(), factor_update_freq=1, inv_update_freq=4,
+                damping=0.01, lr=0.05, deferred_factor_reduction=True,
+                inv_staleness=1, inv_pipeline_chunks=2)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 6))
+    variables, _ = kfac.init(jax.random.PRNGKey(0), x)
+    params = variables['params']
+    mesh = D.make_kfac_mesh(jax.devices(),
+                            comm_method=CommMethod.HYBRID_OPT,
+                            grad_worker_fraction=0.5)
+    dkfac = D.DistributedKFAC(kfac, mesh, params)
+    sd = dkfac.state_dict(kstate)
+    assert {'factor_accum', 'accum_decay', 'frozen_factors'} <= set(sd)
+    restored = dkfac.load_state_dict(sd, params)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)),
+        restored['factor_accum'], kstate['factor_accum'])
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)),
+        restored['frozen_factors'], kstate['frozen_factors'])
+    old = {k: v for k, v in sd.items()
+           if k not in ('factor_accum', 'accum_decay',
+                        'frozen_factors')}
+    restored = dkfac.load_state_dict(old, params)
+    assert float(restored['accum_decay']) == 1.0
+    assert all(float(np.abs(np.asarray(v)).max()) == 0.0
+               for v in jax.tree.leaves(restored['factor_accum']))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)),
+        restored['frozen_factors'], restored['factors'])
