@@ -142,6 +142,7 @@ def main(argv=None):
             ('polish_HIGH_4', jax.lax.Precision.HIGH, 4),
         ]
         for label, precision, iters in configs:
+            # kfaclint: waive[retrace-jit-in-loop] per-config bench harness: one jit per method config, compile excluded from timing
             fn = jax.jit(jax.vmap(functools.partial(
                 linalg.eigh_polish, iters=iters, precision=precision)))
             sec, (qs, ds) = time_variants(fn, variants, args.repeats)
@@ -154,6 +155,7 @@ def main(argv=None):
                          'worst_precond_rel_err':
                              float(f'{np.max(errs):.3g}')})
             print(json.dumps(rows[-1]), flush=True)
+        # kfaclint: waive[retrace-jit-in-loop] per-dim bench harness: one jit per dim rung, compile excluded from timing
         fn = jax.jit(lambda s, _q: pallas_kernels.damped_inverse_stack(
             s, 1e-3, 'cholesky'))
         sec, _ = time_variants(fn, variants, args.repeats)
@@ -161,6 +163,7 @@ def main(argv=None):
                      'ms_per_firing': round(sec * 1e3, 2),
                      'worst_precond_rel_err': None})
         print(json.dumps(rows[-1]), flush=True)
+        # kfaclint: waive[retrace-jit-in-loop] per-dim bench harness: one jit per dim rung, compile excluded from timing
         fn = jax.jit(lambda s, _q: jnp.linalg.eigh(s))
         sec, _ = time_variants(fn, variants, args.repeats)
         rows.append({'dim': dim, 'method': 'xla_eigh_cold',

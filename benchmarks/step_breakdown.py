@@ -325,6 +325,7 @@ def lm_approx_rows(args):
         variables, _ = kexp.init(jax.random.PRNGKey(0), ids,
                                  train=False)
         kred.init(jax.random.PRNGKey(0), ids, train=False)
+        # kfaclint: waive[retrace-jit-in-loop] per-approx bench harness: one capture program per approx row
         _, _, _, captures, _ = jax.jit(
             lambda p: kexp.capture.loss_and_grads(
                 loss, p, ids, train=False))(variables['params'])

@@ -1,0 +1,52 @@
+"""kfaclint: repo-invariant static analysis + runtime sanitizers.
+
+The r6-r14 subsystems rest on invariants that, until r15, were only
+enforced by example-specific runtime tests:
+
+  - **host-sync hygiene** — no ``.item()`` / ``jax.device_get`` /
+    device-value ``float()``/``int()`` casts / implicit ``__bool__``
+    on the hot-path modules (``preconditioner``,
+    ``parallel/distributed``, ``ops/*``, ``layers/*``,
+    ``training/engine``). A single stray host read serializes the
+    async dispatch pipeline (arXiv:2107.06533's "smart parallelism"
+    wins evaporate exactly this way).
+  - **retrace hazards** — the static-cadence contract (one compile
+    per program variant, ever; PERF.md pitfalls 2-3) requires
+    hashable canonical variant-key flags and no ``jax.jit`` /
+    ``shard_map`` construction inside per-step loops.
+  - **collective axis discipline** — every ``psum``/``pmean``/
+    ``all_gather`` names its axes via the canonical constants
+    (``parallel.distributed.INV_GROUP_AXIS`` & friends), never
+    string literals, so a mesh-axis rename cannot silently split the
+    collective surface.
+  - **dtype discipline** — bf16-pipeline matmuls carry fp32
+    accumulation (``preferred_element_type``), the r6 contract.
+  - **surface consistency** — ``TUNABLE_FIELDS`` ⊆ ``OptimConfig``,
+    every tunable has its CLI flag in all three examples, autotune
+    space knobs / ``kfac_overrides`` reference real fields, and
+    event names are drawn from ``observability.sink.EVENT_KINDS``.
+
+Static entry point (exit 1 on violation, ``--json`` machine output
+like ``observability.gate``):
+
+    python -m distributed_kfac_pytorch_tpu.analysis.lint
+
+Runtime counterpart (the dynamic oracle for the static rules), wired
+into ``training.engine.train_epoch``:
+
+    KFAC_SANITIZE=transfer,nan,retrace python examples/...
+
+See :mod:`analysis.rules` for the rule families and the inline waiver
+syntax (``# kfaclint: waive[rule-id] reason``), :mod:`analysis.surface`
+for the cross-file checks, and :mod:`analysis.sanitize` for the
+runtime mode.
+"""
+
+from distributed_kfac_pytorch_tpu.analysis.rules import (  # noqa: F401
+    Finding,
+    RULES,
+    lint_source,
+)
+from distributed_kfac_pytorch_tpu.analysis.sanitize import (  # noqa: F401
+    Sanitizer,
+)

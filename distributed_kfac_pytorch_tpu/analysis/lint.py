@@ -1,0 +1,221 @@
+"""kfaclint CLI: repo-invariant static analysis.
+
+    python -m distributed_kfac_pytorch_tpu.analysis.lint [PATH ...]
+
+Exit 0 = clean, 1 = violations, 2 = usage error — the same contract
+as ``observability.gate``, so CI wires both the same way
+(``scripts/lint_smoke.sh``). ``--json`` emits the machine verdict.
+
+With no PATH arguments the default scan set is the package tree plus
+the sibling ``examples/`` and ``benchmarks/`` directories (when
+present); ``tests/`` is deliberately NOT scanned — tests host-sync
+on purpose (oracles, fixtures) — but an explicit PATH argument lints
+anything, which is how the fixture matrix under
+``tests/fixtures/lint/`` pins each rule.
+
+The single-file rule families (host-sync / retrace / axis / dtype)
+come from :mod:`analysis.rules`; the cross-file ``surface`` family
+from :mod:`analysis.surface` (skipped when ``--no-surface`` or when
+PATH arguments are given that exclude the package).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from distributed_kfac_pytorch_tpu.analysis import rules as rules_mod
+from distributed_kfac_pytorch_tpu.analysis import surface as surface_mod
+from distributed_kfac_pytorch_tpu.analysis.rules import (
+    FAMILIES,
+    RULES,
+    lint_file,
+)
+
+_SKIP_PARTS = frozenset({'__pycache__', '.git', 'csrc'})
+
+
+def package_root() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parent.parent
+
+
+def default_paths() -> list[pathlib.Path]:
+    pkg = package_root()
+    out = [pkg]
+    for sibling in ('examples', 'benchmarks'):
+        d = pkg.parent / sibling
+        if d.is_dir():
+            out.append(d)
+    return out
+
+
+def iter_py_files(paths) -> list[pathlib.Path]:
+    files: list[pathlib.Path] = []
+    for p in paths:
+        p = pathlib.Path(p)
+        if p.is_dir():
+            files.extend(
+                f for f in sorted(p.rglob('*.py'))
+                if not _SKIP_PARTS.intersection(f.parts))
+        elif p.suffix == '.py':
+            files.append(p)
+        else:
+            raise ValueError(f'{p}: not a .py file or directory')
+    return files
+
+
+def package_rel(path: pathlib.Path) -> str | None:
+    """Path relative to the package root (posix), or None if outside
+    the package (examples/benchmarks are never hot-path)."""
+    try:
+        return path.resolve().relative_to(package_root()).as_posix()
+    except ValueError:
+        return None
+
+
+def lint_paths(paths, *, families=None,
+               with_surface: 'bool | str' = True,
+               assume_hot: bool = False) -> dict:
+    """Lint ``paths``; returns the verdict object the CLI prints.
+
+    ``families``: restrict to these rule families (None = all).
+    ``with_surface``: True runs the cross-file surface checks; a
+    string skips them and is reported verbatim as the skip reason
+    (never a silent drop).
+    ``assume_hot``: treat every file as hot-path (the fixture-matrix
+    escape hatch — files outside the package are otherwise never
+    hot, so the host-sync/dtype families would not fire on them).
+    """
+    files = iter_py_files(paths)
+    findings = []
+    n_waived = 0
+    unused_waivers = []
+    for f in files:
+        file_findings, waivers = lint_file(
+            str(f), f.read_text(),
+            hot=True if assume_hot else None,
+            package_rel=package_rel(f))
+        for w in waivers:
+            if not w.used:
+                unused_waivers.append(
+                    {'path': str(f), 'line': w.line,
+                     'rules': list(w.rules), 'reason': w.reason})
+        findings.extend(file_findings)
+    if with_surface is True and families is not None \
+            and 'surface' not in families:
+        # don't pay the package-wide re-parse for findings the
+        # family filter would immediately discard
+        with_surface = ("surface checks skipped: --family filter "
+                        "excludes 'surface'")
+    if with_surface is True:
+        pkg = package_root()
+        surface_findings, skipped = surface_mod.check_surface(pkg)
+        findings.extend(surface_findings)
+    else:
+        skipped = [str(with_surface)]
+    if families:
+        findings = [fi for fi in findings if fi.family in families
+                    or fi.family == 'waiver']
+    n_waived = sum(1 for fi in findings if fi.waived)
+    active = [fi for fi in findings if not fi.waived]
+    return {
+        'pass': not active,
+        'n_files': len(files),
+        'n_findings': len(active),
+        'n_waived': n_waived,
+        'findings': [fi.to_dict() for fi in findings],
+        'unused_waivers': unused_waivers,
+        'skipped': skipped,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog='python -m distributed_kfac_pytorch_tpu.analysis.lint',
+        description='kfaclint: host-sync / retrace / axis / dtype / '
+                    'surface invariant checks over the source tree. '
+                    'Exit 0 = clean, 1 = violations, 2 = usage '
+                    'error.')
+    p.add_argument('paths', nargs='*',
+                   help='files or directories to lint (default: the '
+                        'package + examples/ + benchmarks/)')
+    p.add_argument('--json', action='store_true',
+                   help='machine-readable verdict on stdout')
+    p.add_argument('--family', action='append', default=[],
+                   choices=list(FAMILIES),
+                   help='restrict to a rule family (repeatable)')
+    p.add_argument('--no-surface', action='store_true',
+                   help='skip the cross-file surface checks')
+    p.add_argument('--assume-hot', action='store_true',
+                   help='treat every linted file as a hot-path '
+                        'module (arms the host-sync/dtype families '
+                        'outside the package — the fixture-matrix '
+                        'mode)')
+    p.add_argument('--list-rules', action='store_true',
+                   help='print the rule registry and exit')
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule, (family, doc) in sorted(RULES.items()):
+            print(f'{rule:26s} [{family}] {doc}')
+        return 0
+
+    try:
+        paths = ([pathlib.Path(s) for s in args.paths]
+                 or default_paths())
+        missing = [str(s) for s in paths if not s.exists()]
+        if missing:
+            raise ValueError(f'no such path(s): {missing}')
+        # Surface checks are anchored to the package: run them on the
+        # default (whole-tree) invocation and whenever an explicit
+        # PATH covers the package root; otherwise report the skip
+        # with its real reason (never a silent drop).
+        if args.no_surface:
+            with_surface = 'surface checks disabled (--no-surface)'
+        elif not args.paths:
+            with_surface = True
+        else:
+            pkg = package_root()
+            resolved = [p.resolve() for p in paths]
+            # a path "covers" the package when it IS the package root
+            # or an ancestor of it (e.g. the repo root / '.') — a
+            # single file inside the package does not.
+            if any(r == pkg or r in pkg.parents for r in resolved):
+                with_surface = True
+            else:
+                with_surface = ('surface checks skipped: explicit '
+                                'PATH arguments do not cover the '
+                                'package root')
+        verdict = lint_paths(
+            paths, families=set(args.family) or None,
+            assume_hot=args.assume_hot,
+            with_surface=with_surface)
+    except (OSError, ValueError) as e:
+        print(f'error: {e}', file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(verdict, sort_keys=True))
+        return 0 if verdict['pass'] else 1
+
+    print('== kfaclint ==')
+    print(f"{verdict['n_files']} file(s); "
+          f"{verdict['n_findings']} violation(s), "
+          f"{verdict['n_waived']} waived")
+    for fi in verdict['findings']:
+        tag = 'waived ' if fi['waived'] else 'FAIL   '
+        print(f"  {tag}{fi['path']}:{fi['line']}:{fi['col']} "
+              f"[{fi['rule']}] {fi['message']}")
+    for w in verdict['unused_waivers']:
+        print(f"  note   {w['path']}:{w['line']} unused waiver "
+              f"for {w['rules']}")
+    for s in verdict['skipped']:
+        print(f'  skip   {s}')
+    print('PASS' if verdict['pass'] else 'FAIL')
+    return 0 if verdict['pass'] else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,187 @@
+"""Runtime sanitizer gates: the dynamic oracle for the static rules.
+
+    KFAC_SANITIZE=transfer,nan,retrace python examples/train_...
+
+Three independent modes, comma-separated in the ``KFAC_SANITIZE``
+environment variable (read once per epoch by
+``training.engine.train_epoch``; unset = the unsanitized engine
+path). The static linter (``analysis.lint``) under-approximates by
+design — it flags only syntactically certain violations — so these
+gates are what proves the invariants hold end-to-end on a real
+training loop (``scripts/lint_smoke.sh`` runs a representative
+fast-tier module under ``KFAC_SANITIZE=transfer,nan`` in CI).
+
+``transfer``
+    Wraps every *warm* step dispatch in
+    ``jax.transfer_guard_device_to_host('disallow')`` plus a
+    Python-level ``jax.device_get`` interposer. On accelerator
+    backends the XLA guard catches every device->host transfer the
+    step provokes (a stray ``.item()``, an implicit ``__bool__``,
+    ``np.asarray`` of a traced value); on the CPU backend arrays are
+    host-resident and the XLA guard never trips (zero-copy reads),
+    so the interposer — which raises on any ``jax.device_get``
+    inside the guarded region — is what keeps the mode load-bearing
+    in CPU CI. The first dispatch of each cadence-flag combination
+    is exempt — trace + XLA compile legitimately reads device
+    constants, and those steps are already labeled
+    ``fired='compile'`` in the metrics stream. The documented
+    per-step blocking points (the r10 barrier probe, the
+    epoch-boundary metric drain) sit OUTSIDE the guarded region by
+    construction, mirroring their lint waivers.
+
+``nan``
+    Runs every step dispatch under ``jax.debug_nans``: a NaN/Inf
+    produced by the step fails loudly at the producing primitive
+    instead of poisoning the factor EMAs. Applied uniformly to every
+    dispatch (compile steps included) so the debug flag cannot fork
+    the jit trace cache mid-run. This is the eager cousin of the
+    on-device ``nonfinite_guard`` (which protects factor statistics
+    only and is collective-safe).
+
+``retrace``
+    After every step, checks the step builder's host-side
+    ``trace_counts`` tally (``DistributedKFAC.build_train_step``)
+    and raises on any variant traced more than once — the online
+    form of the zero-retrace contract the offline gate regresses
+    (``observability.gate``: ``retraces`` metric).
+
+The sanitizer costs dispatch-pipelining (context-manager toggles per
+step; debug_nans blocks on every step's outputs) and must stay off
+in production runs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+ENV_VAR = 'KFAC_SANITIZE'
+MODES = ('transfer', 'nan', 'retrace')
+
+
+class SanitizerError(RuntimeError):
+    """A sanitizer gate tripped (transfer/retrace violation)."""
+
+
+@contextlib.contextmanager
+def _device_get_interposer():
+    """Raise on any ``jax.device_get`` within the region.
+
+    The CPU-backend arm of the transfer gate (see module docs): XLA's
+    transfer guard is a no-op when arrays are host-resident, but an
+    explicit ``device_get`` on the hot path is a violation on every
+    backend — it blocks the host on device completion. Patches the
+    public binding for the region's duration (the engine loop is
+    single-threaded; restored on exit even on error)."""
+    import jax
+
+    def _blocked(*args, **kwargs):
+        raise SanitizerError(
+            'KFAC_SANITIZE=transfer: jax.device_get inside a warm '
+            'step dispatch — a host sync on the hot path. Drain the '
+            'value asynchronously (metrics sink) or move the read '
+            'to a documented blocking point (and waive it in lint)')
+
+    orig = jax.device_get
+    jax.device_get = _blocked
+    try:
+        yield
+    finally:
+        jax.device_get = orig
+
+
+def parse_modes(value: str | None) -> frozenset:
+    """Parse a ``KFAC_SANITIZE`` value; raises on unknown modes so a
+    typo ('KFAC_SANITIZE=transfers') cannot silently sanitize
+    nothing."""
+    if not value:
+        return frozenset()
+    modes = frozenset(s.strip() for s in value.split(',') if s.strip())
+    unknown = sorted(modes - set(MODES))
+    if unknown:
+        raise ValueError(
+            f'{ENV_VAR}={value!r}: unknown sanitizer mode(s) '
+            f'{unknown} (choose from {list(MODES)})')
+    return modes
+
+
+class Sanitizer:
+    """Per-epoch sanitizer (engine-owned; see module docs).
+
+    A Sanitizer with no modes is inert: ``step_guard`` degrades to a
+    null context and ``after_step`` returns immediately, so the
+    engine wires it unconditionally without forking its step loop.
+    """
+
+    def __init__(self, modes=()):
+        self.modes = frozenset(modes)
+        self._warm_variants: set = set()
+
+    def __bool__(self) -> bool:
+        return bool(self.modes)
+
+    @classmethod
+    def from_env(cls, environ=None) -> 'Sanitizer':
+        return cls(parse_modes((environ or os.environ).get(ENV_VAR)))
+
+    def _warm_set(self, step_fn) -> set:
+        """The per-step-fn warm-variant set, attached to the step
+        callable itself so it lives exactly as long as the compiled
+        variant cache does — a Sanitizer is rebuilt every epoch (the
+        env is re-read), and a per-epoch set would re-exempt the
+        first dispatch of every flag combination in every epoch
+        (e.g. the once-per-window inverse firing would NEVER be
+        guarded on a one-window epoch). Falls back to the
+        sanitizer-local set for callables that refuse attributes."""
+        warm = getattr(step_fn, '_kfac_sanitize_warm', None)
+        if warm is None:
+            warm = set()
+            try:
+                step_fn._kfac_sanitize_warm = warm
+            except (AttributeError, TypeError):
+                warm = self._warm_variants
+        return warm
+
+    def step_guard(self, step_fn, flags: dict):
+        """Context manager wrapping ONE dispatch of ``step_fn``.
+
+        ``flags`` is the step's static cadence-flag dict — the first
+        dispatch of each distinct combination is the compile step
+        and runs without the transfer guard (see module docs); every
+        later dispatch of that combination is steady-state hot path
+        and must not transfer device->host. The nan gate applies to
+        every dispatch uniformly (a per-step flip of ``debug_nans``
+        would fork the jit trace cache).
+        """
+        if not self.modes:
+            return contextlib.nullcontext()
+        import jax
+        stack = contextlib.ExitStack()
+        if 'nan' in self.modes:
+            stack.enter_context(jax.debug_nans(True))
+        if 'transfer' in self.modes:
+            warm = self._warm_set(step_fn)
+            key = tuple(sorted(flags.items()))
+            if key in warm:
+                stack.enter_context(
+                    jax.transfer_guard_device_to_host('disallow'))
+                stack.enter_context(_device_get_interposer())
+            else:
+                warm.add(key)
+        return stack
+
+    def after_step(self, step_fn, step: int) -> None:
+        """Post-dispatch checks (currently: the retrace tally)."""
+        if 'retrace' not in self.modes:
+            return
+        counts = getattr(step_fn, 'trace_counts', None)
+        if not counts:
+            return
+        retraced = {k: n for k, n in counts.items() if n > 1}
+        if retraced:
+            raise SanitizerError(
+                f'KFAC_SANITIZE=retrace: step {step} left program '
+                f'variant(s) traced more than once: {retraced} — '
+                'the one-compile-per-variant contract is broken '
+                '(PERF.md pitfalls 2-3; see the retrace events in '
+                'the metrics stream for the variant labels)')

@@ -1,0 +1,278 @@
+"""Cross-file surface-consistency checks (the ``surface`` family).
+
+The knob surface spans five places that must agree:
+
+  1. ``training.optimizers.OptimConfig`` — the field registry;
+  2. ``training.optimizers.TUNABLE_FIELDS`` — the subset a tuned
+     artifact may override (must be ⊆ the OptimConfig fields);
+  3. the three example CLIs — every tunable needs its flag in each
+     (``--kfac-update-freq`` style, see :data:`FLAG_ALIASES`);
+  4. ``autotune.space.default_space()`` knobs and
+     ``autotune.driver.kfac_overrides`` special-cases — both must
+     reference real tunable fields;
+  5. ``observability.sink.EVENT_KINDS`` — every literal event name
+     emitted anywhere in the package must be registered there.
+
+Everything here is *static* (AST over the source tree, no imports) so
+the lint CLI stays fast and jax-free; ``tests/test_surface.py`` is
+the semantic double-check that imports the real modules, so tier-1
+catches drift even when lint is skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from distributed_kfac_pytorch_tpu.analysis.rules import Finding
+
+#: OptimConfig field -> CLI flag, where the mechanical
+#: underscores->dashes mapping does not hold.
+FLAG_ALIASES = {
+    'kfac_inv_update_freq': '--kfac-update-freq',
+    'factor_decay': '--stat-decay',
+    'weight_decay': '--wd',
+}
+
+EXAMPLE_CLIS = ('train_cifar10_resnet.py', 'train_imagenet_resnet.py',
+                'train_language_model.py')
+
+
+def flag_for(field: str) -> str:
+    return FLAG_ALIASES.get(field, '--' + field.replace('_', '-'))
+
+
+# ---------------------------------------------------------------------------
+# AST extraction helpers
+# ---------------------------------------------------------------------------
+
+def _parse(path: pathlib.Path) -> ast.AST | None:
+    try:
+        return ast.parse(path.read_text())
+    except (OSError, SyntaxError):
+        return None
+
+
+def _tuple_literal(tree: ast.AST, name: str
+                   ) -> tuple[list[str], int] | None:
+    """Top-level ``NAME = ('a', 'b', ...)`` -> (values, lineno)."""
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == name
+                        for t in node.targets)
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            vals = [e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+            return vals, node.lineno
+    return None
+
+
+def _dataclass_fields(tree: ast.AST, classname: str) -> list[str]:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == classname:
+            return [s.target.id for s in node.body
+                    if isinstance(s, ast.AnnAssign)
+                    and isinstance(s.target, ast.Name)]
+    return []
+
+
+def _knob_names(tree: ast.AST, func: str) -> list[tuple[str, int]]:
+    """First-arg string literals of ``Knob(...)`` calls inside
+    ``func``."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == func:
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Name)
+                        and sub.func.id == 'Knob' and sub.args
+                        and isinstance(sub.args[0], ast.Constant)):
+                    out.append((sub.args[0].value, sub.lineno))
+    return out
+
+
+def _name_compare_literals(tree: ast.AST, func: str, var: str
+                           ) -> list[tuple[str, int]]:
+    """String literals ``var`` is compared against inside ``func``
+    (``name == 'x'`` / ``name in ('x', 'y')``)."""
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.FunctionDef) and node.name == func):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Compare):
+                continue
+            if not (isinstance(sub.left, ast.Name)
+                    and sub.left.id == var):
+                continue
+            for comp in sub.comparators:
+                if (isinstance(comp, ast.Constant)
+                        and isinstance(comp.value, str)):
+                    out.append((comp.value, sub.lineno))
+                elif isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                    out.extend(
+                        (e.value, sub.lineno) for e in comp.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str))
+    return out
+
+
+def _cli_flags(tree: ast.AST) -> set[str]:
+    """Every ``add_argument('--flag', ...)`` literal in the file."""
+    flags = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == 'add_argument' and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            flags.add(node.args[0].value)
+    return flags
+
+
+def _event_literals(tree: ast.AST) -> list[tuple[str, int]]:
+    """Literal event names this module emits: first-arg strings of
+    ``*.event_record('x', ...)`` / ``*._event('x', ...)`` calls plus
+    ``{'event': 'x', ...}`` dict literals."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            attr = (node.func.attr
+                    if isinstance(node.func, ast.Attribute) else None)
+            if (attr in ('event_record', '_event') and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                out.append((node.args[0].value, node.lineno))
+        elif isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if (isinstance(k, ast.Constant) and k.value == 'event'
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    out.append((v.value, node.lineno))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The checks
+# ---------------------------------------------------------------------------
+
+def check_surface(package_dir: str | pathlib.Path,
+                  examples_dir: str | pathlib.Path | None = None
+                  ) -> tuple[list[Finding], list[str]]:
+    """Run every cross-file check; returns ``(findings, skipped)``.
+
+    ``skipped`` lists checks that could not run (e.g. no examples/
+    directory in an installed-package tree) — reported, never silent.
+    """
+    pkg = pathlib.Path(package_dir)
+    findings: list[Finding] = []
+    skipped: list[str] = []
+
+    def emit(path: pathlib.Path, line: int, message: str):
+        findings.append(Finding(str(path), line, 0, 'surface-drift',
+                                'surface', message))
+
+    opt_path = pkg / 'training' / 'optimizers.py'
+    opt_tree = _parse(opt_path)
+    fields: list[str] = []
+    tunables: list[str] = []
+    if opt_tree is None:
+        skipped.append('optimizers.py unreadable: TUNABLE_FIELDS/'
+                       'OptimConfig checks skipped')
+    else:
+        fields = _dataclass_fields(opt_tree, 'OptimConfig')
+        tup = _tuple_literal(opt_tree, 'TUNABLE_FIELDS')
+        if not fields or tup is None:
+            skipped.append('OptimConfig/TUNABLE_FIELDS not found: '
+                           'surface checks degraded')
+        else:
+            tunables, tline = tup
+            for t in tunables:
+                if t not in fields:
+                    emit(opt_path, tline,
+                         f'TUNABLE_FIELDS entry {t!r} is not an '
+                         'OptimConfig field — a tuned artifact '
+                         'naming it would be rejected at apply time')
+            if len(set(tunables)) != len(tunables):
+                emit(opt_path, tline,
+                     'TUNABLE_FIELDS contains duplicates')
+
+    # autotune space knobs reference tunable fields
+    space_path = pkg / 'autotune' / 'space.py'
+    space_tree = _parse(space_path)
+    if space_tree is None:
+        skipped.append('autotune/space.py unreadable: knob check '
+                       'skipped')
+    elif tunables:
+        for knob, line in _knob_names(space_tree, 'default_space'):
+            if knob not in tunables:
+                emit(space_path, line,
+                     f'autotune space knob {knob!r} is not in '
+                     'TUNABLE_FIELDS — the driver could commit an '
+                     'artifact apply_tuned must reject')
+
+    # kfac_overrides special-cases reference tunable fields
+    driver_path = pkg / 'autotune' / 'driver.py'
+    driver_tree = _parse(driver_path)
+    if driver_tree is None:
+        skipped.append('autotune/driver.py unreadable: '
+                       'kfac_overrides check skipped')
+    elif tunables:
+        for name, line in _name_compare_literals(
+                driver_tree, 'kfac_overrides', 'name'):
+            if name not in tunables:
+                emit(driver_path, line,
+                     f'kfac_overrides special-cases {name!r}, which '
+                     'is not a TUNABLE_FIELDS entry (dead or stale '
+                     'mapping)')
+
+    # every tunable has its CLI flag in all three examples
+    if examples_dir is None:
+        examples_dir = pkg.parent / 'examples'
+    examples_dir = pathlib.Path(examples_dir)
+    if not examples_dir.is_dir():
+        skipped.append(f'{examples_dir}: no examples directory — '
+                       'CLI-flag coverage check skipped')
+    elif tunables:
+        for cli in EXAMPLE_CLIS:
+            cli_path = examples_dir / cli
+            cli_tree = _parse(cli_path)
+            if cli_tree is None:
+                skipped.append(f'{cli}: unreadable — CLI-flag '
+                               'coverage check skipped for it')
+                continue
+            flags = _cli_flags(cli_tree)
+            for field in tunables:
+                want = flag_for(field)
+                if want not in flags:
+                    emit(cli_path, 1,
+                         f'tunable {field!r} has no {want} flag in '
+                         f'{cli} — the knob surface must stay '
+                         'consistent across all three example CLIs')
+
+    # every literal event name is registered in sink.EVENT_KINDS
+    sink_path = pkg / 'observability' / 'sink.py'
+    sink_tree = _parse(sink_path)
+    kinds = _tuple_literal(sink_tree, 'EVENT_KINDS') \
+        if sink_tree is not None else None
+    if kinds is None:
+        skipped.append('observability/sink.py has no EVENT_KINDS '
+                       'registry: event-name check skipped')
+    else:
+        registry = set(kinds[0])
+        for py in sorted(pkg.rglob('*.py')):
+            if '__pycache__' in py.parts:
+                continue
+            tree = _parse(py)
+            if tree is None:
+                continue
+            for name, line in _event_literals(tree):
+                if name not in registry:
+                    emit(py, line,
+                         f'event name {name!r} is not in '
+                         'observability.sink.EVENT_KINDS — register '
+                         'it so report/gate consumers can rely on '
+                         'one registry')
+
+    return findings, skipped

@@ -73,6 +73,24 @@ from typing import Any
 SCHEMA_VERSION = 4
 ACCEPTED_SCHEMAS = (1, 2, 3, 4)
 RECORD_KINDS = ('meta', 'step', 'epoch', 'event', 'memory')
+# The ONE registry of event names a ``kind='event'`` record may carry
+# (r15): report/gate consumers key on these strings, so every emitter
+# in the tree must draw from here — ``analysis.surface`` statically
+# checks that every literal ``event_record('x')`` / ``{'event': 'x'}``
+# in the package names a registered kind, and ``tests/test_surface.py``
+# is the semantic pin. Add the name HERE first when introducing a new
+# event.
+EVENT_KINDS = (
+    'compile',            # first dispatch of a program variant (r10)
+    'retrace',            # a variant re-traced — contract breach (r10)
+    'preemption',         # resilience drain began (r8)
+    'checkpoint_save',    # step checkpoint written (r8)
+    'restore',            # resume restored a checkpoint (r8)
+    'topology_change',    # elastic resume changed the world (r11)
+    'autotune_apply',     # --tuned-config overlay applied (r12)
+    'autotune_fallback',  # --tuned-config rejected, fail-closed (r12)
+    'autotune_backoff',   # cadence-backoff stretch/relax (r12)
+)
 # Dead incarnations kept per metrics path (<path>.prev.1 newest ..
 # .prev.N oldest); older ones are pruned on relaunch.
 PREV_INCARNATIONS_KEPT = 5

@@ -16,6 +16,7 @@ from typing import Any, Callable, Iterable
 import jax
 import jax.numpy as jnp
 
+from distributed_kfac_pytorch_tpu.analysis import sanitize as _sanitize
 from distributed_kfac_pytorch_tpu.observability import tracing
 from distributed_kfac_pytorch_tpu.parallel.distributed import KFAC_AXES
 from distributed_kfac_pytorch_tpu.training.utils import Metric, accuracy
@@ -206,6 +207,13 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
     ``metrics_sink`` like the compile telemetry. Requires
     ``barrier_probe`` to act on skew (without one the policy is
     inert).
+
+    ``KFAC_SANITIZE=transfer,nan,retrace`` (env var, r15): run the
+    epoch under the runtime sanitizer gates — device->host transfer
+    guard around warm step dispatches, ``jax.debug_nans`` on every
+    dispatch, and an after-step retrace check against the builder's
+    ``trace_counts``. See :mod:`analysis.sanitize`; unset (default)
+    is the unsanitized path.
     """
     if static_cadence == 'auto':
         import inspect
@@ -238,6 +246,7 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
         # restoring ``step`` would silently shift the schedule). Checked
         # BEFORE the epoch so a desynced state cannot train a whole
         # epoch on the wrong schedule; one device sync per epoch.
+        # kfaclint: waive[host-sync] documented blocking point: ONE device sync per epoch, before any step is dispatched
         kstep = int(jax.device_get(state.kfac_state['step']))
         if kstep != state.step:
             raise RuntimeError(
@@ -300,6 +309,10 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
             'for the epoch')
         inv_staleness = 0
         chunks = 1
+    # r15 runtime sanitizer gates (KFAC_SANITIZE=transfer,nan,retrace
+    # — see analysis.sanitize). Env read once per epoch; unset = an
+    # inert sanitizer whose step guard is a null context.
+    sanitizer = _sanitize.Sanitizer.from_env()
     meters: dict[str, Metric] = {}
     t0 = time.perf_counter()
     n_batches = 0
@@ -328,9 +341,12 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
             # is derived, so attribution reflects what actually ran.
             flags = cadence_policy.adjust(state.step, flags, wait_ms)
         t_it = time.perf_counter()
-        (state.params, state.opt_state, state.kfac_state, state.extra_vars,
-         metrics) = step_fn(state.params, state.opt_state, state.kfac_state,
-                            state.extra_vars, batch, hyper, **flags)
+        with sanitizer.step_guard(step_fn, flags):
+            (state.params, state.opt_state, state.kfac_state,
+             state.extra_vars, metrics) = step_fn(
+                state.params, state.opt_state, state.kfac_state,
+                state.extra_vars, batch, hyper, **flags)
+        sanitizer.after_step(step_fn, state.step)
         dt = time.perf_counter() - t_it
         # A queued compile event right after the call means THIS step's
         # wall time is dominated by trace+XLA compile, not training

@@ -154,6 +154,10 @@ def parse_args(argv=None):
                         '(reference --coallocate-layer-factors)')
     p.add_argument('--symmetry-aware-comm', action='store_true',
                    help='triu-packed factor allreduce (halved bytes)')
+    p.add_argument('--bf16-inverses', action='store_true',
+                   help='bf16 inverse storage (decompositions stay '
+                        'fp32) — halves the K-FAC inverse state '
+                        '(PERF.md round 5)')
     p.add_argument('--bf16-factors', action='store_true',
                    help='bf16 factor storage/averaging + bf16 covariance '
                         'matmul inputs (matmuls accumulate fp32); the '
@@ -237,6 +241,7 @@ def main(argv=None):
         kfac_update_freq_alpha=args.kfac_update_freq_alpha,
         kfac_update_freq_schedule=args.kfac_update_freq_decay,
         bf16_factors=args.bf16_factors,
+        bf16_inverses=args.bf16_inverses,
         bf16_precond=args.bf16_precond,
         kfac_metrics=bool(args.kfac_metrics),
         nonfinite_guard=obs.cli.wants_guard(args))

@@ -1,0 +1,83 @@
+#!/usr/bin/env bash
+# kfaclint CI smoke (r15), the standalone/CI-pipeline form of
+# tests/test_lint.py + tests/test_surface.py — wired next to the
+# observability gate in the verify flow:
+#   1. lint the clean tree (exit 0 required; machine verdict pinned);
+#   2. assert the seeded-violation fixtures FAIL (exit 1) — a linter
+#      that cannot fail is decorative;
+#   3. run a representative fast-tier engine module under
+#      KFAC_SANITIZE=transfer,nan to prove the runtime sanitizer
+#      gates hold on real train loops (the dynamic oracle), and that
+#      the sanitizer catches a seeded hot-path host sync.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== 1. clean-tree lint =="
+python -m distributed_kfac_pytorch_tpu.analysis.lint
+
+python -m distributed_kfac_pytorch_tpu.analysis.lint --json \
+    > /tmp/kfaclint.json
+python - /tmp/kfaclint.json <<'EOF'
+import json, sys
+v = json.load(open(sys.argv[1]))
+assert v['pass'] is True, v
+assert set(v) == {'pass', 'n_files', 'n_findings', 'n_waived',
+                  'findings', 'unused_waivers', 'skipped'}, sorted(v)
+assert v['n_findings'] == 0
+print(f"lint --json OK ({v['n_files']} files, "
+      f"{v['n_waived']} documented waivers)")
+EOF
+
+echo "== 2. seeded violations must fail =="
+for fixture in bad_host_sync bad_retrace bad_axis bad_dtype; do
+    rc=0
+    python -m distributed_kfac_pytorch_tpu.analysis.lint \
+        --assume-hot "tests/fixtures/lint/$fixture.py" \
+        > /dev/null 2>&1 || rc=$?
+    # exactly 1 (violations found): rc 0 means the rule went blind,
+    # rc 2 means the fixture itself is gone/unreadable
+    if [ "$rc" -ne 1 ]; then
+        echo "seeded violation $fixture.py: expected lint rc 1," \
+             "got $rc" >&2
+        exit 1
+    fi
+    echo "  $fixture.py fails as expected (rc 1)"
+done
+# waived violations must pass (the waiver syntax is load-bearing)
+python -m distributed_kfac_pytorch_tpu.analysis.lint \
+    --assume-hot tests/fixtures/lint/waived_ok.py > /dev/null
+echo "  waived_ok.py passes as expected"
+
+echo "== 3. sanitizer mode over a real engine module =="
+JAX_PLATFORMS=cpu KFAC_SANITIZE=transfer,nan \
+python -m pytest tests/test_static_cadence.py -q -m 'not slow' \
+    -p no:cacheprovider
+
+# ... and the sanitizer must CATCH a violation (load-bearing, not
+# decorative): a hot-path device_get inside a warm step dispatch.
+JAX_PLATFORMS=cpu KFAC_SANITIZE=transfer python - <<'EOF'
+import jax, jax.numpy as jnp, numpy as np
+from distributed_kfac_pytorch_tpu.analysis import sanitize
+from distributed_kfac_pytorch_tpu.training import engine
+
+@jax.jit
+def mul(p, b):
+    return p * 1.001, jnp.mean(b)
+
+def dirty(params, opt_state, kstate, extra_vars, batch, hyper):
+    params, loss = mul(params, batch)
+    jax.device_get(loss)  # seeded hot-path host sync
+    return params, opt_state, kstate, extra_vars, {'loss': loss}
+
+state = engine.TrainState(params=jnp.ones(()), opt_state=None,
+                          kfac_state=None, extra_vars={})
+try:
+    engine.train_epoch(dirty, state, [np.ones(4, np.float32)] * 3,
+                       {}, static_cadence=None)
+except sanitize.SanitizerError as e:
+    print('sanitizer caught the seeded violation OK')
+else:
+    raise SystemExit('sanitizer MISSED the seeded hot-path host sync')
+EOF
+
+echo "lint smoke OK"

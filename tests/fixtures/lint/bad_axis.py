@@ -1,0 +1,10 @@
+"""Seeded collective-axis violations: string-literal axis names."""
+import jax
+
+
+def reduce_metrics(m):
+    m = jax.lax.pmean(m, 'kfac_ig')                       # axis-literal
+    m = jax.lax.psum(m, axis_name=('kfac_ig', 'kfac_gw'))  # axis-literal
+    g = jax.lax.all_gather(m, 'kfac_gw', tiled=True)      # axis-literal
+    r = jax.lax.axis_index('kfac_ig')                     # axis-literal
+    return m, g, r

@@ -1,0 +1,12 @@
+"""Seeded dtype violations: bf16-flavored matmuls without fp32
+accumulation pinned."""
+import jax.numpy as jnp
+
+
+def factor_update(a, g, compute_dtype):
+    a_bf16 = a.astype(compute_dtype)
+    cov = jnp.matmul(a_bf16.T, a_bf16)             # dtype-matmul-accum
+    cov2 = jnp.einsum('bi,bj->ij',
+                      g.astype(jnp.bfloat16),
+                      g.astype(jnp.bfloat16))      # dtype-matmul-accum
+    return cov, cov2

@@ -1,0 +1,19 @@
+"""Seeded host-sync violations (one per rule in the family)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def step(state, batch):
+    loss = jnp.mean(batch)
+    lossf = loss.item()                    # host-item
+    kstep = jax.device_get(state['step'])  # host-device-get
+    norm = float(jnp.linalg.norm(batch))   # host-scalar-cast
+    if jnp.any(jnp.isnan(batch)):          # host-implicit-bool
+        norm = 0.0
+    if jnp.max(batch) > 3.0:               # host-implicit-bool (compare)
+        norm = 1.0
+    while jnp.linalg.norm(batch) > 1.0:    # host-implicit-bool (while)
+        batch = batch * 0.5
+    arr = np.asarray(jnp.square(batch))    # host-np-asarray
+    return lossf, kstep, norm, arr

@@ -1,0 +1,18 @@
+"""Seeded retrace hazards (one per rule in the family)."""
+import jax
+
+
+class Module:
+    def run(self, xs, step_fn):
+        for x in xs:
+            fn = jax.jit(lambda v: v * 2)      # retrace-jit-in-loop
+            fn(x)
+        # retrace-variant-flag: float/str literals are not canonical
+        # variant-key values (bool/int/None only)
+        step_fn(x, factor_update=1.0)
+        step_fn(x, inv_chunk='0')
+
+    @jax.jit
+    def traced(self, x):
+        self.cache = x                         # retrace-traced-mutation
+        return x + 1

@@ -1,0 +1,16 @@
+"""The same collectives named via the canonical axis constants."""
+import jax
+
+from distributed_kfac_pytorch_tpu.parallel.distributed import (
+    GRAD_WORKER_AXIS,
+    INV_GROUP_AXIS,
+    KFAC_AXES,
+)
+
+
+def reduce_metrics(m):
+    m = jax.lax.pmean(m, INV_GROUP_AXIS)
+    m = jax.lax.psum(m, axis_name=KFAC_AXES)
+    g = jax.lax.all_gather(m, GRAD_WORKER_AXIS, tiled=True)
+    r = jax.lax.axis_index(INV_GROUP_AXIS)
+    return m, g, r

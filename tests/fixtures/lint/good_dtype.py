@@ -1,0 +1,15 @@
+"""The same matmuls with the r6 contract honored: bf16 operands,
+fp32 accumulation via preferred_element_type."""
+import jax.numpy as jnp
+
+
+def factor_update(a, g, compute_dtype):
+    a_bf16 = a.astype(compute_dtype)
+    cov = jnp.matmul(a_bf16.T, a_bf16,
+                     preferred_element_type=jnp.float32)
+    cov2 = jnp.einsum('bi,bj->ij',
+                      g.astype(jnp.bfloat16),
+                      g.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+    fp32_path = jnp.matmul(a.T, a)   # fp32 operands: no bf16 flavor
+    return cov, cov2, fp32_path

@@ -1,0 +1,18 @@
+"""The same shapes written retrace-clean: jit hoisted out of the
+loop, canonical variant-key flags, state threaded not mutated."""
+import jax
+
+
+class Module:
+    def run(self, xs, step_fn):
+        fn = jax.jit(lambda v: v * 2)          # built once, reused
+        for x in xs:
+            fn(x)
+        step_fn(x, factor_update=True)         # canonical bool
+        step_fn(x, inv_chunk=0)                # canonical int
+        step_fn(x, inv_chunk=None)             # canonical None
+
+    @jax.jit
+    def traced(self, x, cache):
+        cache = cache + x                      # threaded through args
+        return x + 1, cache

@@ -1,0 +1,9 @@
+"""Seeded surface drift: the CLI exposes only one of the two
+tunables (inv_pipeline_chunks has no flag)."""
+import argparse
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--bf16-precond', action='store_true')
+    return p

@@ -1,0 +1,12 @@
+"""Seeded surface drift: kfac_overrides special-cases a stale knob
+name."""
+
+
+def kfac_overrides(knobs):
+    kwargs = {}
+    for name, value in knobs.items():
+        if name == 'bf16_precond':
+            kwargs['precond_compute_dtype'] = 'bf16'
+        elif name == 'bf16_preconditioner':   # stale field name
+            kwargs['precond_compute_dtype'] = 'bf16'
+    return kwargs

@@ -1,0 +1,13 @@
+"""Seeded surface drift: a space knob not in TUNABLE_FIELDS."""
+
+
+class Knob:
+    def __init__(self, name, values, doc=''):
+        self.name, self.values, self.doc = name, values, doc
+
+
+def default_space():
+    return [
+        Knob('bf16_precond', (False, True)),
+        Knob('chunk_count', (1, 2)),   # drifted name: not a tunable
+    ]

@@ -1,0 +1,13 @@
+"""Seeded surface drift: an emitter uses an event name missing from
+EVENT_KINDS."""
+
+EVENT_KINDS = (
+    'compile',
+    'retrace',
+)
+
+
+def emit(sink):
+    sink.event_record('compile', first_call_ms=1.0)       # registered
+    sink.event_record('unregistered_event', detail='x')   # drift
+    return {'event': 'another_rogue_event'}               # drift

@@ -1,0 +1,18 @@
+"""Seeded surface drift: TUNABLE_FIELDS names a field OptimConfig
+does not have (plus a duplicate)."""
+import dataclasses
+
+
+@dataclasses.dataclass
+class OptimConfig:
+    base_lr: float = 0.1
+    bf16_precond: bool = False
+    inv_pipeline_chunks: int = 1
+
+
+TUNABLE_FIELDS = (
+    'bf16_precond',
+    'inv_pipeline_chunks',
+    'inv_pipeline_chunks',     # duplicate
+    'bf16_precondition',       # not an OptimConfig field
+)

@@ -1,0 +1,11 @@
+"""A real violation carrying a valid waiver (rule-id form and
+family form, same-line and line-above placement) — lints clean."""
+import jax
+import jax.numpy as jnp
+
+
+def epoch_boundary(state, batch):
+    kstep = jax.device_get(state['step'])  # kfaclint: waive[host-device-get] documented blocking point: once per epoch
+    # kfaclint: waive[host-sync] epoch-end metric drain, host already blocks here
+    lossf = float(jnp.mean(batch))
+    return kstep, lossf

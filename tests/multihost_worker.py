@@ -344,6 +344,7 @@ def _time_grouped_collectives(cases, iters):
         mesh = Mesh(arr, KFAC_AXES)
         out[name] = {}
         for op_name, (x, op) in cases.items():
+            # kfaclint: waive[retrace-jit-in-loop] per-(layout,op) comm microbench: one program each, compile excluded by the warm call
             fn = jax.jit(jax.shard_map(op, mesh=mesh, in_specs=P(),
                                        out_specs=P(), check_vma=False))
             jax.block_until_ready(fn(x))  # compile + warm

@@ -1,0 +1,453 @@
+"""kfaclint: rule-matrix fixtures, waiver syntax, CLI/JSON contract,
+clean-tree gate, and the runtime sanitizer (analysis.sanitize).
+
+The fixture matrix under ``tests/fixtures/lint/`` carries one
+positive (``bad_*``) and one negative (``good_*``) case per rule
+family; ``surface_pkg_bad/`` is a miniature drifted package tree for
+the cross-file family. The clean-tree test IS the acceptance
+criterion: ``python -m distributed_kfac_pytorch_tpu.analysis.lint``
+exits 0 on this repo.
+"""
+
+import json
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_kfac_pytorch_tpu.analysis import lint as lint_cli
+from distributed_kfac_pytorch_tpu.analysis import sanitize
+from distributed_kfac_pytorch_tpu.analysis import surface
+from distributed_kfac_pytorch_tpu.analysis.rules import (
+    FAMILIES,
+    RULES,
+    is_hot_path,
+    lint_source,
+)
+from distributed_kfac_pytorch_tpu.training import engine
+
+FIXTURES = pathlib.Path(__file__).parent / 'fixtures' / 'lint'
+
+
+def run_rules(name: str, hot: bool = True):
+    path = FIXTURES / name
+    return lint_source(str(path), path.read_text(), hot=hot)
+
+
+def active_rules(findings):
+    return sorted({f.rule for f in findings if not f.waived})
+
+
+# ---------------------------------------------------------------------------
+# Rule matrix: one positive + one negative fixture per family
+# ---------------------------------------------------------------------------
+
+class TestRuleMatrix:
+    def test_host_sync_positive(self):
+        findings = run_rules('bad_host_sync.py')
+        assert active_rules(findings) == sorted([
+            'host-item', 'host-device-get', 'host-scalar-cast',
+            'host-implicit-bool', 'host-np-asarray'])
+        # the implicit-bool rule sees the direct call, the
+        # comparison form AND the while form
+        assert sum(1 for f in findings
+                   if f.rule == 'host-implicit-bool') == 3
+
+    def test_host_sync_negative(self):
+        assert active_rules(run_rules('good_host_sync.py')) == []
+
+    def test_host_sync_silent_off_hot_path(self):
+        # the family is scoped to the hot-path modules: the same bad
+        # file lints clean when not hot (examples/benchmarks do
+        # host-side work on purpose)
+        assert active_rules(run_rules('bad_host_sync.py',
+                                      hot=False)) == []
+
+    def test_retrace_positive(self):
+        rules = active_rules(run_rules('bad_retrace.py'))
+        assert rules == sorted([
+            'retrace-jit-in-loop', 'retrace-traced-mutation',
+            'retrace-variant-flag'])
+        # both non-canonical flag values are flagged individually
+        found = [f for f in run_rules('bad_retrace.py')
+                 if f.rule == 'retrace-variant-flag']
+        assert len(found) == 2
+
+    def test_retrace_negative(self):
+        assert active_rules(run_rules('good_retrace.py')) == []
+
+    def test_jit_in_loop_header_is_not_in_loop(self):
+        # for-iter/target and orelse evaluate once, not per
+        # iteration — a jit built there is a single build
+        src = ('import jax\n'
+               'def run(xs, f, g):\n'
+               '    for fn in (jax.jit(f), jax.jit(g)):\n'
+               '        fn(xs)\n'
+               '    else:\n'
+               '        h = jax.jit(f)\n'
+               '    while len(xs) > 0:\n'
+               '        xs = xs[1:]\n'
+               '    return h\n')
+        assert active_rules(lint_source('x.py', src)) == []
+        # ...but the while TEST re-evaluates per iteration
+        src_while = ('import jax\n'
+                     'def run(x):\n'
+                     '    while jax.jit(lambda v: v)(x) is not None:\n'
+                     '        x = None\n')
+        assert active_rules(lint_source('x.py', src_while)) == [
+            'retrace-jit-in-loop']
+
+    def test_axis_positive(self):
+        findings = run_rules('bad_axis.py', hot=False)
+        assert active_rules(findings) == ['axis-literal']
+        assert len(findings) == 4  # pmean, psum-kwarg-tuple,
+        #                            all_gather, axis_index
+
+    def test_axis_negative(self):
+        assert active_rules(run_rules('good_axis.py',
+                                      hot=False)) == []
+
+    def test_dtype_positive(self):
+        findings = run_rules('bad_dtype.py')
+        assert active_rules(findings) == ['dtype-matmul-accum']
+        assert len(findings) == 2
+
+    def test_dtype_negative(self):
+        assert active_rules(run_rules('good_dtype.py')) == []
+
+    def test_surface_positive(self):
+        findings, skipped = surface.check_surface(
+            FIXTURES / 'surface_pkg_bad',
+            examples_dir=FIXTURES / 'surface_examples_bad')
+        msgs = '\n'.join(f.message for f in findings)
+        assert "'bf16_precondition'" in msgs      # not an OptimConfig field
+        assert 'duplicates' in msgs
+        assert "'chunk_count'" in msgs            # space knob drift
+        assert "'bf16_preconditioner'" in msgs    # kfac_overrides drift
+        assert '--inv-pipeline-chunks' in msgs    # missing CLI flag
+        assert "'unregistered_event'" in msgs     # event registry drift
+        assert "'another_rogue_event'" in msgs
+        assert all(f.family == 'surface' for f in findings)
+
+    def test_surface_negative_real_tree(self):
+        findings, skipped = surface.check_surface(
+            lint_cli.package_root())
+        assert findings == [], [f.message for f in findings]
+        assert skipped == []
+
+
+# ---------------------------------------------------------------------------
+# Waiver syntax
+# ---------------------------------------------------------------------------
+
+class TestWaivers:
+    def test_valid_waivers_silence_but_are_reported(self):
+        findings = run_rules('waived_ok.py')
+        assert active_rules(findings) == []
+        waived = [f for f in findings if f.waived]
+        assert sorted({f.rule for f in waived}) == [
+            'host-device-get', 'host-scalar-cast']
+
+    def test_malformed_waivers_are_findings(self):
+        findings = run_rules('waiver_bad.py')
+        rules = active_rules(findings)
+        # the typo'd waiver is a finding AND its target stays live;
+        # the reason-less waiver likewise
+        assert 'waiver-unknown-rule' in rules
+        assert 'waiver-missing-reason' in rules
+        assert 'host-device-get' in rules
+
+    def test_docstring_waiver_syntax_is_not_a_waiver(self):
+        src = ('"""docs: # kfaclint: waive[host-sync] example"""\n'
+               'import jax\n'
+               'def f(s):\n'
+               '    return jax.device_get(s)\n')
+        findings = lint_source('x.py', src, hot=True)
+        assert active_rules(findings) == ['host-device-get']
+
+    def test_registry_is_consistent(self):
+        assert set(FAMILIES) == {
+            'host-sync', 'retrace', 'axis', 'dtype', 'surface'}
+        for rule, (family, doc) in RULES.items():
+            assert family in FAMILIES + ('waiver',), rule
+            assert doc
+
+
+# ---------------------------------------------------------------------------
+# CLI / JSON contract
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def test_clean_tree_exits_zero(self):
+        # THE acceptance criterion: the repo lints clean.
+        assert lint_cli.main([]) == 0
+
+    def test_seeded_violation_exits_one(self, capsys):
+        rc = lint_cli.main([str(FIXTURES / 'bad_axis.py')])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert 'axis-literal' in out and 'FAIL' in out
+
+    def test_assume_hot_arms_scoped_families(self):
+        assert lint_cli.main([str(FIXTURES / 'bad_host_sync.py')]) == 0
+        assert lint_cli.main(['--assume-hot',
+                              str(FIXTURES / 'bad_host_sync.py')]) == 1
+
+    def test_json_key_set_pinned(self, capsys):
+        rc = lint_cli.main(['--json', '--assume-hot',
+                            str(FIXTURES / 'bad_dtype.py')])
+        assert rc == 1
+        verdict = json.loads(capsys.readouterr().out)
+        assert set(verdict) == {
+            'pass', 'n_files', 'n_findings', 'n_waived', 'findings',
+            'unused_waivers', 'skipped'}
+        assert verdict['pass'] is False
+        assert verdict['n_files'] == 1
+        assert verdict['n_findings'] == 2
+        for f in verdict['findings']:
+            assert set(f) == {'path', 'line', 'col', 'rule', 'family',
+                              'message', 'waived'}
+
+    def test_json_clean_run(self, capsys):
+        rc = lint_cli.main(['--json', str(FIXTURES / 'good_axis.py')])
+        assert rc == 0
+        verdict = json.loads(capsys.readouterr().out)
+        assert verdict['pass'] is True and verdict['findings'] == []
+
+    def test_usage_error_exits_two(self):
+        assert lint_cli.main(['/no/such/path.py']) == 2
+
+    def test_explicit_package_path_runs_surface_checks(self, capsys):
+        # an explicit PATH covering the package must NOT silently
+        # drop the cross-file surface family
+        rc = lint_cli.main(['--json', str(lint_cli.package_root())])
+        assert rc == 0
+        verdict = json.loads(capsys.readouterr().out)
+        assert verdict['skipped'] == []
+
+    def test_repo_root_path_runs_surface_checks(self, capsys):
+        # an ANCESTOR of the package (the `lint .` CI invocation)
+        # covers it too; rc is 1 here only because an explicit repo
+        # root path also sweeps tests/fixtures/lint's intentionally
+        # bad files — the point is surface ran (no skip entry)
+        rc = lint_cli.main(['--json',
+                            str(lint_cli.package_root().parent)])
+        verdict = json.loads(capsys.readouterr().out)
+        assert verdict['skipped'] == []
+        assert rc == 1
+        # every active finding comes from the seeded fixtures — the
+        # real tree (package/examples/benchmarks/tests) is clean
+        assert all('fixtures/lint' in f['path']
+                   for f in verdict['findings'] if not f['waived'])
+
+    def test_family_filter_skips_surface_scan_with_reason(
+            self, capsys):
+        rc = lint_cli.main(['--json', '--family', 'axis'])
+        assert rc == 0
+        verdict = json.loads(capsys.readouterr().out)
+        assert any("--family filter excludes 'surface'" in s
+                   for s in verdict['skipped'])
+
+    def test_explicit_outside_path_reports_honest_skip(self, capsys):
+        rc = lint_cli.main(['--json', str(FIXTURES / 'good_axis.py')])
+        assert rc == 0
+        verdict = json.loads(capsys.readouterr().out)
+        assert any('do not cover the package' in s
+                   for s in verdict['skipped'])
+
+    def test_family_filter(self, capsys):
+        rc = lint_cli.main(['--json', '--assume-hot',
+                            '--family', 'axis',
+                            str(FIXTURES / 'bad_dtype.py')])
+        assert rc == 0  # dtype findings filtered out
+        assert json.loads(capsys.readouterr().out)['pass'] is True
+
+    def test_hot_path_scoping(self):
+        assert is_hot_path('preconditioner.py')
+        assert is_hot_path('parallel/distributed.py')
+        assert is_hot_path('ops/factors.py')
+        assert is_hot_path('layers/base.py')
+        assert is_hot_path('training/engine.py')
+        assert not is_hot_path('observability/sink.py')
+        assert not is_hot_path('autotune/driver.py')
+
+
+# ---------------------------------------------------------------------------
+# Runtime sanitizer (the dynamic oracle)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _mul(params, batch):
+    return params * 1.001, jnp.mean(batch)
+
+
+def _state():
+    return engine.TrainState(params=jnp.ones(()), opt_state=None,
+                             kfac_state=None, extra_vars={})
+
+
+def _data(n=3):
+    return [np.ones((4,), np.float32)] * n
+
+
+class TestSanitizer:
+    def test_parse_modes(self):
+        assert sanitize.parse_modes(None) == frozenset()
+        assert sanitize.parse_modes('') == frozenset()
+        assert sanitize.parse_modes('transfer,nan') == {
+            'transfer', 'nan'}
+        with pytest.raises(ValueError, match='transfers'):
+            sanitize.parse_modes('transfers')
+
+    def test_inert_without_env(self, monkeypatch):
+        monkeypatch.delenv(sanitize.ENV_VAR, raising=False)
+        s = sanitize.Sanitizer.from_env()
+        assert not s and s.modes == frozenset()
+
+    def test_transfer_gate_catches_hot_device_get(self, monkeypatch):
+        monkeypatch.setenv(sanitize.ENV_VAR, 'transfer')
+
+        def dirty(params, opt_state, kstate, extra_vars, batch, hyper):
+            params, loss = _mul(params, batch)
+            jax.device_get(loss)  # hot-path host sync
+            return params, opt_state, kstate, extra_vars, {'loss': loss}
+
+        with pytest.raises(sanitize.SanitizerError,
+                           match='jax.device_get inside a warm step'):
+            engine.train_epoch(dirty, _state(), _data(), {},
+                               static_cadence=None)
+        # the interposer must restore the real binding on error
+        assert float(jax.device_get(jnp.ones(()))) == 1.0
+
+    def test_transfer_gate_exempts_compile_step(self, monkeypatch):
+        # first dispatch of the (single) flag combo is the compile
+        # step: a host read there is legitimate (trace-time), so a
+        # 1-batch epoch passes even with a dirty step
+        monkeypatch.setenv(sanitize.ENV_VAR, 'transfer')
+
+        def dirty(params, opt_state, kstate, extra_vars, batch, hyper):
+            params, loss = _mul(params, batch)
+            jax.device_get(loss)
+            return params, opt_state, kstate, extra_vars, {'loss': loss}
+
+        m = engine.train_epoch(dirty, _state(), _data(1), {},
+                               static_cadence=None)
+        assert np.isfinite(m['loss'])
+
+    def test_transfer_gate_warm_set_survives_epochs(self, monkeypatch):
+        # the warm-variant set rides on the step_fn, not the
+        # per-epoch Sanitizer: a flag combo that dispatches once per
+        # epoch is only compile-exempt in the FIRST epoch — a second
+        # 1-batch epoch with the same step_fn must be guarded
+        monkeypatch.setenv(sanitize.ENV_VAR, 'transfer')
+
+        def dirty(params, opt_state, kstate, extra_vars, batch, hyper):
+            params, loss = _mul(params, batch)
+            jax.device_get(loss)
+            return params, opt_state, kstate, extra_vars, {'loss': loss}
+
+        state = _state()
+        engine.train_epoch(dirty, state, _data(1), {},
+                           static_cadence=None)
+        with pytest.raises(sanitize.SanitizerError,
+                           match='jax.device_get inside a warm step'):
+            engine.train_epoch(dirty, state, _data(1), {},
+                               static_cadence=None)
+
+    def test_transfer_gate_passes_clean_step(self, monkeypatch):
+        monkeypatch.setenv(sanitize.ENV_VAR, 'transfer,nan,retrace')
+
+        def clean(params, opt_state, kstate, extra_vars, batch, hyper):
+            params, loss = _mul(params, batch)
+            return params, opt_state, kstate, extra_vars, {'loss': loss}
+
+        m = engine.train_epoch(clean, _state(), _data(), {},
+                               static_cadence=None)
+        assert np.isfinite(m['loss'])
+
+    def test_nan_gate_raises_at_producer(self, monkeypatch):
+        monkeypatch.setenv(sanitize.ENV_VAR, 'nan')
+
+        def nan_step(params, opt_state, kstate, extra_vars, batch,
+                     hyper):
+            params, loss = _mul(params, batch)
+            return (params * jnp.inf * 0.0, opt_state, kstate,
+                    extra_vars, {'loss': loss})
+
+        with pytest.raises(FloatingPointError, match='nan'):
+            engine.train_epoch(nan_step, _state(), _data(), {},
+                               static_cadence=None)
+        # the flag must not leak past the guarded dispatch
+        assert not jax.config.jax_debug_nans
+
+    def test_retrace_gate_reads_trace_counts(self, monkeypatch):
+        monkeypatch.setenv(sanitize.ENV_VAR, 'retrace')
+
+        def step(params, opt_state, kstate, extra_vars, batch, hyper):
+            params, loss = _mul(params, batch)
+            return params, opt_state, kstate, extra_vars, {'loss': loss}
+
+        step.trace_counts = {(True, False, None): 1}
+        m = engine.train_epoch(step, _state(), _data(), {},
+                               static_cadence=None)
+        assert np.isfinite(m['loss'])
+
+        step.trace_counts = {(True, False, None): 2}  # a retrace
+        with pytest.raises(sanitize.SanitizerError, match='retrace'):
+            engine.train_epoch(step, _state(), _data(), {},
+                               static_cadence=None)
+
+    def test_real_kfac_step_is_sanitize_clean(self, monkeypatch):
+        """The load-bearing end-to-end check: a REAL distributed
+        K-FAC train epoch (static cadence, variant cache, factor +
+        inverse firings) runs clean under all three sanitizer gates
+        — warm hot-path dispatches provoke no device->host transfer,
+        no NaNs, no retraces."""
+        import flax.linen as nn
+        import optax
+
+        from distributed_kfac_pytorch_tpu import KFAC, CommMethod
+        from distributed_kfac_pytorch_tpu.parallel import (
+            distributed as D,
+        )
+
+        class Tiny(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.Dense(16, name='fc1')(x)
+                x = nn.relu(x)
+                return nn.Dense(4, name='fc2')(x)
+
+        model = Tiny()
+        kfac = KFAC(model, factor_update_freq=1, inv_update_freq=2,
+                    damping=0.003, lr=0.1)
+        x0 = jnp.zeros((2, 8))
+        variables, _ = kfac.init(jax.random.PRNGKey(0), x0)
+        params = variables['params']
+        mesh = D.make_kfac_mesh(comm_method=CommMethod.HYBRID_OPT,
+                                grad_worker_fraction=0.5)
+        dkfac = D.DistributedKFAC(kfac, mesh, params)
+        tx = optax.sgd(0.05)
+
+        def loss_fn(out, b):
+            import optax as _optax
+            return _optax.softmax_cross_entropy_with_integer_labels(
+                out, b[1]).mean()
+
+        step_fn = dkfac.build_train_step(loss_fn, tx, donate=False)
+        rng = np.random.default_rng(0)
+        data = [(rng.normal(size=(16, 8)).astype(np.float32),
+                 rng.integers(0, 4, 16).astype(np.int32))
+                for _ in range(6)]
+        state = engine.TrainState(
+            params=params, opt_state=tx.init(params),
+            kfac_state=dkfac.init_state(params), extra_vars={})
+        monkeypatch.setenv(sanitize.ENV_VAR, 'transfer,nan,retrace')
+        hyper = {'lr': 0.05, 'damping': 0.003,
+                 'factor_update_freq': 1, 'inv_update_freq': 2}
+        m = engine.train_epoch(step_fn, state, data, hyper)
+        assert np.isfinite(m['loss'])
+        assert state.step == 6
+        assert max(step_fn.trace_counts.values()) == 1
