@@ -19,6 +19,9 @@ vector —
     variant cache — the offline cross-check of the host-side
     ``trace_counts`` guard; any value above the baseline's (normally
     0) means a static-cadence program variant recompiled mid-run.
+  - ``selfheal_rollbacks`` (r16): in-process rollback count from the
+    self-healing ladder — a recovery, but a run that needed one
+    regressed against a baseline that needed none.
 
 — and compares it against a committed baseline with per-metric
 relative tolerances, exiting non-zero on any breach so CI can block
@@ -61,8 +64,13 @@ DEFAULT_TOLERANCES = {
     'max_over_median': 0.25,
     'peak_hbm_bytes': 0.05,
     'retraces': 0.0,
+    # r16 self-healing: in-process rollbacks are recoveries, but a run
+    # that needed one regressed against a baseline that needed none —
+    # the gate surfaces it (absolute count, like retraces). Baselines
+    # predating the metric skip it ("not in baseline").
+    'selfheal_rollbacks': 0.0,
 }
-_ABSOLUTE_METRICS = ('retraces',)
+_ABSOLUTE_METRICS = ('retraces', 'selfheal_rollbacks')
 
 
 def gate_metrics(records: list[dict]) -> dict:
@@ -75,6 +83,9 @@ def gate_metrics(records: list[dict]) -> dict:
     retraces = sum(1 for r in records
                    if r.get('kind') == 'event'
                    and r.get('event') == 'retrace')
+    rollbacks = sum(1 for r in records
+                    if r.get('kind') == 'event'
+                    and r.get('event') == 'selfheal_rollback')
     out = {
         'n_steps': dist['n_steps'] if dist else 0,
         'step_p50_ms': dist['p50_ms'] if dist else None,
@@ -83,6 +94,7 @@ def gate_metrics(records: list[dict]) -> dict:
         'max_over_median': (dist['max_over_median'] if dist else None),
         'peak_hbm_bytes': peak,
         'retraces': retraces,
+        'selfheal_rollbacks': rollbacks,
     }
     for k, v in out.items():
         if isinstance(v, float) and not math.isfinite(v):

@@ -94,6 +94,11 @@ class HealthMonitor:
         self.memory_growth_windows = int(memory_growth_windows)
         self.memory_growth_min_frac = memory_growth_min_frac
         self.events: list[str] = []
+        # Parallel per-event check kinds (same order as ``events``):
+        # the machine-readable classification ``summary()`` counts by
+        # (r16 satellite — the text messages alone forced consumers to
+        # regex the category back out).
+        self.event_kinds: list[str] = []
         self._last_factor_updates: float | None = None
         self._last_factor_step: int | None = None
         self._last_damping: float | None = None
@@ -114,16 +119,12 @@ class HealthMonitor:
     def observe(self, rec: dict) -> list[str]:
         """Consume one record; returns (and acts on) new events."""
         if rec.get('kind') == 'memory':
-            events = self._observe_memory(rec)
-            self.events.extend(events)
-            for e in events:
-                self._act(e)
-            return events
+            return self._record(self._observe_memory(rec))
         if rec.get('kind') != 'step':
             return []
         step = int(rec.get('step', 0))
         m = rec.get('metrics', {})
-        events: list[str] = []
+        events: list[tuple[str, str]] = []  # (kind, message)
 
         ms = rec.get('host_step_ms')
         if self.step_spike_zscore is not None and \
@@ -138,12 +139,13 @@ class HealthMonitor:
                           0.01 * self._ms_mean, 1e-9)
                 z = (ms - self._ms_mean) / std
                 if z > self.step_spike_zscore:
-                    events.append(
+                    events.append((
+                        'step_spike',
                         f'step {step}: step-time spike {ms:.3g} ms is '
                         f'{z:.1f} sigma above the plain-step mean '
                         f'{self._ms_mean:.3g} ms (threshold '
                         f'{self.step_spike_zscore:g}) — no K-FAC stage '
-                        'fired this step; suspect host/data/chip')
+                        'fired this step; suspect host/data/chip'))
             self._ms_n += 1
             delta = ms - self._ms_mean
             self._ms_mean += delta / self._ms_n
@@ -151,16 +153,18 @@ class HealthMonitor:
 
         skips = _num(m.get('kfac/nonfinite_skips'))
         if not math.isnan(skips) and skips > self._nonfinite_skips:
-            events.append(
+            events.append((
+                'nonfinite',
                 f'step {step}: non-finite candidate factor update '
                 f'(total {int(skips)}) — gradients/captures contained '
                 "NaN/Inf (skipped on device when the guard is armed, "
-                "i.e. --health-action skip/raise)")
+                "i.e. --health-action skip/raise)"))
             self._nonfinite_skips = skips
         for key in ('loss', 'kfac/grad_norm', 'kfac/precond_norm'):
             if key in m and not math.isfinite(_num(m[key])):
-                events.append(f'step {step}: non-finite {key} = '
-                              f'{m[key]!r}')
+                events.append(('nonfinite',
+                               f'step {step}: non-finite {key} = '
+                               f'{m[key]!r}'))
 
         fu = _num(m.get('kfac/factor_updates'))
         if not math.isnan(fu):
@@ -172,23 +176,27 @@ class HealthMonitor:
                   and self._last_factor_step is not None
                   and step - self._last_factor_step
                   > self.stale_after_steps):
-                events.append(
+                events.append((
+                    'factor_stale',
                     f'step {step}: factors stale — no factor update '
                     f'for {step - self._last_factor_step} steps '
-                    f'(limit {self.stale_after_steps})')
+                    f'(limit {self.stale_after_steps})'))
 
         damping = _num(m.get('kfac/damping'))
         if 'kfac/damping' in m:
             if not math.isfinite(damping) or damping <= 0.0:
-                events.append(f'step {step}: damping {m["kfac/damping"]!r}'
-                              ' is not a positive finite value')
+                events.append(('damping',
+                               f'step {step}: damping '
+                               f'{m["kfac/damping"]!r}'
+                               ' is not a positive finite value'))
             elif self._last_damping is not None and self._last_damping > 0:
                 ratio = max(damping / self._last_damping,
                             self._last_damping / damping)
                 if ratio > self.damping_jump_factor:
-                    events.append(
+                    events.append((
+                        'damping',
                         f'step {step}: damping jumped {ratio:.1f}x '
-                        f'({self._last_damping:g} -> {damping:g})')
+                        f'({self._last_damping:g} -> {damping:g})'))
             if math.isfinite(damping):
                 self._last_damping = damping
 
@@ -199,17 +207,23 @@ class HealthMonitor:
         # numerically harmless, the damping carries them).
         clipped = _num(m.get('kfac/eig_clipped'))
         if not math.isnan(clipped) and clipped > self._max_eig_clipped:
-            events.append(
+            events.append((
+                'eig_floor',
                 f'step {step}: {int(clipped)} eigenvalues at the 0.0 '
                 f'clip floor (limit {self.eig_clip_limit}, previous '
                 f'high {int(self._max_eig_clipped)}) — factors are '
-                'rank-deficient or numerically indefinite')
+                'rank-deficient or numerically indefinite'))
             self._max_eig_clipped = clipped
 
-        self.events.extend(events)
-        for e in events:
+        return self._record(events)
+
+    def _record(self, events: list[tuple[str, str]]) -> list[str]:
+        msgs = [msg for _kind, msg in events]
+        self.events.extend(msgs)
+        self.event_kinds.extend(kind for kind, _msg in events)
+        for e in msgs:
             self._act(e)
-        return events
+        return msgs
 
     def _observe_memory(self, rec: dict) -> list[str]:
         """Monotonic device-memory-growth detection (leak signature)."""
@@ -219,7 +233,7 @@ class HealthMonitor:
         if not isinstance(b, (int, float)) or not math.isfinite(b):
             return []
         b = float(b)
-        events: list[str] = []
+        events: list[tuple[str, str]] = []
         if self._mem_prev is None or b <= self._mem_prev:
             # Flat or falling watermark: a healthy steady state. Reset
             # the run and re-arm the latch.
@@ -233,12 +247,13 @@ class HealthMonitor:
             if (not self._mem_latched
                     and self._mem_run_len >= self.memory_growth_windows
                     and grown > self.memory_growth_min_frac):
-                events.append(
+                events.append((
+                    'memory_growth',
                     f"step {rec.get('step', '?')}: device memory grew "
                     f'monotonically over {self._mem_run_len} samples '
                     f'({start:.4g} -> {b:.4g} bytes_in_use, '
                     f'+{grown * 100:.1f}%) — leak signature (resident '
-                    'K-FAC state should be flat after warmup)')
+                    'K-FAC state should be flat after warmup)'))
                 self._mem_latched = True
         self._mem_prev = b
         return events
@@ -247,10 +262,27 @@ class HealthMonitor:
         if self.action == 'raise':
             raise HealthError(event)
         if self.action == 'warn':
+            # stacklevel: warn -> _act -> _record -> observe -> CALLER
+            # (the r16 _record hop added one frame; keep the warning
+            # attributed to whoever fed the record in).
             warnings.warn(f'KFAC health: {event}', RuntimeWarning,
-                          stacklevel=3)
+                          stacklevel=4)
 
     def summary(self) -> dict:
+        """Run-level health summary.
+
+        ``by_kind`` (r16 satellite) counts events per CHECK KIND
+        ('step_spike' / 'nonfinite' / 'factor_stale' / 'damping' /
+        'eig_floor' / 'memory_growth') — before it, only the
+        aggregate count and ``nonfinite_skips`` survived to the
+        summary and every consumer had to regex the text messages.
+        ``report --json`` carries it as ``health_event_counts``
+        (key-set pinned by tests/test_obs_perf.py).
+        """
+        by_kind: dict[str, int] = {}
+        for kind in self.event_kinds:
+            by_kind[kind] = by_kind.get(kind, 0) + 1
         return {'events': len(self.events),
+                'by_kind': by_kind,
                 'nonfinite_skips': int(self._nonfinite_skips),
                 'last_damping': self._last_damping}

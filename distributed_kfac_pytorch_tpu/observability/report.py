@@ -24,7 +24,11 @@ Prints, from the recorded stream alone (no live process needed):
   - straggler attribution (r10): when per-rank shards
     (``run.jsonl.rank<r>``, ``--straggler-shards``) sit next to the
     stream, per-host skew, slowest-rank frequency and barrier-wait
-    stats.
+    stats;
+  - self-healing (r16): the escalation ladder's decision trail —
+    damping escalations/decays, bucket quarantines/readmits,
+    in-process rollbacks, and checkpoint quarantines from the
+    verified resume walk (``resilience.selfheal``).
 
 A torn/truncated FINAL line (a host crashed mid-append) is skipped and
 counted in the header instead of refusing the stream; torn lines
@@ -187,6 +191,30 @@ def summarize(records: list[dict]) -> dict:
     # threshold emits stretch/relax pairs indefinitely, and neither
     # the report nor its --json consumer should scale with that (the
     # full sequence is on disk in the stream itself).
+    # Self-healing ladder events (r16): escalation/de-escalation,
+    # bucket quarantine/readmit, in-process rollbacks, and the verified
+    # resume walk's checkpoint quarantines. Same newest-window cap
+    # discipline as the autotune section (an oscillating ladder must
+    # not grow the report); the full sequence is in the stream.
+    selfheal_events = [{'event': r['event'], **dict(r.get('data', {}))}
+                       for r in events
+                       if r['event'].startswith('selfheal')
+                       or r['event'] == 'ckpt_quarantine']
+    selfheal = None
+    if selfheal_events:
+        count = lambda kind: sum(1 for e in selfheal_events
+                                 if e['event'] == kind)
+        selfheal = {
+            'n_events': len(selfheal_events),
+            'events': selfheal_events[-50:],
+            'escalations': count('selfheal_escalate'),
+            'deescalations': count('selfheal_deescalate'),
+            'quarantines': count('selfheal_quarantine'),
+            'readmits': count('selfheal_readmit'),
+            'rollbacks': count('selfheal_rollback'),
+            'ckpt_quarantines': count('ckpt_quarantine'),
+        }
+
     autotune_events = [{'event': r['event'], **dict(r.get('data', {}))}
                        for r in events
                        if r['event'].startswith('autotune')]
@@ -209,6 +237,7 @@ def summarize(records: list[dict]) -> dict:
 
     return {
         'autotune': autotune,
+        'selfheal': selfheal,
         'memory': memory,
         'compiles': compiles,
         'retraces': retraces,
@@ -237,7 +266,25 @@ def summarize(records: list[dict]) -> dict:
         'eig_clipped': _num(last.get('kfac/eig_clipped')),
         'bucket_norms': buckets,
         'health_events': list(monitor.events),
+        # Per-check-kind counts (r16 satellite: HealthMonitor.summary
+        # now classifies; only nonfinite_skips used to survive here).
+        'health_event_counts': monitor.summary()['by_kind'],
     }
+
+
+def _print_event_detail(w, events: list[dict], n_events: int,
+                        cap: int = 10) -> None:
+    """Shared newest-window event renderer (self-healing + autotune
+    sections): '(newest K of N)' note plus one sorted-detail line per
+    event — one place to change the cap or the formatting."""
+    shown = events[-cap:]
+    if n_events > len(shown):
+        w(f"  (newest {len(shown)} of {n_events}; the full "
+          'sequence is in the stream)')
+    for e in shown:
+        detail = ', '.join(f'{k}={v}' for k, v in sorted(e.items())
+                           if k != 'event')
+        w(f'  ! {e["event"]}: {detail}')
 
 
 def print_report(s: dict, out=None, torn: int = 0,
@@ -388,6 +435,16 @@ def print_report(s: dict, out=None, torn: int = 0,
               f"{_fmt(float('nan') if mean_skew is None else mean_skew, ' ms')}"
               f"  max "
               f"{_fmt(float('nan') if max_skew is None else max_skew, ' ms')}")
+    if s.get('selfheal'):
+        sh = s['selfheal']
+        w()
+        w(f"-- self-healing ({sh['n_events']} ladder event(s)) --")
+        w(f"damping escalations: {sh['escalations']} up / "
+          f"{sh['deescalations']} decayed   quarantine: "
+          f"{sh['quarantines']} gated / {sh['readmits']} re-admitted")
+        w(f"rollbacks: {sh['rollbacks']} in-process   checkpoint "
+          f"quarantines: {sh['ckpt_quarantines']}")
+        _print_event_detail(w, sh['events'], sh['n_events'])
     if s.get('autotune'):
         a = s['autotune']
         w()
@@ -395,20 +452,15 @@ def print_report(s: dict, out=None, torn: int = 0,
         w(f"policy backoffs: {a['backoffs']} stretch / "
           f"{a['relaxes']} relax   tuned-config: {a['applies']} "
           f"applied / {a['fallbacks']} fell back to defaults")
-        shown = a['events'][-10:]
-        if a['n_events'] > len(shown):
-            w(f"  (newest {len(shown)} of {a['n_events']}; the full "
-              'sequence is in the stream)')
-        for e in shown:
-            detail = ', '.join(f'{k}={v}' for k, v in sorted(e.items())
-                               if k != 'event')
-            w(f'  ! {e["event"]}: {detail}')
-    # Compile/retrace and autotune events have their own sections
-    # above; everything else in the event stream is resilience
-    # lifecycle (r8).
+        _print_event_detail(w, a['events'], a['n_events'])
+    # Compile/retrace, autotune and self-healing events have their own
+    # sections above; everything else in the event stream is
+    # resilience lifecycle (r8).
     resil_counts = {k: v for k, v in s['event_counts'].items()
-                    if k not in ('compile', 'retrace')
-                    and not k.startswith('autotune')}
+                    if k not in ('compile', 'retrace',
+                                 'ckpt_quarantine')
+                    and not k.startswith('autotune')
+                    and not k.startswith('selfheal')}
     if resil_counts:
         w()
         w('-- resilience events --')
@@ -467,6 +519,7 @@ def summary_json(s: dict, *, torn: int = 0,
         'compiles': s['compiles'],
         'retraces': s['retraces'],
         'autotune': s['autotune'],
+        'selfheal': s['selfheal'],
         'event_counts': s['event_counts'],
         'kfac': {
             'factor_updates': s['factor_updates'],
@@ -477,6 +530,7 @@ def summary_json(s: dict, *, torn: int = 0,
             'bucket_norms': s['bucket_norms'],
         },
         'health_events': s['health_events'],
+        'health_event_counts': s['health_event_counts'],
         'stragglers': stragglers,
         'torn_lines': torn,
     })

@@ -90,6 +90,16 @@ EVENT_KINDS = (
     'autotune_apply',     # --tuned-config overlay applied (r12)
     'autotune_fallback',  # --tuned-config rejected, fail-closed (r12)
     'autotune_backoff',   # cadence-backoff stretch/relax (r12)
+    # r16 self-healing ladder (resilience.selfheal; README
+    # "Self-healing" — the report's self-healing section and the
+    # gate's selfheal_rollbacks metric consume these):
+    'selfheal_escalate',    # damping multiplier raised (rung 2)
+    'selfheal_deescalate',  # damping multiplier decayed one notch
+    'selfheal_quarantine',  # bucket gated to SGD direction (rung 3)
+    'selfheal_readmit',     # parity probe passed, bucket re-admitted
+    'selfheal_rollback',    # in-process last-good restore (rung 4)
+    'ckpt_quarantine',      # corrupt/torn bundle skipped by the
+                            # verified resume/rollback walk (r16)
 )
 # Dead incarnations kept per metrics path (<path>.prev.1 newest ..
 # .prev.N oldest); older ones are pruned on relaunch.

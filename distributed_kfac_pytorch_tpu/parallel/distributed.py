@@ -1141,7 +1141,8 @@ class DistributedKFAC:
 
     @profiling.scope('kfac/precond')
     def _spmd_precondition(self, inv_stacks, diag_inv, grouped_inv,
-                           grads, damping, lr, with_stats: bool = False):
+                           grads, damping, lr, with_stats: bool = False,
+                           gates: dict | None = None):
         """Row-masked preconditioning + one ``psum`` gradient broadcast.
 
         Every member of a layer's inverse group computes its preconditioned
@@ -1152,6 +1153,16 @@ class DistributedKFAC:
         The KL-clip factor is assembled the same way: row-partial ``v·g``
         sums, ``psum``ed, so the scale matches the single-device path
         bit-for-bit in structure (reference preconditioner.py:661-682).
+
+        ``gates`` (r16 self-healing quarantine): per-shape-bucket 0/1
+        traced scalars — a gated-off bucket's layers serve the RAW
+        gradient (plain SGD direction). The blend happens on the
+        row-masked per-layer mats BEFORE the KL-clip and delivery
+        ``psum`` (the SGD fallback carries the same owner-row mask, so
+        the psum still sums exactly one contribution and the clip sees
+        the blended ``v·g``); replicated scalar gates keep the select
+        identical on every device. ``None`` = the bit-identical
+        historical path (see ``KFAC.precondition``).
         """
         kfac = self.kfac
         row = jax.lax.axis_index(INV_GROUP_AXIS)
@@ -1189,6 +1200,23 @@ class DistributedKFAC:
                 compute_dtype=kfac.precond_compute_dtype)
             mask = (row == self.assignment.layer_row[name]).astype(v.dtype)
             precond_mats[name] = v * mask
+
+        if gates is not None:
+            # Quarantine blend (r16): row-masked SGD fallback so the
+            # delivery psum still sums one owner contribution; where is
+            # a select, so a poisoned (NaN) preconditioned branch does
+            # not propagate into the blended output.
+            for name in precond_mats:
+                g = gates.get(obs_metrics.shape_key(
+                    grad_mats[name].shape))
+                if g is None:
+                    continue
+                pm = precond_mats[name]
+                own = (row == self.assignment.layer_row[name]).astype(
+                    pm.dtype)
+                precond_mats[name] = jnp.where(
+                    jnp.asarray(g, jnp.float32) >= 0.5, pm,
+                    grad_mats[name].astype(pm.dtype) * own)
 
         if kfac.kl_clip is not None:
             vg_sum = jnp.zeros((), jnp.float32)
@@ -1229,7 +1257,8 @@ class DistributedKFAC:
                   inv_update: bool | None = None,
                   inv_chunk: int | None = None,
                   factor_reduce: bool = False,
-                  factor_snapshot: bool = False) -> tuple[dict, dict]:
+                  factor_snapshot: bool = False,
+                  gates: dict | None = None) -> tuple[dict, dict]:
         """One distributed K-FAC update; call inside ``shard_map``.
 
         Same contract and cadence semantics as :meth:`KFAC.step`
@@ -1261,6 +1290,10 @@ class DistributedKFAC:
         (deferred window-boundary factor reduction / frozen-snapshot
         refresh) — static-cadence only, same contract as
         :meth:`KFAC.step`.
+
+        ``gates``: per-shape-bucket quarantine mask (r16 self-healing,
+        traced scalar values) — see :meth:`_spmd_precondition`;
+        ``None`` (default) keeps the historical program bit-identical.
         """
         kfac = self.kfac
         damping = kfac.damping if damping is None else damping
@@ -1392,7 +1425,8 @@ class DistributedKFAC:
 
         if not kfac.collect_metrics:
             precond = self._spmd_precondition(
-                inv_stacks, diag_inv, grouped_inv, grads, damping, lr)
+                inv_stacks, diag_inv, grouped_inv, grads, damping, lr,
+                gates=gates)
             new_state = {'step': step + 1, 'factors': factors,
                          'inv_stacks': inv_stacks, 'diag_inv': diag_inv,
                          'grouped_inv': grouped_inv,
@@ -1402,7 +1436,7 @@ class DistributedKFAC:
 
         precond, stats = self._spmd_precondition(
             inv_stacks, diag_inv, grouped_inv, grads, damping, lr,
-            with_stats=True)
+            with_stats=True, gates=gates)
         one = lambda: jnp.ones((), jnp.int32)
         zero = lambda: jnp.zeros((), jnp.int32)
         did_f = cadence_gate(factor_update, step, f_freq, one, zero)
@@ -1850,7 +1884,13 @@ class DistributedKFAC:
                     inv_update_freq=hyper.get('inv_update_freq'),
                     factor_update=factor_update, inv_update=inv_update,
                     inv_chunk=inv_chunk, factor_reduce=factor_reduce,
-                    factor_snapshot=factor_snapshot)
+                    factor_snapshot=factor_snapshot,
+                    # r16 self-healing quarantine gates ride in hyper
+                    # (replicated traced scalars) — present exactly
+                    # when the ladder is armed; the dict-structure
+                    # check is static, so the unarmed program is
+                    # byte-for-byte the historical one.
+                    gates=hyper.get('bucket_gate'))
                 updates, new_opt_state = tx.update(precond, opt_state,
                                                    params)
                 new_params = jax.tree.map(

@@ -1182,7 +1182,7 @@ class KFAC:
     @profiling.scope('kfac/precond')
     def precondition(self, state: dict, grads: dict, damping, lr,
                      layer_filter: Sequence[str] | None = None,
-                     with_stats: bool = False):
+                     with_stats: bool = False, gates: dict | None = None):
         """Precondition registered layers' grads; KL-clip scale on-device.
 
         Reference: compute_preconditioned_gradients + _compute_grad_scale +
@@ -1210,6 +1210,19 @@ class KFAC:
         preconditioned-grad norms and per-shape-bucket norms, all traced
         scalars (the metrics path; default False is the historical
         single-value return).
+
+        ``gates`` (r16 self-healing quarantine): an optional
+        ``{shape-bucket key -> traced 0/1 scalar}`` dict (keys from
+        ``observability.metrics.shape_key``, the same grouping the
+        bucketed paths batch over). A gated-off (0) bucket's layers
+        fall back to the RAW gradient direction — plain SGD — via
+        ``jnp.where`` (a ``select``: NaN/Inf in the unselected
+        preconditioned branch does not propagate), applied BEFORE the
+        KL-clip so the clip scale and all downstream stats see the
+        blended directions. Gate VALUES are traced scalars riding in
+        ``hyper`` (engine), so flipping one is a value change — zero
+        retraces. ``None`` (default) is the bit-identical historical
+        path.
         """
         names = list(self.specs) if layer_filter is None else list(
             layer_filter)
@@ -1235,6 +1248,20 @@ class KFAC:
                 grad_mats[name], inv, damping,
                 diag_a=(inv['A_inv'] if spec.kind == EMBEDDING else None),
                 compute_dtype=cdt)
+
+        if gates is not None:
+            # Quarantine blend (r16): a gated-off bucket serves the raw
+            # gradient. jnp.where is a select — the poisoned
+            # preconditioned branch's NaNs stay un-propagated.
+            for name in names:
+                g = gates.get(obs_metrics.shape_key(
+                    grad_mats[name].shape))
+                if g is None:
+                    continue
+                pm = precond_mats[name]
+                precond_mats[name] = jnp.where(
+                    jnp.asarray(g, jnp.float32) >= 0.5, pm,
+                    grad_mats[name].astype(pm.dtype))
 
         if self.kl_clip is not None:
             # Fused with the precondition pass: the grad matrices are
@@ -1311,7 +1338,8 @@ class KFAC:
              inv_update: bool | None = None,
              inv_chunk: int | None = None,
              factor_reduce: bool = False,
-             factor_snapshot: bool = False) -> tuple[dict, dict]:
+             factor_snapshot: bool = False,
+             gates: dict | None = None) -> tuple[dict, dict]:
         """One K-FAC update: returns (preconditioned_grads, new_state).
 
         The analogue of reference KFAC.step() (preconditioner.py:472-523).
@@ -1353,6 +1381,10 @@ class KFAC:
         monolithic ``inv_update=True`` firing snapshots-then-fires
         (eager semantics — the step-0 warmup). Both features are
         static-cadence only: dynamic (``None``) flags raise.
+
+        ``gates``: per-shape-bucket quarantine mask (r16 self-healing)
+        — see :meth:`precondition`. Traced scalar VALUES; ``None``
+        (default) keeps the historical program bit-identical.
         """
         damping = self.damping if damping is None else damping
         lr = self.lr if lr is None else lr
@@ -1468,12 +1500,13 @@ class KFAC:
                    'inv_chunk_phase': chunk_phase}
 
         if not self.collect_metrics:
-            precond = self.precondition(state_i, grads, damping, lr)
+            precond = self.precondition(state_i, grads, damping, lr,
+                                        gates=gates)
             new_state = {**state_i, 'step': step + 1}
             return precond, new_state
 
         precond, stats = self.precondition(state_i, grads, damping, lr,
-                                           with_stats=True)
+                                           with_stats=True, gates=gates)
         one = lambda: jnp.ones((), jnp.int32)
         zero = lambda: jnp.zeros((), jnp.int32)
         did_f = cadence_gate(factor_update, step, f_freq, one, zero)

@@ -20,9 +20,19 @@ sequence*:
     remaining batches bit-identically.
   - :mod:`faults` + :mod:`chaos` — fault injectors (simulated
     preemption at step *k*, NaN batches, hard crashes, crash during
-    checkpoint write) driven by the ``KFAC_CHAOS`` env var, and the
-    ``python -m ...resilience.chaos`` harness that runs a training
+    checkpoint write, live-factor corruption, checkpoint bit rot,
+    loss-spike divergence) driven by the ``KFAC_CHAOS`` env var, and
+    the ``python -m ...resilience.chaos`` harness that runs a training
     command under them with an optional relaunch loop.
+  - :mod:`integrity` — content checksums recorded in every bundle's
+    scalars at save and verified at restore (r16); the resume walk
+    quarantines bundles that fail (``ckpt_quarantine``) and lands on
+    the newest verifiable one.
+  - :mod:`selfheal` — the r16 fault-response escalation ladder
+    (skip-window -> damping escalation -> per-bucket quarantine ->
+    in-process last-good-checkpoint rollback), driven from
+    ``engine.train_epoch`` by the on-device metrics stream; see
+    README "Self-healing".
   - :mod:`cli` — the shared flag surface (``--checkpoint-steps``,
     ``--checkpoint-secs``, ``--preemption-grace``, ``--resume-step``)
     and the unified newest-of-step-or-epoch resume helper used by all
@@ -40,7 +50,8 @@ from __future__ import annotations
 
 import importlib
 
-_LAZY = ('preemption', 'policy', 'dataiter', 'faults', 'chaos', 'cli')
+_LAZY = ('preemption', 'policy', 'dataiter', 'faults', 'chaos', 'cli',
+         'integrity', 'selfheal')
 
 __all__ = list(_LAZY)
 

@@ -47,6 +47,9 @@ def main(argv=None) -> int:
     p.add_argument('spec',
                    help="fault spec 'kind@step[,kind@step...]'; kinds: "
                         'preempt, crash, nan-batch, crash-in-save, '
+                        'corrupt-factor (Inf into a live Kronecker '
+                        'factor), corrupt-ckpt (bit-flip a saved '
+                        'bundle), diverge (loss-spike injection), '
                         "resize@K->N (relaunch with an N-device world) "
                         "(use '-' for no faults: pure relaunch loop)")
     p.add_argument('--relaunch', type=int, default=0, metavar='N',

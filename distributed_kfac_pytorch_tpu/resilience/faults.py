@@ -20,6 +20,23 @@ tests set it directly). Spec grammar — comma-separated ``kind@step``::
                       checkpoint write; orbax's write-to-tmp-then-rename
                       atomicity must keep ``latest_epoch()`` from ever
                       surfacing the torn step
+    corrupt-factor@K  after step K completes, plant an Inf in one LIVE
+                      Kronecker factor (host-side state edit, bypassing
+                      the on-device EWMA guard) — the silent-state-
+                      corruption path the r16 self-healing ladder's
+                      per-bucket quarantine exists for
+    corrupt-ckpt@K    after the step-K checkpoint finalizes (forced
+                      blocking save), flip one byte in its largest
+                      on-disk file — the bit-rot path; the verified
+                      resume walk must quarantine the bundle
+                      (``ckpt_quarantine``) and land on an older
+                      verifiable one
+    diverge@K         after step K completes, scale every parameter by
+                      a large factor (host-side) — a loss-spike
+                      injection that exercises the ladder's damping
+                      escalation + decay-back rung without any
+                      non-finite value (so it runs under
+                      ``KFAC_SANITIZE=nan``)
     resize@K->N       topology change after step K completes: drain
                       like a preemption (forced blocking save, exit
                       RELAUNCH_EXIT_CODE), and the chaos harness
@@ -43,7 +60,17 @@ import os
 import numpy as np
 
 ENV_VAR = 'KFAC_CHAOS'
-_KINDS = ('preempt', 'crash', 'nan-batch', 'crash-in-save', 'resize')
+_KINDS = ('preempt', 'crash', 'nan-batch', 'crash-in-save',
+          'corrupt-factor', 'corrupt-ckpt', 'diverge', 'resize')
+#: One line of grammar per fault kind — error messages cite the WHOLE
+#: menu, not just the token that failed to parse, so a typo'd spec is
+#: fixable from the traceback alone (r16 satellite: the old messages
+#: only echoed the bad token plus a bare kind tuple).
+_GRAMMAR = ('preempt@K, crash@K, nan-batch@K, crash-in-save@K, '
+            'corrupt-factor@K, corrupt-ckpt@K, diverge@K, '
+            'resize@K->N')
+# How hard `diverge` kicks the parameters (see poison_params).
+DIVERGE_SCALE = 8.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,6 +80,9 @@ class FaultPlan:
     crash_at: int | None = None
     nan_batch_at: int | None = None
     crash_in_save_at: int | None = None
+    corrupt_factor_at: int | None = None
+    corrupt_ckpt_at: int | None = None
+    diverge_at: int | None = None
     resize_at: int | None = None
     resize_to: int | None = None  # new world size for resize_at
 
@@ -63,9 +93,12 @@ class FaultPlan:
 def parse_spec(spec: str | None) -> FaultPlan | None:
     """Parse a ``kind@step[,kind@step...]`` spec; None/'' -> None.
 
-    The ``resize`` kind takes ``resize@<step>-><new_world_size>``
-    (e.g. ``resize@2->4``: drain after step 2, relaunch with 4
-    devices).
+    Fails CLOSED at parse time: an unknown kind, a malformed step, or a
+    duplicated kind raises here — before any training step runs — so a
+    chaos run can never silently train fault-free because its spec
+    never matched at fire time. The ``resize`` kind takes
+    ``resize@<step>-><new_world_size>`` (e.g. ``resize@2->4``: drain
+    after step 2, relaunch with 4 devices).
     """
     if not spec:
         return None
@@ -82,15 +115,20 @@ def parse_spec(spec: str | None) -> FaultPlan | None:
                 raise ValueError(
                     f'bad {ENV_VAR} fault spec {part!r}: expected '
                     "'resize@<step>-><new_world_size>' (e.g. "
-                    "'resize@2->4')")
-            fields['resize_at'] = int(step_s)
+                    f"'resize@2->4'); valid fault kinds: {_GRAMMAR}")
+            _set_once(fields, 'resize_at', int(step_s), part, spec)
             fields['resize_to'] = int(to_s)
             continue
-        if not sep or kind not in _KINDS or not at.lstrip('-').isdigit():
+        if not sep or kind not in _KINDS:
             raise ValueError(
-                f'bad {ENV_VAR} fault spec {part!r}: expected '
-                f"'<kind>@<step>' with kind in {_KINDS}")
-        fields[kind.replace('-', '_') + '_at'] = int(at)
+                f'bad {ENV_VAR} fault spec {part!r}: unknown fault '
+                f'kind {kind!r} — valid fault kinds: {_GRAMMAR}')
+        if not at.lstrip('-').isdigit():
+            raise ValueError(
+                f'bad {ENV_VAR} fault spec {part!r}: {at!r} is not an '
+                f'integer step; valid fault kinds: {_GRAMMAR}')
+        _set_once(fields, kind.replace('-', '_') + '_at', int(at),
+                  part, spec)
     if 'resize_at' in fields and 'preempt_at' in fields:
         # Both drain via the SAME relaunch exit code, so a supervisor
         # (resilience.chaos) could not tell which one caused a given
@@ -102,6 +140,20 @@ def parse_spec(spec: str | None) -> FaultPlan | None:
             'code, so the supervisor cannot attribute the drain); '
             'inject them on separate launches instead')
     return FaultPlan(**fields) if fields else None
+
+
+def _set_once(fields: dict, key: str, value: int, part: str,
+              spec: str) -> None:
+    """A duplicated kind is a spec bug, not a schedule: the dataclass
+    holds ONE step per kind, so the old parser silently kept the last
+    occurrence — the dropped injection then never fired and the chaos
+    run 'passed' without testing anything. Fail closed instead."""
+    if key in fields:
+        raise ValueError(
+            f'bad {ENV_VAR} spec {spec!r}: fault kind in {part!r} '
+            'appears more than once (each kind fires at ONE step; '
+            'chain separate launches for repeated faults)')
+    fields[key] = value
 
 
 def plan_from_env() -> FaultPlan | None:
@@ -146,6 +198,80 @@ def poison_at(batches, plan: FaultPlan | None, *, first_step: int = 0):
         if first_step + i == plan.nan_batch_at:
             batch = poison_batch(batch)
         yield batch
+
+
+# ---------------------------------------------------------------------------
+# Live-state corruption (corrupt-factor / diverge — r16 ladder proofs)
+# ---------------------------------------------------------------------------
+
+def poison_factors(kfac_state: dict) -> dict:
+    """Plant an ``inf`` in one live Kronecker factor (host-side).
+
+    Deterministic target: the lexicographically-first registered layer's
+    first factor leaf, element 0. Edited OUTSIDE the jitted step — the
+    on-device EWMA guard never sees a candidate, so the poison lands
+    exactly like a silent in-memory corruption would. Works on both the
+    single-chip (``KFAC.init_state``) and SPMD
+    (``DistributedKFAC.init_state``) state layouts (``'factors'`` is a
+    per-layer dict in both).
+    """
+    import jax.numpy as jnp
+
+    factors = dict(kfac_state['factors'])
+    name = sorted(factors)[0]
+    entry = dict(factors[name])
+    key = sorted(entry)[0]
+    leaf = entry[key]
+    flat = jnp.ravel(leaf).at[0].set(jnp.inf)
+    entry[key] = flat.reshape(leaf.shape).astype(leaf.dtype)
+    factors[name] = entry
+    return {**kfac_state, 'factors': factors}
+
+
+def poison_params(params, scale: float = DIVERGE_SCALE):
+    """Scale every float parameter by ``scale`` (host-side): a pure
+    loss-spike injection — values stay finite (so the run survives
+    ``KFAC_SANITIZE=nan``), but the loss/grad-norm jump is the
+    divergence-window signature the self-healing damping-escalation
+    rung keys on."""
+    import jax
+    import jax.numpy as jnp
+
+    def bump(p):
+        if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating):
+            return (jnp.asarray(p) * scale).astype(p.dtype)
+        return p
+
+    return jax.tree.map(bump, params)
+
+
+def corrupt_bundle_file(directory: str, step: int) -> str:
+    """Flip one byte in the middle of the LARGEST file of a finalized
+    step-checkpoint directory (the array-payload file, with
+    overwhelming probability) — the bit-rot fault. The bundle stays
+    present and listed; only the r16 integrity verification (content
+    checksum recorded in the bundle's scalars) or a failing restore can
+    tell it is bad. Returns the corrupted path."""
+    root = os.path.join(directory, str(step))
+    if not os.path.isdir(root):
+        raise FileNotFoundError(
+            f'corrupt-ckpt fault: no finalized step dir {root}')
+    victim, size = None, -1
+    for base, _dirs, files in os.walk(root):
+        for f in files:
+            p = os.path.join(base, f)
+            s = os.path.getsize(p)
+            if s > size:
+                victim, size = p, s
+    if victim is None or size == 0:
+        raise FileNotFoundError(
+            f'corrupt-ckpt fault: no non-empty file under {root}')
+    with open(victim, 'r+b') as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return victim
 
 
 # ---------------------------------------------------------------------------

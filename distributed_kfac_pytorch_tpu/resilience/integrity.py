@@ -1,0 +1,184 @@
+"""Checkpoint-bundle content integrity: checksums at save, verified at
+restore (r16).
+
+A corrupted step checkpoint used to poison resume with no fallback: a
+bit-flipped array file either fails the orbax restore outright (best
+case) or deserializes into silently-wrong weights (worst case — the run
+"resumes" from garbage). This module closes that hole:
+
+  - :func:`tree_checksum` reduces a bundle pytree to one 63-bit content
+    digest (shape + dtype + raw bytes of every array leaf, value of
+    every scalar leaf, keyed by tree path — deterministic for a fixed
+    structure, and a fixed structure is exactly what orbax
+    ``StandardRestore`` guarantees).
+  - ``training.checkpoint.bundle_state`` stamps the digest into the
+    bundle's ``scalars`` under :data:`CHECKSUM_KEY` at assembly time,
+    so it rides inside the bundle with zero format machinery.
+  - :func:`verify_tree` recomputes the digest over a RESTORED tree and
+    compares: a flipped byte in any array payload produces different
+    restored bytes, hence a mismatch. The unified resume path
+    (``resilience.cli.resume``) and the in-process rollback
+    (``resilience.selfheal.rollback_restore``) quarantine a failing
+    bundle (``ckpt_quarantine`` event) and walk back to the newest
+    bundle that verifies, instead of crashing (or worse, not
+    crashing).
+  - :func:`finite_ok` additionally scans the restored K-FAC group for
+    non-finite values: a bundle saved AFTER an in-memory factor
+    corruption checksums perfectly (the digest vouches for integrity,
+    not health), so the rollback walk must also refuse to roll back
+    INTO poison.
+
+Scope and honesty: the digest is computed from fully-addressable
+host-fetched values. On a multi-process pod, non-rank-local shards are
+not addressable and the gather would serialize the pod through one
+host; bundles saved there record :data:`UNVERIFIED` (0) and restore
+with a warning — the same degraded-but-working behavior pre-r16
+bundles get (MIGRATION.md "Checkpoint integrity"). Single-process runs
+(every test tier, the chaos harness, single-host TPU boxes) get the
+full end-to-end guarantee.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import numpy as np
+
+#: Key of the content digest inside ``bundle['scalars']``. An int (not
+#: a string) so it round-trips orbax scalar handling like the other
+#: resume-point scalars.
+CHECKSUM_KEY = 'integrity_checksum'
+#: Sentinel digest meaning "recorded as unverifiable at save time"
+#: (multi-process save). Distinct from the field being ABSENT, which
+#: means a pre-r16 bundle.
+UNVERIFIED = 0
+
+
+def _leaf_update(h, path: str, leaf) -> None:
+    h.update(path.encode())
+    arr = np.asarray(jax.device_get(leaf))
+    if arr.ndim == 0:
+        # Scalars hash by VALUE, not representation: a python int saved
+        # through orbax can come back as a 0-d numpy scalar (and its
+        # default width differs across platforms) — repr of .item() is
+        # the stable cross-trip form. Non-finite floats repr fine.
+        h.update(repr(arr.item()).encode())
+        return
+    h.update(str(arr.shape).encode())
+    h.update(str(arr.dtype).encode())
+    h.update(np.ascontiguousarray(arr).tobytes())
+
+
+def tree_checksum(tree) -> int:
+    """63-bit content digest of a bundle pytree.
+
+    Walks every leaf in ``jax.tree_util`` flatten order with its path
+    string; the ``scalars``' :data:`CHECKSUM_KEY` leaf is excluded (the
+    digest cannot cover itself). Returns :data:`UNVERIFIED` when any
+    leaf is not fully addressable (multi-process shards) — recorded,
+    never raising, so pod saves keep working.
+    """
+    h = hashlib.sha256()
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    # Addressability pre-scan BEFORE any device fetch: on a pod, bailing
+    # out mid-walk would already have paid host transfers for the
+    # leaves in front of the first non-addressable one.
+    if any(getattr(leaf, 'is_fully_addressable', True) is False
+           for _path, leaf in leaves):
+        return UNVERIFIED
+    for path, leaf in leaves:
+        pstr = jax.tree_util.keystr(path)
+        if pstr.endswith(f"['{CHECKSUM_KEY}']"):
+            continue
+        _leaf_update(h, pstr, leaf)
+    digest = int.from_bytes(h.digest()[:8], 'big') & ((1 << 63) - 1)
+    # The real digest must never collide with the sentinel; remap the
+    # 2^-63 case rather than letting it read as "unverified".
+    return digest or 1
+
+
+def stamp(tree: dict, compute: bool = True) -> dict:
+    """Record the content digest into ``tree['scalars']`` (in place on
+    the scalars dict the caller just built; returns the tree).
+
+    ``compute=False`` records the :data:`UNVERIFIED` sentinel WITHOUT
+    the host fetch + hash — for restore TEMPLATES, which must carry
+    the field (orbax structures are exact) but whose digest nobody
+    ever reads (``resilience.cli.resume`` / ``handle_rollback`` build
+    one from live state on every launch; hashing the whole model for
+    it was pure startup cost).
+    """
+    scalars = tree.get('scalars')
+    if isinstance(scalars, dict):
+        scalars[CHECKSUM_KEY] = (tree_checksum(tree) if compute
+                                 else UNVERIFIED)
+    return tree
+
+
+def recorded_checksum(tree: dict):
+    """The digest recorded in a restored bundle: an int, or None for a
+    pre-r16 bundle (no field)."""
+    scalars = tree.get('scalars', {})
+    if CHECKSUM_KEY not in scalars:
+        return None
+    return int(np.asarray(scalars[CHECKSUM_KEY]).item())
+
+
+def verify_tree(tree: dict) -> tuple[bool | None, int | None, int]:
+    """Verify a restored bundle against its recorded digest.
+
+    Returns ``(ok, recorded, actual)``: ``ok`` is None when the bundle
+    carries no digest or recorded :data:`UNVERIFIED` (pre-r16 /
+    multi-process save — restore proceeds with a warning, not a
+    quarantine), else the comparison verdict.
+    """
+    recorded = recorded_checksum(tree)
+    if recorded is None or recorded == UNVERIFIED:
+        # Nothing to verify against — skip the (full host fetch +
+        # hash) recompute entirely; pre-r16 and template/multi-process
+        # bundles restore unverified either way.
+        return None, recorded, UNVERIFIED
+    actual = tree_checksum(tree)
+    if actual == UNVERIFIED:
+        return None, recorded, actual
+    return recorded == actual, recorded, actual
+
+
+def finite_ok(subtree) -> bool:
+    """True when every float leaf of ``subtree`` is finite.
+
+    The rollback walk applies this to the restored ``kfac`` group: a
+    checkpoint written after the state was already poisoned is
+    internally consistent (checksum passes) but rolling back into it
+    would re-seed the very fault being healed.
+    """
+    for leaf in jax.tree_util.tree_leaves(subtree):
+        arr = np.asarray(jax.device_get(leaf))
+        if arr.dtype.kind == 'f' and arr.size:
+            # ml_dtypes (bf16) support isfinite through float32.
+            if not np.isfinite(
+                    arr.astype(np.float32, copy=False)).all():
+                return False
+    return True
+
+
+def strip_checksum(like: dict) -> dict:
+    """A restore template for bundles that PREDATE the checksum field:
+    same tree minus ``scalars[CHECKSUM_KEY]`` (orbax StandardRestore
+    structures must match exactly, so the template must not demand a
+    leaf the bundle never saved)."""
+    if not isinstance(like, dict) or 'scalars' not in like:
+        return like
+    scalars = {k: v for k, v in like['scalars'].items()
+               if k != CHECKSUM_KEY}
+    return {**like, 'scalars': scalars}
+
+
+def describe_mismatch(recorded: int | None, actual: int) -> str:
+    if recorded is None:
+        return 'bundle predates content checksums (pre-r16)'
+    if recorded == UNVERIFIED:
+        return 'bundle recorded no digest (multi-process save)'
+    return (f'content digest mismatch: recorded {recorded:#x}, '
+            f'restored data hashes to {actual:#x}')

@@ -98,6 +98,12 @@ class StepCheckpointer:
         self.sink = sink
         self.plan = plan
         self.always_block = always_block
+        # State-corruption injections fire ONCE per process: an
+        # in-process self-heal rollback (r16) rewinds state.step below
+        # the fault step, and re-firing on the replay would make every
+        # rollback a guaranteed re-poisoning (the crash/drain faults
+        # exit the process, so only these three need the latch).
+        self._fired: set[str] = set()
 
     # -- the once-per-step hook ----------------------------------------
 
@@ -110,6 +116,27 @@ class StepCheckpointer:
         if self.plan is not None:
             if self.plan.crash_at == gstep:
                 faults_lib.hard_crash()
+            if self.plan.corrupt_factor_at == gstep and \
+                    state.kfac_state is not None and \
+                    self._once('corrupt-factor'):
+                # Silent in-memory corruption: an Inf lands in a live
+                # Kronecker factor OUTSIDE the jitted step, past the
+                # on-device EWMA guard — the r16 quarantine rung's
+                # proof fault.
+                state.kfac_state = faults_lib.poison_factors(
+                    state.kfac_state)
+            if self.plan.diverge_at == gstep and self._once('diverge'):
+                # Loss-spike injection (finite values): the damping-
+                # escalation rung's proof fault.
+                state.params = faults_lib.poison_params(state.params)
+            if self.plan.corrupt_ckpt_at == gstep and \
+                    self._once('corrupt-ckpt'):
+                # Bit-rot a FINALIZED bundle: force a blocking save so
+                # the step dir exists, then flip a byte in its largest
+                # file — the verified resume walk must quarantine it.
+                self.save(state, step_in_epoch, blocking=True)
+                faults_lib.corrupt_bundle_file(self.mgr.directory,
+                                               gstep)
             if self.plan.preempt_at == gstep and \
                     self.preemption is not None:
                 self.preemption.trigger('injected preemption')
@@ -137,6 +164,13 @@ class StepCheckpointer:
             raise Preempted(gstep, reason)
         if due:
             self.save(state, step_in_epoch)
+
+    def _once(self, key: str) -> bool:
+        """True exactly the first time ``key`` fires this process."""
+        if key in self._fired:
+            return False
+        self._fired.add(key)
+        return True
 
     @staticmethod
     def _agree(preempted: bool, due: bool) -> tuple[bool, bool]:

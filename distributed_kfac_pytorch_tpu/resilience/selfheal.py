@@ -1,0 +1,577 @@
+"""Self-healing training: the fault-response escalation ladder (r16).
+
+A production run cannot treat every numeric fault as fatal: before this
+module, a persistent non-finite window either silently skipped factor
+updates forever (the on-device guard protects the EWMA but nothing
+re-seeds it) or killed the run, and recovery always meant
+die-and-relaunch (r8). The :class:`SelfHealController` makes faults
+survivable *in-process* — detect, degrade, recover — with
+die-and-relaunch demoted to the last rung:
+
+  1. **Skip-window** (rung 1, pre-existing): the on-device
+     ``nonfinite_guard`` drops a non-finite candidate factor window and
+     counts it in ``metrics['nonfinite_skips']``. The ladder *reads*
+     this; it does not change it.
+  2. **Damping escalation** (rung 2): on repeated bad windows
+     (non-finite events or a loss-spike divergence) the controller
+     multiplies the step's damping by ``damping_factor`` — a pure
+     host-side scale on the traced ``hyper['damping']`` scalar, so the
+     cadence stays ZERO-retrace — and decays it back one notch per
+     clean window.
+  3. **Per-bucket quarantine** (rung 3): when bad windows persist and a
+     factor scan attributes them to specific layers, those layers'
+     precondition shape-buckets are gated to the raw-gradient (plain
+     SGD) direction via the on-device ``hyper['bucket_gate']`` mask
+     (``KFAC.precondition(gates=)``), their factor EWMAs are reset to
+     the init seeds and re-accumulate from clean statistics, and after
+     a parity probe (re-accumulated factors finite + at least one
+     inverse refresh) the bucket is re-admitted.
+  4. **In-process rollback** (rung 4): when the fault cannot be
+     attributed or quarantine does not clear it, :class:`Rollback`
+     propagates out of ``engine.train_epoch``; the CLI restores the
+     newest *verified* step checkpoint older than the fault onset
+     (:func:`rollback_restore` — checksum-verified AND finite, walking
+     past corrupt bundles with ``ckpt_quarantine`` events) and
+     continues training in the same process.
+  5. Only past ``max_rollbacks`` (or with no restorable bundle) does
+     the process die — the r8 relaunch loop is the final rung, not the
+     first response.
+
+Cost discipline: per step the controller does host arithmetic only; the
+one deliberate device sync is the window-boundary metric read (every
+``check_every`` steps, like the straggler probe's documented cost), and
+the factor finiteness scan runs only while a window is already bad. The
+ladder is OFF by default; with it off, ``train_epoch`` is byte-for-byte
+the pre-r16 engine (bit-identity pinned in tests/test_selfheal.py).
+Armed, every adjustment is a traced-scalar VALUE change — zero
+retraces, pinned by the same tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import numpy as np
+
+
+class Rollback(RuntimeError):
+    """Raised by the controller when the ladder escalates to rung 4.
+
+    Propagates out of ``engine.train_epoch`` (sinks are flushed first);
+    the CLI catches it, restores via :func:`rollback_restore` and
+    continues the training loop in-process.
+    """
+
+    def __init__(self, global_step: int, onset_step: int, reason: str):
+        super().__init__(
+            f'self-heal rollback requested at step {global_step} '
+            f'(fault onset ~step {onset_step}): {reason}')
+        self.global_step = int(global_step)
+        self.onset_step = int(onset_step)
+        self.reason = reason
+
+
+class SelfHealExhausted(RuntimeError):
+    """The ladder is out of rungs (rollback budget spent); the process
+    should die and let the r8 relaunch loop take over."""
+
+
+@dataclasses.dataclass
+class SelfHealConfig:
+    """Knobs of the escalation ladder (README "Self-healing").
+
+    ``check_every`` is the window length in optimizer steps — the one
+    host sync the armed ladder adds runs at this cadence (the CLIs
+    default it to the inverse-update frequency, so the ladder observes
+    once per K-FAC cadence window).
+    """
+    check_every: int = 10
+    # Rung 2: damping escalation.
+    escalate_after: int = 1        # consecutive bad windows to escalate
+    damping_factor: float = 10.0   # per-escalation multiplier
+    damping_max_mult: float = 1e4  # multiplier ceiling
+    diverge_ratio: float = 10.0    # boundary loss > ratio * EMA -> bad
+    loss_ema_alpha: float = 0.5    # boundary-loss reference tracking
+    # How fast a DIVERGED reference re-legitimizes: on a diverged
+    # window the loss reference grows by at most this factor (the
+    # normal EMA update is suspended — feeding the spiked loss into
+    # its own reference at full alpha would declare any plateau
+    # healthy within one window and make the rollback rung
+    # unreachable for pure-divergence faults). A divergence deeper
+    # than ~ratio * adapt^rollback_after therefore escalates to
+    # rollback instead of being absorbed; a moderate transient is
+    # re-accepted within a few windows (escalate -> decay back).
+    diverge_adapt: float = 1.2
+    # Rung 3: per-bucket quarantine.
+    quarantine: bool = True
+    quarantine_after: int = 2      # consecutive bad windows to gate
+    readmit_windows: int = 2       # min windows gated before the probe
+    # Rung 4: in-process rollback.
+    rollback_after: int = 5        # consecutive bad windows to roll back
+    max_rollbacks: int = 1
+
+    def __post_init__(self):
+        if self.check_every < 1:
+            raise ValueError(f'{self.check_every=} must be >= 1')
+        if self.damping_factor <= 1.0:
+            raise ValueError(f'{self.damping_factor=} must be > 1')
+        if self.diverge_adapt <= 1.0:
+            raise ValueError(f'{self.diverge_adapt=} must be > 1')
+        if not (self.escalate_after >= 1
+                and self.quarantine_after >= 1
+                and self.rollback_after >= 1):
+            raise ValueError('escalate_after/quarantine_after/'
+                             'rollback_after must be >= 1')
+        if self.rollback_after <= self.quarantine_after and \
+                self.quarantine:
+            raise ValueError(
+                f'{self.rollback_after=} must exceed '
+                f'{self.quarantine_after=} — quarantine needs at least '
+                'one window to act before the ladder skips past it')
+
+
+def bucket_layer_map(kfac, params) -> dict[str, list[str]]:
+    """Precondition shape-bucket key -> the registered layers in it.
+
+    Same ``eval_shape``-over-``grads_to_matrix`` derivation as
+    ``KFAC.metric_bucket_keys`` (one source of shape truth), extended
+    with the membership the quarantine reset needs.
+    """
+    from distributed_kfac_pytorch_tpu import layers as L
+    from distributed_kfac_pytorch_tpu.observability import (
+        metrics as obs_metrics,
+    )
+
+    def _get(tree, path):
+        for part in path:
+            tree = tree[part]
+        return tree
+
+    out: dict[str, list[str]] = {}
+    for name, spec in kfac.specs.items():
+        sh = jax.eval_shape(
+            lambda p, s=spec: L.grads_to_matrix(s, p),
+            _get(params, spec.path)).shape
+        out.setdefault(obs_metrics.shape_key(sh), []).append(name)
+    return out
+
+
+def _recommit(value, leaf):
+    """Place a freshly-built reset array on the original leaf's
+    committed sharding: the jitted step's executable expects global
+    mesh-placed inputs, and a host-local replacement would fail the
+    dispatch on a multi-process mesh (single-process it would merely
+    pay a silent re-commit)."""
+    sharding = getattr(leaf, 'sharding', None)
+    if isinstance(leaf, jax.Array) and sharding is not None:
+        return jax.device_put(value, sharding)
+    return value
+
+
+def _seed_like(leaf):
+    """The ``init_state`` factor seed for one factor leaf: identity for
+    square (stacked) matrices, ones for diagonal vectors — shape,
+    dtype and committed sharding preserved (see :func:`_recommit`)."""
+    import jax.numpy as jnp
+
+    shape = leaf.shape
+    if len(shape) >= 2 and shape[-1] == shape[-2]:
+        eye = jnp.eye(shape[-1], dtype=leaf.dtype)
+        seed = jnp.broadcast_to(eye, shape)
+    else:
+        seed = jnp.ones(shape, leaf.dtype)
+    return _recommit(seed, leaf)
+
+
+class SelfHealController:
+    """Host-side ladder state machine driven by the metrics stream.
+
+    Wire through ``engine.train_epoch(selfheal=...)``; construct via
+    ``resilience.cli.make_selfheal`` (the CLIs) or directly in tests.
+
+    ``bucket_layers``: :func:`bucket_layer_map` output; None disables
+    the quarantine rung (the ladder then goes skip -> damping ->
+    rollback). When present, :meth:`adjust_hyper` carries a
+    ``bucket_gate`` entry (one traced scalar per bucket, 1.0 = normal)
+    in EVERY step's hyper — constant structure, so arming the ladder
+    costs one compile per program variant and zero retraces after.
+    With ``config.quarantine=False`` but ``bucket_layers`` given, the
+    gate STRUCTURE still rides (all ones, never flipped) — the rung is
+    inert but the traced program is identical, so a step builder can
+    be shared across both controller shapes.
+    """
+
+    def __init__(self, config: SelfHealConfig | None = None, *,
+                 bucket_layers: dict[str, list[str]] | None = None,
+                 sink=None):
+        self.config = config or SelfHealConfig()
+        self.bucket_layers = bucket_layers
+        self.sink = sink
+        self.damping_mult = 1.0
+        self.gates: dict[str, float] = {
+            k: 1.0 for k in (bucket_layers or {})}
+        self.pending_events: list[dict] = []
+        self.rollbacks = 0
+        # Window bookkeeping.
+        self._consec_bad = 0
+        self._onset_step: int | None = None
+        self._last_skips = 0.0
+        self._loss_ema: float | None = None
+        self._last_inv_work = 0.0
+        # bucket -> {'since': windows gated, 'inv_work_at': firing
+        # count when gated} for the parity probe.
+        self._quarantined: dict[str, dict] = {}
+
+    # -- the per-step hooks (engine.train_epoch) -----------------------
+
+    def adjust_hyper(self, hyper: dict) -> dict:
+        """This step's effective hyperparameters: escalated damping
+        (value-only change on the traced scalar) plus the per-bucket
+        quarantine gates. Called every step; pure host dict work."""
+        out = dict(hyper)
+        if self.damping_mult != 1.0:
+            out['damping'] = hyper['damping'] * self.damping_mult
+        if self.bucket_layers is not None:
+            out['bucket_gate'] = dict(self.gates)
+        return out
+
+    def observe(self, state, metrics: dict) -> None:
+        """Consume one completed step (called with ``state.step`` still
+        at the step just executed). Host arithmetic except at window
+        boundaries; may reset quarantined layers' factor EWMAs in
+        ``state.kfac_state`` and may raise :class:`Rollback`."""
+        step = int(state.step)
+        if (step + 1) % self.config.check_every:
+            return
+        self._boundary(step, state, metrics)
+
+    def drain_events(self) -> list[dict]:
+        out, self.pending_events = self.pending_events, []
+        return out
+
+    # -- window-boundary logic -----------------------------------------
+
+    @staticmethod
+    def _read(metrics: dict, key: str) -> float:
+        v = metrics.get(key)
+        if v is None:
+            return float('nan')
+        try:
+            return float(np.asarray(jax.device_get(v)))
+        except (TypeError, ValueError):
+            return float('nan')
+
+    def _boundary(self, step: int, state, metrics: dict) -> None:
+        cfg = self.config
+        # The one deliberate sync: a handful of device scalars from the
+        # step just executed, every check_every steps.
+        loss = self._read(metrics, 'loss')
+        skips = self._read(metrics, 'kfac/nonfinite_skips')
+        grad_norm = self._read(metrics, 'kfac/grad_norm')
+        precond_norm = self._read(metrics, 'kfac/precond_norm')
+        # Total inverse-refresh work = monolithic firings + pipelined
+        # chunk firings; either key may be absent (a k=1 run records
+        # no chunk counter) — only both-missing means "no signal".
+        inv_u = self._read(metrics, 'kfac/inv_updates')
+        inv_c = self._read(metrics, 'kfac/inv_chunk_firings')
+        if math.isnan(inv_u) and math.isnan(inv_c):
+            inv_work = float('nan')
+        else:
+            inv_work = ((0.0 if math.isnan(inv_u) else inv_u)
+                        + (0.0 if math.isnan(inv_c) else inv_c))
+
+        nonfinite = (
+            (not math.isnan(skips) and skips > self._last_skips)
+            or not math.isfinite(loss)
+            or (not math.isnan(grad_norm)
+                and not math.isfinite(grad_norm))
+            or (not math.isnan(precond_norm)
+                and not math.isfinite(precond_norm)))
+        if not math.isnan(skips):
+            self._last_skips = skips
+        diverged = (not nonfinite and math.isfinite(loss)
+                    and self._loss_ema is not None
+                    and loss > cfg.diverge_ratio * self._loss_ema)
+        if diverged:
+            # Suspend the normal EMA: the spiked loss must not vouch
+            # for itself. The reference re-legitimizes by at most
+            # ×diverge_adapt per window, so a sustained plateau keeps
+            # flagging (and can reach the rollback rung) while a
+            # moderate transient is re-accepted within a few windows.
+            self._loss_ema *= cfg.diverge_adapt
+        elif math.isfinite(loss):
+            a = cfg.loss_ema_alpha
+            self._loss_ema = (loss if self._loss_ema is None
+                              else (1 - a) * self._loss_ema + a * loss)
+
+        if not math.isnan(inv_work):
+            self._last_inv_work = inv_work
+        if nonfinite or diverged:
+            self._bad_window(step, state,
+                             'nonfinite' if nonfinite else 'diverge',
+                             loss)
+        else:
+            self._clean_window(step)
+        self._probe_quarantined(step, state, inv_work)
+
+    def _bad_window(self, step: int, state, kind: str,
+                    loss: float) -> None:
+        cfg = self.config
+        self._consec_bad += 1
+        if self._onset_step is None:
+            # The fault began somewhere inside this window; the rollback
+            # walk must not restore a bundle saved after its start.
+            self._onset_step = max(0, step - cfg.check_every)
+        if self._consec_bad >= cfg.escalate_after and \
+                self.damping_mult < cfg.damping_max_mult:
+            self.damping_mult = min(
+                self.damping_mult * cfg.damping_factor,
+                cfg.damping_max_mult)
+            self._event('selfheal_escalate', global_step=step,
+                        kind=kind, damping_mult=self.damping_mult,
+                        bad_windows=self._consec_bad)
+        if self.config.quarantine and self.bucket_layers is not None \
+                and self._consec_bad >= cfg.quarantine_after:
+            self._quarantine_bad_buckets(step, state)
+        if self._consec_bad >= cfg.rollback_after:
+            self._request_rollback(step, kind, loss)
+
+    def _clean_window(self, step: int) -> None:
+        cfg = self.config
+        self._consec_bad = 0
+        if not self._quarantined:
+            self._onset_step = None
+        if self.damping_mult > 1.0:
+            self.damping_mult = max(
+                1.0, self.damping_mult / cfg.damping_factor)
+            self._event('selfheal_deescalate', global_step=step,
+                        damping_mult=self.damping_mult)
+
+    # -- rung 3: quarantine --------------------------------------------
+
+    def _scan_factors(self, kfac_state: dict) -> dict[str, bool]:
+        """layer -> factors-all-finite (host scan; only runs while a
+        window is already bad or a quarantined bucket is up for its
+        readmission probe)."""
+        from distributed_kfac_pytorch_tpu.resilience import (
+            integrity as integrity_lib,
+        )
+        factors = kfac_state.get('factors', {})
+        return {name: integrity_lib.finite_ok(entry)
+                for name, entry in factors.items()}
+
+    def _quarantine_bad_buckets(self, step: int, state) -> None:
+        finite = self._scan_factors(state.kfac_state)
+        for bucket, layers in self.bucket_layers.items():
+            if bucket in self._quarantined or \
+                    self.gates.get(bucket, 1.0) == 0.0:
+                continue
+            bad = [n for n in layers if not finite.get(n, True)]
+            if not bad:
+                continue
+            self.gates[bucket] = 0.0
+            self._quarantined[bucket] = {
+                'since': 0, 'inv_work_at': self._last_inv_work}
+            state.kfac_state = self._reset_layers(state.kfac_state,
+                                                 layers)
+            self._event('selfheal_quarantine', global_step=step,
+                        bucket=bucket, layers=','.join(sorted(layers)),
+                        nonfinite_layers=','.join(sorted(bad)))
+
+    def _reset_layers(self, kfac_state: dict, layers) -> dict:
+        """Reset the named layers' factor EWMAs (and any overlap-state
+        mirrors) to the init seeds: quarantined layers re-accumulate
+        statistics from scratch instead of EMA-ing poison forever."""
+        out = dict(kfac_state)
+        for group in ('factors', 'frozen_factors'):
+            if group not in out:
+                continue
+            entries = dict(out[group])
+            for name in layers:
+                if name in entries:
+                    entries[name] = jax.tree.map(_seed_like,
+                                                 entries[name])
+            out[group] = entries
+        if 'factor_accum' in out:
+            import jax.numpy as jnp
+            acc = dict(out['factor_accum'])
+            for name in layers:
+                if name in acc:
+                    acc[name] = jax.tree.map(
+                        lambda x: _recommit(jnp.zeros_like(x), x),
+                        acc[name])
+            out['factor_accum'] = acc
+        return out
+
+    def _probe_quarantined(self, step: int, state,
+                           inv_work: float) -> None:
+        """Rung-3 exit: the parity probe. A bucket re-admits once its
+        re-accumulated factors are finite AND at least one inverse
+        refresh (monolithic or chunk firing) consumed them — the
+        rebuilt preconditioner then serves clean directions."""
+        if not self._quarantined:
+            return
+        cfg = self.config
+        finite = None
+        for bucket in list(self._quarantined):
+            q = self._quarantined[bucket]
+            q['since'] += 1
+            if q['since'] < cfg.readmit_windows:
+                continue
+            refired = (not math.isnan(inv_work)
+                       and inv_work > q['inv_work_at'])
+            if not refired:
+                continue
+            if finite is None:
+                finite = self._scan_factors(state.kfac_state)
+            layers = self.bucket_layers[bucket]
+            if all(finite.get(n, True) for n in layers):
+                self.gates[bucket] = 1.0
+                windows = q['since']
+                del self._quarantined[bucket]
+                self._event('selfheal_readmit', global_step=step,
+                            bucket=bucket, windows=windows)
+        if not self._quarantined and self._consec_bad == 0:
+            self._onset_step = None
+
+    # -- rung 4: rollback ----------------------------------------------
+
+    def _request_rollback(self, step: int, kind: str,
+                          loss: float) -> None:
+        cfg = self.config
+        reason = (f'{self._consec_bad} consecutive bad windows '
+                  f'(last: {kind}, loss={loss:.4g}, '
+                  f'damping_mult={self.damping_mult:g})')
+        if self.rollbacks >= cfg.max_rollbacks:
+            raise SelfHealExhausted(
+                f'self-heal ladder exhausted at step {step}: {reason} '
+                f'after {self.rollbacks} rollback(s) — dying for the '
+                'relaunch loop (r8), the ladder\'s last rung')
+        self.rollbacks += 1
+        onset = self._onset_step if self._onset_step is not None else step
+        raise Rollback(step, onset, reason)
+
+    def after_rollback(self, restored_step: int) -> None:
+        """Re-arm the ladder on the restored (pre-fault) state: gates
+        lift, damping resets, window counters clear. The rollback
+        budget (``rollbacks``) is NOT reset — a recurring fault must
+        eventually fall through to relaunch, keeping the ladder
+        bounded."""
+        self._consec_bad = 0
+        self._onset_step = None
+        self._last_skips = 0.0
+        self._last_inv_work = 0.0
+        self._loss_ema = None
+        self.damping_mult = 1.0
+        self._quarantined.clear()
+        for k in self.gates:
+            self.gates[k] = 1.0
+
+    def _event(self, name: str, **data) -> None:
+        self.pending_events.append({'event': name, **data})
+
+
+# ---------------------------------------------------------------------------
+# Rollback restore (the CLI half of rung 4)
+# ---------------------------------------------------------------------------
+
+def rollback_restore(step_mgr, like: dict, *, from_step: int,
+                     onset_step: int | None = None, reason: str = '',
+                     sink=None):
+    """Restore the newest VERIFIED step bundle for an in-process
+    rollback; returns ``(label, tree)``.
+
+    Candidates are the step tree's bundles at or before ``onset_step``
+    (a bundle saved after the fault began would roll back INTO the
+    fault); each must pass the content-checksum verification AND a
+    finiteness scan of its K-FAC group (``integrity.finite_ok`` — a
+    poisoned state checksums perfectly). Failing bundles emit
+    ``ckpt_quarantine`` events and the walk continues. Raises
+    :class:`SelfHealExhausted` when nothing restorable remains — the
+    process then dies into the r8 relaunch loop.
+    """
+    from distributed_kfac_pytorch_tpu.resilience import (
+        cli as cli_lib,
+        integrity as integrity_lib,
+    )
+    labels = sorted(step_mgr.all_steps(), reverse=True)
+    if onset_step is not None:
+        labels = [l for l in labels if l <= onset_step]
+    quarantined: list[str] = []
+    for label in labels:
+        found = cli_lib._walk_restore(step_mgr, like, None, kind='step',
+                                      sink=sink, labels=[label],
+                                      quarantined=quarantined)
+        if found is None:
+            continue
+        label, tree, _relaid = found
+        if not integrity_lib.finite_ok(tree.get('kfac', {})):
+            # mgr= moves the bundle aside on disk: it checksums clean,
+            # so the r8 relaunch resume (checksum-only) would
+            # otherwise restore this poisoned bundle right back after
+            # the ladder exhausts.
+            cli_lib._quarantine(sink, 'step', label,
+                                'restored K-FAC state contains '
+                                'non-finite values (saved after the '
+                                'fault?)', quarantined, mgr=step_mgr)
+            continue
+        if sink is not None:
+            sink.event_record('selfheal_rollback',
+                              from_step=int(from_step),
+                              to_step=int(tree['scalars']['step']),
+                              label=int(label),
+                              reason=str(reason)[:300])
+        return label, tree
+    raise SelfHealExhausted(
+        f'rollback requested at step {from_step} but no verified '
+        f'step checkpoint at or before step {onset_step} exists '
+        f'({len(quarantined)} quarantined: {quarantined[:3]}...) — '
+        'dying for the relaunch loop (r8)')
+
+
+def handle_rollback(rb: Rollback, *, args, step_mgr, like: dict, state,
+                    dkfac, sink=None, controller=None, kfac_sched=None,
+                    checkpointer=None,
+                    verbose: bool = False) -> tuple[int, int]:
+    """The CLIs' shared rung-4 recovery: restore the newest verified
+    pre-fault bundle into the LIVE ``TrainState`` and return the
+    ``(start_epoch, start_offset)`` to continue the epoch loop from —
+    all without exiting the process.
+
+    The preconditioner state is rebuilt from the bundle through
+    ``DistributedKFAC.load_state_dict`` (inverses recomputed when
+    absent), discarding every poisoned live tensor; ``controller``
+    (when given) is re-armed via :meth:`SelfHealController
+    .after_rollback`.
+    """
+    label, tree = rollback_restore(
+        step_mgr, like, from_step=rb.global_step,
+        onset_step=rb.onset_step, reason=rb.reason, sink=sink)
+    state.params = tree['params']
+    state.opt_state = tree['opt_state']
+    if dkfac is not None:
+        state.kfac_state = dkfac.load_state_dict(tree['kfac'],
+                                                 state.params)
+    state.extra_vars = tree['extra_vars']
+    sc = tree['scalars']
+    state.epoch = int(sc['epoch'])
+    state.step = int(sc['step'])
+    if kfac_sched is not None:
+        kfac_sched.step(state.epoch)
+    if controller is not None:
+        controller.after_rollback(state.step)
+    if checkpointer is not None and checkpointer.policy is not None:
+        # Re-key the interval policy to the restored position: its
+        # last-save step is still the pre-rollback value, and
+        # "steps since last save" would stay negative for the whole
+        # replay — zero step checkpoints while replaying is exactly
+        # when a second fault would be unrecoverable.
+        checkpointer.policy.note_saved(state.step)
+    if verbose:
+        print(f'self-heal: rolled back in-process to verified step '
+              f'checkpoint {label} (global step {state.step}, epoch '
+              f'{state.epoch}, offset {int(sc["step_in_epoch"])}) — '
+              f'{rb.reason}')
+    return int(sc['epoch']), int(sc['step_in_epoch'])

@@ -58,9 +58,26 @@ class CheckpointManager:
         :meth:`wait_until_finished`. Pass ``blocking=True`` (or call
         ``wait_until_finished``) where durability must be certain before
         proceeding, e.g. right before process exit.
+
+        ``force=True`` additionally REPLACES an existing bundle at the
+        same label (orbax's own ``force`` only bypasses the
+        save-interval policy and still raises StepAlreadyExistsError):
+        an in-process self-heal rollback (r16) rewinds the step/epoch
+        counters, and the replay's saves land on labels whose
+        pre-rollback bundles are stale garbage from an abandoned
+        timeline — they must be overwritten, not fatal.
         """
-        self._mgr.save(epoch, args=ocp.args.StandardSave(tree),
-                       force=force)
+        try:
+            self._mgr.save(epoch, args=ocp.args.StandardSave(tree),
+                           force=force)
+        except Exception as e:
+            if not force or \
+                    type(e).__name__ != 'StepAlreadyExistsError':
+                raise
+            self._mgr.wait_until_finished()
+            self._mgr.delete(epoch)
+            self._mgr.save(epoch, args=ocp.args.StandardSave(tree),
+                           force=True)
         if blocking:
             self._mgr.wait_until_finished()
 
@@ -71,6 +88,45 @@ class CheckpointManager:
     def latest_epoch(self) -> int | None:
         self._mgr.wait_until_finished()  # join any pending async save
         return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        """Every finalized checkpoint label on disk, ascending. The
+        verified-resume walk (``resilience.cli.resume`` /
+        ``resilience.selfheal.rollback_restore``) iterates these
+        newest-first, quarantining corrupt/torn bundles until one
+        verifies (r16)."""
+        self._mgr.wait_until_finished()
+        return sorted(self._mgr.all_steps())
+
+    def quarantine(self, label: int) -> str | None:
+        """Move a corrupt bundle's directory aside
+        (``<label>.quarantined[.N]`` — kept for forensics, invisible
+        to orbax's integer-step scan) and resync the manager.
+
+        Without the move, a run that resumed PAST the corrupt bundle
+        re-reaches its step and orbax refuses the re-save
+        (StepAlreadyExistsError) — the quarantined garbage would brick
+        the very replay the verified walk just enabled. On shared
+        multihost storage the first mover wins; losers see the dir
+        gone and only resync. Returns the new path (None if another
+        rank already moved it)."""
+        self._mgr.wait_until_finished()
+        src = os.path.join(self.directory, str(label))
+        dst = f'{src}.quarantined'
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = f'{src}.quarantined.{n}'
+        moved = None
+        try:
+            os.replace(src, dst)
+            moved = dst
+        except FileNotFoundError:
+            pass  # raced with another rank (or already gone)
+        reload = getattr(self._mgr, 'reload', None)
+        if reload is not None:
+            reload()
+        return moved
 
     def restore(self, epoch: int | None = None,
                 like: dict | None = None) -> dict:
@@ -197,7 +253,8 @@ class CheckpointManager:
 
 def bundle_state(params, opt_state, kfac_state_dict, extra_vars,
                  schedulers: dict[str, Any] | None = None,
-                 topology=None, **scalars) -> dict:
+                 topology=None, integrity: bool | str = True,
+                 **scalars) -> dict:
     """Assemble the composite checkpoint tree.
 
     Mirrors the reference's checkpoint dict {model, optimizer,
@@ -215,6 +272,17 @@ def bundle_state(params, opt_state, kfac_state_dict, extra_vars,
     the bundle can be resumed on a DIFFERENT topology (the r11
     elastic format — bundles without it are same-topology-only; see
     MIGRATION.md).
+
+    ``integrity=True`` (default, the r16 format) additionally stamps a
+    content checksum of the assembled tree into
+    ``scalars['integrity_checksum']`` (``resilience.integrity``); the
+    unified resume path verifies it and walks back past bundles that
+    fail. ``integrity='template'`` carries the field with the
+    unverified sentinel and SKIPS the host fetch + hash — for
+    restore-template bundles (``resume(like=)``), whose digest nobody
+    reads. ``False`` omits the field entirely — the pre-r16 format,
+    only where unverified restores are acceptable (MIGRATION.md
+    "Checkpoint integrity").
     """
     scalars = dict(scalars)
     if topology is not None:
@@ -227,4 +295,17 @@ def bundle_state(params, opt_state, kfac_state_dict, extra_vars,
     if schedulers:
         tree['schedulers'] = {k: v.state_dict()
                               for k, v in schedulers.items()}
+    if integrity:
+        from distributed_kfac_pytorch_tpu.resilience import (
+            integrity as integrity_lib,
+        )
+        # The digest is computed SYNCHRONOUSLY at assembly, not
+        # deferred behind the async orbax write: the train step
+        # donates its state buffers (donate_argnums), so the arrays
+        # referenced here are invalidated by the very next dispatch —
+        # a deferred hash would read freed buffers. The cost is one
+        # host fetch + sha256 per SAVE (not per step); opt out with
+        # integrity=False / 'template' where that gates cadence
+        # (PERF.md r16).
+        integrity_lib.stamp(tree, compute=integrity != 'template')
     return tree

@@ -76,6 +76,20 @@ def cadence_flags(step: int, factor_update_freq, inv_update_freq,
     return flags
 
 
+def _drain_selfheal(selfheal, metrics_sink) -> None:
+    """Move the ladder's queued decision events into the metrics sink
+    (duck-typed sinks without ``event_record`` keep their queue, like
+    the compile-event drain)."""
+    if not selfheal.pending_events or metrics_sink is None:
+        return
+    emit = getattr(metrics_sink, 'event_record', None)
+    if emit is None:
+        return
+    for ev in selfheal.drain_events():
+        emit(ev['event'], **{k: v for k, v in ev.items()
+                             if k != 'event'})
+
+
 def fired_stage(flags: dict) -> str | None:
     """Most expensive stage a step's static flags fire (for step-time
     attribution in the metrics stream): 'inverse' > 'chunk<j>' >
@@ -124,7 +138,7 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
                 rank_sink=None, barrier_probe=None,
                 straggler_sample_every: int = 1,
                 memory_interval: int = 0,
-                cadence_policy=None) -> dict[str, float]:
+                cadence_policy=None, selfheal=None) -> dict[str, float]:
     """One training epoch; returns averaged metrics.
 
     ``hyper`` holds this epoch's dynamic hyperparameters ('lr', 'damping',
@@ -207,6 +221,20 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
     ``metrics_sink`` like the compile telemetry. Requires
     ``barrier_probe`` to act on skew (without one the policy is
     inert).
+
+    ``selfheal``: a ``resilience.selfheal.SelfHealController`` (or
+    None, the default — that path is byte-for-byte the pre-r16
+    engine). Per step the controller adjusts the traced
+    hyperparameters (escalated damping, per-bucket quarantine gates —
+    VALUE changes only, zero retraces) and observes the step's
+    metrics; at window boundaries (its ``check_every``) it reads a
+    handful of device scalars — the armed ladder's one deliberate
+    host sync, amortized like the sampled straggler probe — and may
+    reset quarantined layers' factor EWMAs in ``state.kfac_state`` or
+    raise ``resilience.selfheal.Rollback`` (sinks are flushed first;
+    the CLI catches it and restores in-process — README
+    "Self-healing"). Ladder decision events drain into
+    ``metrics_sink`` like the compile/backoff telemetry.
 
     ``KFAC_SANITIZE=transfer,nan,retrace`` (env var, r15): run the
     epoch under the runtime sanitizer gates — device->host transfer
@@ -340,12 +368,18 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
             # Applied BEFORE dispatch and before the fired-stage label
             # is derived, so attribution reflects what actually ran.
             flags = cadence_policy.adjust(state.step, flags, wait_ms)
+        # Self-healing ladder (r16): escalated damping / quarantine
+        # gates are traced-scalar VALUE changes on this step's hyper —
+        # the dict structure is fixed at arming time, so the variant
+        # cache never retraces. selfheal=None leaves hyper untouched.
+        hyper_step = (hyper if selfheal is None
+                      else selfheal.adjust_hyper(hyper))
         t_it = time.perf_counter()
         with sanitizer.step_guard(step_fn, flags):
             (state.params, state.opt_state, state.kfac_state,
              state.extra_vars, metrics) = step_fn(
                 state.params, state.opt_state, state.kfac_state,
-                state.extra_vars, batch, hyper, **flags)
+                state.extra_vars, batch, hyper_step, **flags)
         sanitizer.after_step(step_fn, state.step)
         dt = time.perf_counter() - t_it
         # A queued compile event right after the call means THIS step's
@@ -417,6 +451,24 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
                     data = {k: v for k, v in ev.items()
                             if k != 'event'}
                     emit_event(ev['event'], **data)
+        if selfheal is not None:
+            # Ladder observation (r16): host arithmetic except at its
+            # window boundaries. May reset quarantined factor EWMAs in
+            # state.kfac_state; may raise Rollback — the drain persists
+            # the ladder's own escalation events on both paths, and
+            # the except additionally flushes the sinks so the
+            # completed steps' records survive the unwind, exactly
+            # like a preemption.
+            try:
+                selfheal.observe(state, metrics)
+            except BaseException:
+                _drain_selfheal(selfheal, metrics_sink)
+                if metrics_sink is not None:
+                    metrics_sink.flush()
+                if rank_sink is not None:
+                    rank_sink.flush()
+                raise
+            _drain_selfheal(selfheal, metrics_sink)
         state.step += 1
         n_batches += 1
         for k, v in metrics.items():

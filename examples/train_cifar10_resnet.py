@@ -244,7 +244,10 @@ def main(argv=None):
         bf16_inverses=args.bf16_inverses,
         bf16_precond=args.bf16_precond,
         kfac_metrics=bool(args.kfac_metrics),
-        nonfinite_guard=obs.cli.wants_guard(args))
+        # --selfheal forces the guard on: the ladder's rung 1 IS the
+        # on-device skip-window (README "Self-healing").
+        nonfinite_guard=(obs.cli.wants_guard(args)
+                         or resil.cli.wants_selfheal_guard(args)))
     # Tuned-config overlay (fail-closed): the queued apply/fallback
     # events land in the metrics stream once the sink exists below.
     cfg, tune_events = autotune.cli.maybe_apply_tuned(args, cfg)
@@ -354,7 +357,7 @@ def main(argv=None):
         mesh, distribute_layer_factors=(
             dkfac.distribute_layer_factors if dkfac else None))
 
-    def bundle_fn(st, step_in_epoch):
+    def bundle_fn(st, step_in_epoch, integrity=True):
         # The like/saved tree must match exactly (orbax StandardRestore
         # is strict): scheduler states + the resume-point scalars
         # (MIGRATION.md "Checkpoint format").
@@ -364,11 +367,17 @@ def main(argv=None):
             st.extra_vars,
             schedulers={'kfac': kfac_sched} if kfac_sched else None,
             topology=topo,
+            integrity=integrity,
             step=st.step, epoch=st.epoch, step_in_epoch=step_in_epoch,
             data_seed=args.seed)
 
     start_epoch, start_offset = 0, 0
-    resumed = resil.cli.resume(args, mgr, step_mgr, bundle_fn(state, 0),
+    # integrity='template': the like= tree needs the checksum FIELD
+    # (orbax structures are exact) but hashing the whole live state
+    # for a digest nobody reads was pure startup cost.
+    resumed = resil.cli.resume(args, mgr, step_mgr,
+                               bundle_fn(state, 0,
+                                         integrity='template'),
                                sink=metrics_sink, verbose=is_main,
                                elastic=elastic_lib.ElasticResume(
                                    mesh=mesh, dkfac=dkfac,
@@ -388,6 +397,10 @@ def main(argv=None):
     step_ckpt = resil.cli.make_step_checkpointer(
         args, step_mgr, bundle_fn, preemption=preemption,
         sink=metrics_sink, start_step=state.step)
+    # r16 self-healing ladder (None when --selfheal is off — the
+    # engine then runs the byte-identical pre-r16 path).
+    selfheal_ctl = resil.cli.make_selfheal(
+        args, kfac=kfac, params=state.params, sink=metrics_sink)
 
     # rank-0 writer (reference engine.py:89-93); checkpoint saves stay
     # collective (orbax coordinates all hosts' shard writes).
@@ -396,7 +409,8 @@ def main(argv=None):
                 if args.precise_bn_batches > 0 else None)
     t_start = time.perf_counter()
     try:
-        for epoch in range(start_epoch, args.epochs):
+        epoch = start_epoch
+        while epoch < args.epochs:
             skip = start_offset if epoch == start_epoch else 0
             # A preemption notice that landed during eval/checkpointing
             # of the previous epoch drains here (forced save + exit);
@@ -413,16 +427,38 @@ def main(argv=None):
             raw = resil.faults.poison_at(raw, step_ckpt.plan,
                                          first_step=state.step)
             batches = launch.global_batches(mesh, raw)
-            with obs.cli.profile_epoch(args, info, epoch, start_epoch):
-                train_m = engine.train_epoch(
-                    step_fn, state, batches, hyper,
-                    log_writer=writer, verbose=is_main,
-                    metrics_sink=metrics_sink, checkpointer=step_ckpt,
-                    start_step_in_epoch=skip,
-                    rank_sink=rank_sink, barrier_probe=barrier_probe,
-                    straggler_sample_every=args.straggler_sample_every,
-                    memory_interval=args.memory_interval,
-                    cadence_policy=cadence_policy)
+            try:
+                with obs.cli.profile_epoch(args, info, epoch,
+                                           start_epoch):
+                    train_m = engine.train_epoch(
+                        step_fn, state, batches, hyper,
+                        log_writer=writer, verbose=is_main,
+                        metrics_sink=metrics_sink,
+                        checkpointer=step_ckpt,
+                        start_step_in_epoch=skip,
+                        rank_sink=rank_sink,
+                        barrier_probe=barrier_probe,
+                        straggler_sample_every=(
+                            args.straggler_sample_every),
+                        memory_interval=args.memory_interval,
+                        cadence_policy=cadence_policy,
+                        selfheal=selfheal_ctl)
+            except resil.selfheal.Rollback as rb:
+                # Rung 4: restore the newest VERIFIED pre-fault step
+                # checkpoint into the live state and keep training IN
+                # THIS PROCESS (die-and-relaunch is the rung after).
+                start_epoch, start_offset = resil.selfheal.\
+                    handle_rollback(
+                        rb, args=args, step_mgr=step_mgr,
+                        like=bundle_fn(state, 0,
+                                       integrity='template'),
+                        state=state,
+                        dkfac=dkfac, sink=metrics_sink,
+                        controller=selfheal_ctl,
+                        kfac_sched=kfac_sched, checkpointer=step_ckpt,
+                        verbose=is_main)
+                epoch = start_epoch
+                continue
             val_batches = launch.global_batches(
                 mesh, datasets.epoch_batches(
                     test_x, test_y, args.val_batch_size, shuffle=False,
@@ -450,7 +486,13 @@ def main(argv=None):
                 kfac_sched.step(epoch + 1)
             if (epoch + 1) % args.checkpoint_freq == 0 or \
                     epoch == args.epochs - 1:
-                mgr.save(epoch, bundle_fn(state, 0))
+                # force=: a cross-epoch self-heal rollback replays
+                # epochs whose bundles already exist on disk; the
+                # replayed save must overwrite, not crash (the step
+                # checkpointer already saves with force for the same
+                # reason).
+                mgr.save(epoch, bundle_fn(state, 0), force=True)
+            epoch += 1
     except resil.preemption.Preempted as p:
         # The step checkpoint is already durable (blocking save).
         step_ckpt.close()

@@ -455,8 +455,13 @@ class TestBundleStateRoundtrip:
         mgr.save(0, tree, blocking=True)
         restored = mgr.restore(0, like=tree)
         sc = restored['scalars']
-        assert {k: int(v) for k, v in sc.items()} == {
+        # r16: bundles additionally carry the content checksum scalar
+        # (resilience.integrity; verified by the resume walk).
+        from distributed_kfac_pytorch_tpu.resilience import integrity
+        assert {k: int(v) for k, v in sc.items()
+                if k != integrity.CHECKSUM_KEY} == {
             'step': 37, 'epoch': 3, 'step_in_epoch': 5, 'data_seed': 42}
+        assert integrity.verify_tree(restored)[0] is True
         np.testing.assert_array_equal(restored['params']['w'],
                                       np.arange(6.0))
         np.testing.assert_array_equal(restored['opt_state']['momentum'],
