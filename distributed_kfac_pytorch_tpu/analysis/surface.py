@@ -131,19 +131,36 @@ def _cli_flags(tree: ast.AST) -> set[str]:
     return flags
 
 
+#: Callable names whose first string argument is an event name. Both
+#: attribute calls (``sink.event_record('x')``, ``self._event('x')``)
+#: and bare-name calls (``emit_event(sink, 'x')`` — helper functions a
+#: module defines over its sink, the r17 supervisor/heartbeat shape)
+#: are scanned: an event literal laundered through a local helper must
+#: still be registered in ``sink.EVENT_KINDS``.
+_EVENT_EMITTERS = ('event_record', '_event', 'emit_event')
+
+
 def _event_literals(tree: ast.AST) -> list[tuple[str, int]]:
-    """Literal event names this module emits: first-arg strings of
-    ``*.event_record('x', ...)`` / ``*._event('x', ...)`` calls plus
+    """Literal event names this module emits: the first string argument
+    of any :data:`_EVENT_EMITTERS` call (attribute or bare name) plus
     ``{'event': 'x', ...}`` dict literals."""
     out = []
     for node in ast.walk(tree):
         if isinstance(node, ast.Call):
-            attr = (node.func.attr
-                    if isinstance(node.func, ast.Attribute) else None)
-            if (attr in ('event_record', '_event') and node.args
-                    and isinstance(node.args[0], ast.Constant)
-                    and isinstance(node.args[0].value, str)):
-                out.append((node.args[0].value, node.lineno))
+            if isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                name = node.func.id
+            else:
+                name = None
+            if name in _EVENT_EMITTERS:
+                # First STRING positional arg: helpers often take the
+                # sink first (``emit_event(sink, 'x', ...)``).
+                for arg in node.args[:2]:
+                    if isinstance(arg, ast.Constant) \
+                            and isinstance(arg.value, str):
+                        out.append((arg.value, node.lineno))
+                        break
         elif isinstance(node, ast.Dict):
             for k, v in zip(node.keys, node.values):
                 if (isinstance(k, ast.Constant) and k.value == 'event'

@@ -22,6 +22,10 @@ vector —
   - ``selfheal_rollbacks`` (r16): in-process rollback count from the
     self-healing ladder — a recovery, but a run that needed one
     regressed against a baseline that needed none.
+  - ``supervisor_restarts`` (r17): failure-driven relaunch count from
+    the supervisor (``supervisor_restart`` events, merged from the
+    ``<jsonl>.supervisor`` sidecar) — same recovered-but-regressed
+    logic one process level up.
 
 — and compares it against a committed baseline with per-metric
 relative tolerances, exiting non-zero on any breach so CI can block
@@ -43,6 +47,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import sys
 import time
 
@@ -69,8 +74,15 @@ DEFAULT_TOLERANCES = {
     # the gate surfaces it (absolute count, like retraces). Baselines
     # predating the metric skip it ("not in baseline").
     'selfheal_rollbacks': 0.0,
+    # r17 supervision: same logic one level up — a supervised run that
+    # needed process-level restarts (crash/hang relaunches) recovered,
+    # but it regressed against a baseline that ran clean. Counted from
+    # supervisor_restart events (the <jsonl>.supervisor sidecar is
+    # merged by main(); inline events count too).
+    'supervisor_restarts': 0.0,
 }
-_ABSOLUTE_METRICS = ('retraces', 'selfheal_rollbacks')
+_ABSOLUTE_METRICS = ('retraces', 'selfheal_rollbacks',
+                     'supervisor_restarts')
 
 
 def gate_metrics(records: list[dict]) -> dict:
@@ -86,6 +98,9 @@ def gate_metrics(records: list[dict]) -> dict:
     rollbacks = sum(1 for r in records
                     if r.get('kind') == 'event'
                     and r.get('event') == 'selfheal_rollback')
+    sup_restarts = sum(1 for r in records
+                       if r.get('kind') == 'event'
+                       and r.get('event') == 'supervisor_restart')
     out = {
         'n_steps': dist['n_steps'] if dist else 0,
         'step_p50_ms': dist['p50_ms'] if dist else None,
@@ -95,6 +110,7 @@ def gate_metrics(records: list[dict]) -> dict:
         'peak_hbm_bytes': peak,
         'retraces': retraces,
         'selfheal_rollbacks': rollbacks,
+        'supervisor_restarts': sup_restarts,
     }
     for k, v in out.items():
         if isinstance(v, float) and not math.isfinite(v):
@@ -249,6 +265,19 @@ def main(argv=None) -> int:
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f'error: {e}', file=sys.stderr)
         return 2
+    # Supervisor sidecar (r17): supervisor_restart events live in
+    # <jsonl>.supervisor (the supervisor outlives child incarnations);
+    # merge them so the supervisor_restarts metric sees the whole
+    # session. Unreadable sidecar = skip, like the report.
+    sidecar = args.jsonl + obs_report.SUPERVISOR_SIDECAR_SUFFIX
+    if os.path.exists(sidecar):
+        try:
+            sup_records, sup_torn = read_jsonl_tolerant(sidecar)
+            records = records + sup_records
+            torn += sup_torn
+        except (OSError, ValueError) as e:
+            print(f'note: supervisor sidecar {sidecar} unreadable: '
+                  f'{e}', file=sys.stderr)
     current = gate_metrics(records)
     # The tolerances actually applied (defaults + --tol overrides):
     # part of the verdict artifact, so a recorded gate run is

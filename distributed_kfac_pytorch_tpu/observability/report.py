@@ -28,7 +28,12 @@ Prints, from the recorded stream alone (no live process needed):
   - self-healing (r16): the escalation ladder's decision trail —
     damping escalations/decays, bucket quarantines/readmits,
     in-process rollbacks, and checkpoint quarantines from the
-    verified resume walk (``resilience.selfheal``).
+    verified resume walk (``resilience.selfheal``);
+  - supervision (r17): the failure supervisor's decision trail —
+    restarts, hang detections, survivor-mesh failovers/grow-backs,
+    crash loops — merged from the ``run.jsonl.supervisor`` sidecar
+    the supervisor writes next to the stream
+    (``resilience.supervisor``).
 
 A torn/truncated FINAL line (a host crashed mid-append) is skipped and
 counted in the header instead of refusing the stream; torn lines
@@ -44,12 +49,14 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import sys
 
 from distributed_kfac_pytorch_tpu.observability.health import (
     HealthMonitor,
 )
 from distributed_kfac_pytorch_tpu.observability.sink import (
+    SUPERVISOR_SIDECAR_SUFFIX,
     peak_hbm_bytes,
     percentile as _percentile,
     read_jsonl_tolerant,
@@ -109,6 +116,17 @@ def step_time_distribution(records: list[dict]) -> dict | None:
     return dist
 
 
+# The supervisor's event vocabulary (registered in sink.EVENT_KINDS).
+# Supervisor events normally live in a SIDECAR stream next to the
+# run's JSONL (``<path>.supervisor`` — the supervisor outlives child
+# incarnations, so its decisions cannot ride the rank-0 stream that
+# each relaunch rotates away); ``main`` merges the sidecar, and
+# ``summarize`` also picks up any supervision events recorded inline.
+_SUPERVISION_KINDS = ('supervisor_restart', 'supervisor_failover',
+                      'supervisor_growback', 'hang_detected',
+                      'crash_loop')
+
+
 def _series(records, key):
     out = []
     for r in records:
@@ -117,8 +135,15 @@ def _series(records, key):
     return out
 
 
-def summarize(records: list[dict]) -> dict:
-    """Structured summary of a record stream (the report's data model)."""
+def summarize(records: list[dict],
+              supervisor_records: list[dict] | None = None) -> dict:
+    """Structured summary of a record stream (the report's data model).
+
+    ``supervisor_records``: the supervisor's sidecar stream
+    (``<path>.supervisor``), merged into the supervision section only —
+    its events describe the whole supervised session, while the main
+    stream may hold just the newest incarnation.
+    """
     steps = [r for r in records if r.get('kind') == 'step']
     epochs = [r for r in records if r.get('kind') == 'epoch']
     meta = next((r['meta'] for r in records if r.get('kind') == 'meta'),
@@ -215,6 +240,33 @@ def summarize(records: list[dict]) -> dict:
             'ckpt_quarantines': count('ckpt_quarantine'),
         }
 
+    # Failure supervision (r17): the supervisor's decision trail —
+    # restarts, hang detections, failover/grow-back resizes, crash
+    # loops. Usually from the sidecar stream (the supervisor outlives
+    # every child incarnation); inline events count too. Same
+    # newest-window cap discipline as the other event sections.
+    sup_source = list(events)  # inline events (filtered above) ...
+    for r in (supervisor_records or []):
+        if r.get('kind') == 'event':
+            sup_source.append(r)  # ... plus the sidecar's
+    supervision_events = [{'event': r['event'],
+                           **dict(r.get('data', {}))}
+                          for r in sup_source
+                          if r['event'] in _SUPERVISION_KINDS]
+    supervision = None
+    if supervision_events:
+        count = lambda kind: sum(1 for e in supervision_events
+                                 if e['event'] == kind)
+        supervision = {
+            'n_events': len(supervision_events),
+            'events': supervision_events[-50:],
+            'restarts': count('supervisor_restart'),
+            'failovers': count('supervisor_failover'),
+            'growbacks': count('supervisor_growback'),
+            'hangs': count('hang_detected'),
+            'crash_loops': count('crash_loop'),
+        }
+
     autotune_events = [{'event': r['event'], **dict(r.get('data', {}))}
                        for r in events
                        if r['event'].startswith('autotune')]
@@ -238,6 +290,7 @@ def summarize(records: list[dict]) -> dict:
     return {
         'autotune': autotune,
         'selfheal': selfheal,
+        'supervision': supervision,
         'memory': memory,
         'compiles': compiles,
         'retraces': retraces,
@@ -435,6 +488,15 @@ def print_report(s: dict, out=None, torn: int = 0,
               f"{_fmt(float('nan') if mean_skew is None else mean_skew, ' ms')}"
               f"  max "
               f"{_fmt(float('nan') if max_skew is None else max_skew, ' ms')}")
+    if s.get('supervision'):
+        sup = s['supervision']
+        w()
+        w(f"-- supervision ({sup['n_events']} supervisor event(s)) --")
+        w(f"restarts: {sup['restarts']}   hangs detected: "
+          f"{sup['hangs']}   failovers: {sup['failovers']} / "
+          f"grow-backs: {sup['growbacks']}   crash loops: "
+          f"{sup['crash_loops']}")
+        _print_event_detail(w, sup['events'], sup['n_events'])
     if s.get('selfheal'):
         sh = s['selfheal']
         w()
@@ -459,6 +521,7 @@ def print_report(s: dict, out=None, torn: int = 0,
     resil_counts = {k: v for k, v in s['event_counts'].items()
                     if k not in ('compile', 'retrace',
                                  'ckpt_quarantine')
+                    and k not in _SUPERVISION_KINDS
                     and not k.startswith('autotune')
                     and not k.startswith('selfheal')}
     if resil_counts:
@@ -520,6 +583,7 @@ def summary_json(s: dict, *, torn: int = 0,
         'retraces': s['retraces'],
         'autotune': s['autotune'],
         'selfheal': s['selfheal'],
+        'supervision': s['supervision'],
         'event_counts': s['event_counts'],
         'kfac': {
             'factor_updates': s['factor_updates'],
@@ -561,6 +625,19 @@ def main(argv=None) -> int:
         print(f'error: {e}', file=sys.stderr)
         return 1
     torn += shard_torn
+    # Supervisor sidecar (r17): the supervision decision trail lives
+    # next to the stream, written by a different process — torn-
+    # tolerant like the shards, and an unreadable sidecar degrades the
+    # supervision section rather than the report.
+    supervisor_records = None
+    sidecar = args.jsonl + SUPERVISOR_SIDECAR_SUFFIX
+    if os.path.exists(sidecar):
+        try:
+            supervisor_records, sup_torn = read_jsonl_tolerant(sidecar)
+            torn += sup_torn
+        except (OSError, ValueError) as e:
+            print(f'note: supervisor sidecar {sidecar} unreadable: {e}',
+                  file=sys.stderr)
     stragglers = straggler_mod.straggler_summary(shards)
     if shard_errors:
         # Unreadable shards degrade the straggler section, never the
@@ -570,7 +647,7 @@ def main(argv=None) -> int:
                           'n_common_steps': 0, 'slowest_counts': {},
                           'mean_skew_ms': None, 'max_skew_ms': None}
         stragglers['unreadable'] = shard_errors
-    s = summarize(records)
+    s = summarize(records, supervisor_records=supervisor_records)
     if args.json:
         print(json.dumps(summary_json(s, torn=torn,
                                       stragglers=stragglers),

@@ -100,10 +100,27 @@ EVENT_KINDS = (
     'selfheal_rollback',    # in-process last-good restore (rung 4)
     'ckpt_quarantine',      # corrupt/torn bundle skipped by the
                             # verified resume/rollback walk (r16)
+    # r17 failure supervision (resilience.supervisor; README
+    # "Supervision & failover" — written to the <metrics>.supervisor
+    # sidecar stream the report's supervision section and the gate's
+    # supervisor_restarts metric consume):
+    'supervisor_restart',   # failure-driven or post-drain relaunch
+    'supervisor_failover',  # shrink to the survivor mesh (dead rank /
+                            # lost capacity / persistent straggler)
+    'supervisor_growback',  # capacity returned — grow back to target
+    'hang_detected',        # heartbeat leases expired; child killed
+    'crash_loop',           # same step failed K consecutive launches;
+                            # diagnostic bundle written, distinct exit
 )
 # Dead incarnations kept per metrics path (<path>.prev.1 newest ..
 # .prev.N oldest); older ones are pruned on relaunch.
 PREV_INCARNATIONS_KEPT = 5
+# Where the failure supervisor's event stream lives relative to the
+# run's metrics path (r17): ``<path>.supervisor``. ONE constant for
+# the writer (resilience.supervisor) and both readers (report, gate) —
+# the sidecar is found by convention, so a suffix drift would silently
+# orphan the supervision trail.
+SUPERVISOR_SIDECAR_SUFFIX = '.supervisor'
 
 
 def to_float(x) -> float:

@@ -33,6 +33,16 @@ sequence*:
     in-process last-good-checkpoint rollback), driven from
     ``engine.train_epoch`` by the on-device metrics stream; see
     README "Self-healing".
+  - :mod:`heartbeat` + :mod:`supervisor` — the r17 failure
+    supervision layer: per-rank liveness leases (atomic JSON files
+    written from the train loop) and the
+    ``python -m ...resilience.supervisor`` process that launches the
+    training command, classifies failures (crash / hang / dead
+    worker / lost capacity / persistent straggler / crash loop) from
+    exit codes, lease expiry and the r10 rank shards, and recovers —
+    relaunch with backoff under a budget, survivor-mesh failover and
+    grow-back via the r11 elastic resume; see README "Supervision &
+    failover".
   - :mod:`cli` — the shared flag surface (``--checkpoint-steps``,
     ``--checkpoint-secs``, ``--preemption-grace``, ``--resume-step``)
     and the unified newest-of-step-or-epoch resume helper used by all
@@ -51,7 +61,7 @@ from __future__ import annotations
 import importlib
 
 _LAZY = ('preemption', 'policy', 'dataiter', 'faults', 'chaos', 'cli',
-         'integrity', 'selfheal')
+         'integrity', 'selfheal', 'heartbeat', 'supervisor')
 
 __all__ = list(_LAZY)
 

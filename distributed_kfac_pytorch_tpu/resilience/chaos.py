@@ -11,7 +11,12 @@ CLEARED for relaunches (faults are one-shot; pass ``--keep-faults`` to
 re-inject every launch). This is the one-command form of the
 kill-and-resume smoke (scripts/resilience_smoke.sh) and doubles as the
 documented relaunch-loop shape for real supervisors
-(scripts/tpu_pod_setup.md §5).
+(scripts/tpu_pod_setup.md §5). It only handles the COOPERATIVE failure
+(a graceful drain that exits the relaunch code); crashes, hangs and
+dead workers need the full supervisor —
+``python -m distributed_kfac_pytorch_tpu.resilience.supervisor`` —
+which adds heartbeat-lease liveness, kill-and-relaunch, survivor-mesh
+failover and crash-loop escalation (README "Supervision & failover").
 
 A ``resize@K->N`` fault makes the relaunch a TOPOLOGY change: the
 relaunched command runs with an N-device world
@@ -50,7 +55,10 @@ def main(argv=None) -> int:
                         'corrupt-factor (Inf into a live Kronecker '
                         'factor), corrupt-ckpt (bit-flip a saved '
                         'bundle), diverge (loss-spike injection), '
-                        "resize@K->N (relaunch with an N-device world) "
+                        "resize@K->N (relaunch with an N-device world), "
+                        'hang (wedge without exit — needs the real '
+                        'supervisor to detect), slowrank (persistent '
+                        'per-step delay) '
                         "(use '-' for no faults: pure relaunch loop)")
     p.add_argument('--relaunch', type=int, default=0, metavar='N',
                    help='relaunch the command up to N times while it '
@@ -87,7 +95,7 @@ def main(argv=None) -> int:
             break
         note = ''
         if plan is not None and plan.resize_to is not None:
-            env['XLA_FLAGS'] = _with_device_count(
+            env['XLA_FLAGS'] = faults.xla_flags_with_device_count(
                 env.get('XLA_FLAGS', ''), plan.resize_to)
             note = f' with {plan.resize_to} devices'
         print(f'chaos: launch {launches} exited {rc} (preempted) — '
@@ -96,16 +104,6 @@ def main(argv=None) -> int:
         if not args.keep_faults:
             env.pop(faults.ENV_VAR, None)
     return rc
-
-
-def _with_device_count(xla_flags: str, n: int) -> str:
-    """``XLA_FLAGS`` with the host-platform device count forced to
-    ``n`` (any prior count flag replaced) — the relaunched child's new
-    world size on the CPU backend."""
-    kept = [f for f in xla_flags.split()
-            if not f.startswith('--xla_force_host_platform_device_count')]
-    kept.append(f'--xla_force_host_platform_device_count={n}')
-    return ' '.join(kept)
 
 
 if __name__ == '__main__':

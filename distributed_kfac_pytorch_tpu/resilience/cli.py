@@ -70,6 +70,24 @@ def add_resilience_args(p) -> None:
                    help='resume from this exact global-step checkpoint '
                         'in <checkpoint-dir>/steps (default: the '
                         'newest of step/epoch checkpoints)')
+    # r17 heartbeat leases (README "Supervision & failover"). Off by
+    # default; the supervisor arms them via KFAC_HEARTBEAT_DIR so the
+    # command line needs no rewriting.
+    p.add_argument('--heartbeat-dir', default=None, metavar='DIR',
+                   help='publish a per-rank liveness lease (atomic '
+                        'JSON file rank<r>.lease with global step, '
+                        'wall time, incarnation) into DIR from the '
+                        'train loop — the failure supervisor\'s hang/'
+                        'dead-worker signal (default: the '
+                        'KFAC_HEARTBEAT_DIR env var, unset = no '
+                        'heartbeats; pure host-side file I/O, '
+                        'bit-identical off AND on)')
+    p.add_argument('--heartbeat-every', type=int, default=1,
+                   metavar='N',
+                   help='publish the lease every N optimizer steps '
+                        '(keyed to the global step, so a resumed run '
+                        'keeps the cadence); budget --hang-timeout '
+                        'above N steps + the eval/checkpoint gaps')
     # r16 self-healing ladder (README "Self-healing"). Off by default:
     # with the ladder unarmed the engine is byte-for-byte the pre-r16
     # program (per-step-loss bit-identity pinned).
@@ -115,6 +133,29 @@ def add_resilience_args(p) -> None:
                    help='in-process rollback budget; past it the '
                         'ladder is exhausted and the process dies '
                         'into the r8 relaunch loop (the last rung)')
+
+
+def make_heartbeat(args, info):
+    """The per-rank :class:`resilience.heartbeat.HeartbeatEmitter` for
+    a CLI run, or None when heartbeats are off.
+
+    ``--heartbeat-dir`` wins; the ``KFAC_HEARTBEAT_DIR`` env var is
+    the supervisor's hands-off wiring (it exports the var so the
+    supervised command line runs unmodified — the same pattern as
+    ``KFAC_CHAOS``/``KFAC_PREEMPT_FILE``). EVERY rank emits its own
+    lease (the inverse of the rank-0-gated metrics sink): liveness is
+    per-host by nature.
+    """
+    directory = (getattr(args, 'heartbeat_dir', None)
+                 or os.environ.get('KFAC_HEARTBEAT_DIR'))
+    if not directory:
+        return None
+    from distributed_kfac_pytorch_tpu.resilience import (
+        heartbeat as heartbeat_lib,
+    )
+    return heartbeat_lib.HeartbeatEmitter(
+        directory, info['process_index'],
+        every=max(1, int(getattr(args, 'heartbeat_every', 1) or 1)))
 
 
 def install_preemption(args) -> preemption_lib.PreemptionHandler:
@@ -370,6 +411,24 @@ def _walk_restore(mgr, like, args, *, kind: str, sink=None, elastic=None,
     if labels is None:
         labels = ([explicit] if explicit is not None
                   else sorted(mgr.all_steps(), reverse=True))
+    if explicit is not None:
+        # An operator naming a QUARANTINED label deserves the real
+        # story — which directory the bundle was moved to and why the
+        # verified walk moved it — not the generic not-found that a
+        # never-saved step gets (r17 satellite; the quarantine reason
+        # is recorded by CheckpointManager.quarantine).
+        qinfo = getattr(mgr, 'quarantine_info', lambda _l: None)(
+            explicit)
+        if qinfo is not None:
+            qpath, qreason = qinfo
+            raise SystemExit(
+                f'cannot resume from {kind} checkpoint {explicit}: '
+                f'that bundle was QUARANTINED by a previous verified '
+                f'resume walk — moved to {qpath} because {qreason}. '
+                'Quarantined bundles failed restore or integrity '
+                'verification and are kept only for forensics; pick a '
+                'different --resume-step or drop the flag to resume '
+                'from the newest verifiable checkpoint.')
     for label in labels:
         what = f'{kind} checkpoint {label}'
         use_like = _template_for(mgr, label, like)
@@ -449,7 +508,7 @@ def _quarantine(sink, kind: str, label, reason: str,
                   'next older bundle', RuntimeWarning)
     if mgr is not None:
         try:
-            mgr.quarantine(int(label))
+            mgr.quarantine(int(label), reason=str(reason))
         except Exception as e:  # best effort: never break the walk
             warnings.warn(f'resume: could not move quarantined '
                           f'{kind} checkpoint {label} aside: {e}',

@@ -45,6 +45,18 @@ tests set it directly). Spec grammar — comma-separated ``kind@step``::
                       resumes through the elastic reshard path
                       (``resilience.cli.resume(elastic=...)``) instead
                       of cold restarting
+    hang@K            after step K completes, stop making progress AND
+                      stop heartbeating WITHOUT exiting (block forever
+                      in the step hook) — the wedged-collective /
+                      deadlocked-host failure the supervisor's lease
+                      expiry (``--hang-timeout``) exists to catch; the
+                      process only dies when something kills it
+    slowrank@K        from step K onward, sleep SLOWRANK_DELAY_S per
+                      step — the persistent-straggler fault: this rank
+                      keeps beating and progressing, but the r10
+                      barrier-probe skew (rank shards) shows every
+                      other rank waiting on it, which is the signal
+                      the supervisor's straggler classifier reads
 
 Faults are one-shot by design: a relaunch (fresh process) re-reads the
 env, so the chaos harness clears ``KFAC_CHAOS`` for relaunches unless
@@ -61,16 +73,21 @@ import numpy as np
 
 ENV_VAR = 'KFAC_CHAOS'
 _KINDS = ('preempt', 'crash', 'nan-batch', 'crash-in-save',
-          'corrupt-factor', 'corrupt-ckpt', 'diverge', 'resize')
+          'corrupt-factor', 'corrupt-ckpt', 'diverge', 'resize',
+          'hang', 'slowrank')
 #: One line of grammar per fault kind — error messages cite the WHOLE
 #: menu, not just the token that failed to parse, so a typo'd spec is
 #: fixable from the traceback alone (r16 satellite: the old messages
 #: only echoed the bad token plus a bare kind tuple).
 _GRAMMAR = ('preempt@K, crash@K, nan-batch@K, crash-in-save@K, '
             'corrupt-factor@K, corrupt-ckpt@K, diverge@K, '
-            'resize@K->N')
+            'resize@K->N, hang@K, slowrank@K')
 # How hard `diverge` kicks the parameters (see poison_params).
 DIVERGE_SCALE = 8.0
+# Per-step delay the `slowrank` fault injects (see slow_step). Chosen
+# well above CPU-test step times so the injected skew dominates host
+# jitter, but small enough that a smoke run still finishes.
+SLOWRANK_DELAY_S = 0.25
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,6 +102,8 @@ class FaultPlan:
     diverge_at: int | None = None
     resize_at: int | None = None
     resize_to: int | None = None  # new world size for resize_at
+    hang_at: int | None = None
+    slowrank_at: int | None = None
 
     def any(self) -> bool:
         return any(v is not None for v in dataclasses.astuple(self))
@@ -165,6 +184,48 @@ def hard_crash(code: int = 137) -> None:
     """Die NOW: no save, no atexit, no orbax finalize — the moral
     equivalent of SIGKILL (137 = 128+9), from inside the process."""
     os._exit(code)
+
+
+def hang() -> None:
+    """Wedge NOW: stop progressing and stop heartbeating without
+    exiting — the deadlocked-collective failure mode. Blocks in an
+    interruptible sleep loop forever; a first SIGTERM only sets the
+    (never again polled) preemption flag, exactly like a real hang
+    past the drain poll point, so the supervisor's escalation to
+    SIGKILL is what actually ends the process."""
+    import sys
+    import time as _time
+
+    print('chaos: hang fault — blocking without exit (no further '
+          'heartbeats); kill me', file=sys.stderr, flush=True)
+    while True:
+        _time.sleep(60)
+
+
+def slow_step(plan: 'FaultPlan | None', global_step: int) -> None:
+    """Inject the persistent-straggler delay: once ``global_step``
+    reaches ``plan.slowrank_at``, every step on THIS process sleeps
+    :data:`SLOWRANK_DELAY_S` (sustained skew, not a one-off spike —
+    the supervisor's classifier requires persistence)."""
+    if plan is not None and plan.slowrank_at is not None \
+            and global_step >= plan.slowrank_at:
+        import time as _time
+
+        _time.sleep(SLOWRANK_DELAY_S)
+
+
+def xla_flags_with_device_count(xla_flags: str, n: int) -> str:
+    """``XLA_FLAGS`` with the host-platform device count forced to
+    ``n`` (any prior count flag replaced) — the CPU-backend world-size
+    knob both the chaos harness (``resize@K->N`` relaunches) and the
+    supervisor (survivor-mesh failover / grow-back) use to model
+    re-provisioning on a test box. On real TPU fleets the resource
+    manager owns the device count; this helper only models its
+    relaunch step."""
+    kept = [f for f in xla_flags.split()
+            if not f.startswith('--xla_force_host_platform_device_count')]
+    kept.append(f'--xla_force_host_platform_device_count={int(n)}')
+    return ' '.join(kept)
 
 
 # ---------------------------------------------------------------------------

@@ -114,8 +114,19 @@ class StepCheckpointer:
         durable before it propagates."""
         gstep = int(state.step)
         if self.plan is not None:
+            # Persistent-straggler delay first: it models a SLOW host,
+            # so it must tax every step (the other injectors fire at
+            # one step).
+            faults_lib.slow_step(self.plan, gstep)
             if self.plan.crash_at == gstep:
                 faults_lib.hard_crash()
+            if self.plan.hang_at == gstep:
+                # Wedge without exit: the heartbeat for this step was
+                # already published by the engine (beat runs before
+                # this hook), so the supervisor sees a FRESH lease at
+                # the hang step that then stops advancing — the exact
+                # lease-expiry signature --hang-timeout detects.
+                faults_lib.hang()
             if self.plan.corrupt_factor_at == gstep and \
                     state.kfac_state is not None and \
                     self._once('corrupt-factor'):

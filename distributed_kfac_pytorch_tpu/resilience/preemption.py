@@ -39,10 +39,41 @@ import signal
 import time
 from typing import Callable
 
-# Distinct "preempted with checkpoint saved — relaunch me" exit code
-# (EX_TEMPFAIL from sysexits.h: temporary failure, retry). Supervisors
-# loop `while rc == 75`; anything else is success or a real failure.
-RELAUNCH_EXIT_CODE = 75
+def _relaunch_exit_code() -> int:
+    """The "preempted with checkpoint saved — relaunch me" exit code.
+
+    Default 75 = EX_TEMPFAIL from sysexits.h (temporary failure,
+    retry) — deliberate, but it COLLIDES with anything else on the box
+    that speaks sysexits (sendmail-family tooling most famously; see
+    MIGRATION.md "Relaunch exit code"). ``KFAC_RELAUNCH_EXIT``
+    overrides it; both the training CLIs (which exit with it) and the
+    relaunch-loop side (``resilience.chaos``, ``resilience.supervisor``
+    — which compare against it) read THIS constant, so setting the env
+    var for the whole process tree keeps the two sides agreeing.
+    """
+    raw = os.environ.get('KFAC_RELAUNCH_EXIT')
+    if raw is None:
+        return 75
+    try:
+        code = int(raw)
+    except ValueError:
+        raise ValueError(
+            f'KFAC_RELAUNCH_EXIT={raw!r} is not an integer exit code'
+        ) from None
+    if not 1 <= code <= 255:
+        # 0 means success to every supervisor; >255 wraps mod 256 on
+        # POSIX and would silently alias another code.
+        raise ValueError(
+            f'KFAC_RELAUNCH_EXIT={code} must be in 1..255 (0 is '
+            'success; values past 255 wrap on POSIX exit)')
+    return code
+
+
+# Supervisors loop `while rc == RELAUNCH_EXIT_CODE`; anything else is
+# success or a real failure. Env-configurable (KFAC_RELAUNCH_EXIT),
+# read once at import — children re-read it at their own import, so an
+# env var set on the supervisor propagates consistently.
+RELAUNCH_EXIT_CODE = _relaunch_exit_code()
 
 
 class Preempted(Exception):

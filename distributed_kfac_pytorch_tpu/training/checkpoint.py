@@ -21,6 +21,11 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+#: Filename recording WHY a bundle was moved to ``<label>.quarantined``
+#: (written by :meth:`CheckpointManager.quarantine`, read back by
+#: ``quarantine_info`` for the --resume-step refusal message).
+QUARANTINE_REASON_FILE = 'QUARANTINE_REASON'
+
 
 class CheckpointManager:
     """Epoch-indexed checkpoints with auto-resume.
@@ -98,7 +103,8 @@ class CheckpointManager:
         self._mgr.wait_until_finished()
         return sorted(self._mgr.all_steps())
 
-    def quarantine(self, label: int) -> str | None:
+    def quarantine(self, label: int,
+                   reason: str | None = None) -> str | None:
         """Move a corrupt bundle's directory aside
         (``<label>.quarantined[.N]`` — kept for forensics, invisible
         to orbax's integer-step scan) and resync the manager.
@@ -109,7 +115,13 @@ class CheckpointManager:
         the very replay the verified walk just enabled. On shared
         multihost storage the first mover wins; losers see the dir
         gone and only resync. Returns the new path (None if another
-        rank already moved it)."""
+        rank already moved it).
+
+        ``reason`` is recorded as ``QUARANTINE_REASON`` inside the
+        moved directory (best effort) so a later explicit
+        ``--resume-step`` at this label can tell the operator WHY the
+        bundle was moved, not just that it is gone (r17;
+        :meth:`quarantine_info`)."""
         self._mgr.wait_until_finished()
         src = os.path.join(self.directory, str(label))
         dst = f'{src}.quarantined'
@@ -123,10 +135,53 @@ class CheckpointManager:
             moved = dst
         except FileNotFoundError:
             pass  # raced with another rank (or already gone)
+        if moved is not None and reason:
+            try:
+                with open(os.path.join(moved,
+                                       QUARANTINE_REASON_FILE),
+                          'w') as f:
+                    f.write(str(reason) + '\n')
+            except OSError:
+                pass  # forensics metadata must never fail the walk
         reload = getattr(self._mgr, 'reload', None)
         if reload is not None:
             reload()
         return moved
+
+    def quarantined_paths(self, label: int) -> list[str]:
+        """Quarantined copies of ``label`` on disk, oldest first
+        (``<label>.quarantined``, ``.quarantined.1``, ...)."""
+        src = os.path.join(self.directory, str(label))
+        out = []
+        dst = f'{src}.quarantined'
+        n = 0
+        while os.path.exists(dst):
+            out.append(dst)
+            n += 1
+            dst = f'{src}.quarantined.{n}'
+        return out
+
+    def quarantine_info(self, label: int) -> tuple[str, str] | None:
+        """``(path, reason)`` of the NEWEST quarantined copy of
+        ``label`` — but only when no live bundle exists at that label
+        (a live bundle supersedes its quarantined history: the replay
+        re-saved it). None otherwise. The resume walk uses this to
+        refuse an explicit ``--resume-step`` at a quarantined label
+        with the real story instead of a bare not-found."""
+        if os.path.exists(os.path.join(self.directory, str(label))):
+            return None
+        paths = self.quarantined_paths(label)
+        if not paths:
+            return None
+        newest = paths[-1]
+        reason = 'no recorded reason (pre-r17 quarantine)'
+        try:
+            with open(os.path.join(newest,
+                                   QUARANTINE_REASON_FILE)) as f:
+                reason = f.read().strip() or reason
+        except OSError:
+            pass
+        return newest, reason
 
     def restore(self, epoch: int | None = None,
                 like: dict | None = None) -> dict:

@@ -138,7 +138,8 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
                 rank_sink=None, barrier_probe=None,
                 straggler_sample_every: int = 1,
                 memory_interval: int = 0,
-                cadence_policy=None, selfheal=None) -> dict[str, float]:
+                cadence_policy=None, selfheal=None,
+                heartbeat=None) -> dict[str, float]:
     """One training epoch; returns averaged metrics.
 
     ``hyper`` holds this epoch's dynamic hyperparameters ('lr', 'damping',
@@ -235,6 +236,18 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
     the CLI catches it and restores in-process — README
     "Self-healing"). Ladder decision events drain into
     ``metrics_sink`` like the compile/backoff telemetry.
+
+    ``heartbeat``: a ``resilience.heartbeat.HeartbeatEmitter`` (or
+    None, the default — that path is byte-for-byte the pre-r17
+    engine). Once per completed step the emitter publishes this
+    rank's liveness lease (atomic write-then-rename; stride inside
+    the emitter) BEFORE the checkpointer hook runs, so a step that
+    wedges in that hook still left a fresh lease at its step — the
+    exact stale-lease signature the failure supervisor's
+    ``--hang-timeout`` detects (``resilience.supervisor``). Pure
+    host-side file I/O: no device interaction, no program change —
+    heartbeats off is bit-identical and on adds zero retraces
+    (pinned by tests/test_supervisor.py).
 
     ``KFAC_SANITIZE=transfer,nan,retrace`` (env var, r15): run the
     epoch under the runtime sanitizer gates — device->host transfer
@@ -473,6 +486,12 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
         n_batches += 1
         for k, v in metrics.items():
             meters.setdefault(k, Metric(k)).update(v)
+        if heartbeat is not None:
+            # Liveness lease (r17): published before the checkpointer
+            # hook so a hang inside it (the chaos hang fault, a wedged
+            # collective save) leaves a fresh lease AT the hang step —
+            # the supervisor then sees the lease stop advancing.
+            heartbeat.beat(state.step)
         if checkpointer is not None:
             # May raise Preempted (after a blocking save). Flush the
             # sink first so the completed steps' records are durable

@@ -313,6 +313,10 @@ def main(argv=None):
     autotune.emit_events(metrics_sink, tune_events)
     rank_sink = obs.cli.make_rank_shard_sink(
         args, info, meta={'cli': 'train_language_model'})
+    # r17 liveness lease (per rank; armed by --heartbeat-dir or the
+    # supervisor's KFAC_HEARTBEAT_DIR — None otherwise, and the engine
+    # path is byte-identical without it).
+    heartbeat = resil.cli.make_heartbeat(args, info)
     if args.grad_clip:
         tx = optax.chain(optax.clip_by_global_norm(args.grad_clip), tx)
 
@@ -513,7 +517,8 @@ def main(argv=None):
                             args.straggler_sample_every),
                         memory_interval=args.memory_interval,
                         cadence_policy=cadence_policy,
-                        selfheal=selfheal_ctl)
+                        selfheal=selfheal_ctl,
+                        heartbeat=heartbeat)
             except resil.selfheal.Rollback as rb:
                 # Rung 4: restore the newest VERIFIED pre-fault step
                 # checkpoint into the live state and keep training IN
@@ -562,6 +567,8 @@ def main(argv=None):
             metrics_sink.close()
         if rank_sink is not None:
             rank_sink.close()
+        if heartbeat is not None:
+            heartbeat.close()
         if is_main:
             print(f'preempted ({p.reason}) at global step '
                   f'{p.global_step}; checkpoint saved — exiting '
@@ -573,6 +580,8 @@ def main(argv=None):
         metrics_sink.close()
     if rank_sink is not None:
         rank_sink.close()
+    if heartbeat is not None:
+        heartbeat.close()
     if writer is not None:
         writer.flush()
     if is_main:

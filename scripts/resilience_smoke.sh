@@ -116,4 +116,33 @@ EOF
 # smoke).
 python -m distributed_kfac_pytorch_tpu.observability.report \
     "$out/resize.jsonl"
+
+echo "== supervisor pure-relaunch leg (r17): the same preempt-and- =="
+echo "== resume loop, driven by the real failure supervisor        =="
+# The chaos harness leg above hand-rolls the relaunch; this is the
+# production form — the supervisor classifies the drain exit and
+# relaunches with the checkpoint fresh (no backoff, no budget). Full
+# failure-class coverage (crash/hang/failover/crash-loop) lives in
+# scripts/supervisor_smoke.sh.
+env "${common_env[@]}" KFAC_CHAOS='preempt@1' \
+python -m distributed_kfac_pytorch_tpu.resilience.supervisor \
+    --workdir "$out/sup" --metrics "$out/sup.jsonl" \
+    --hang-timeout 90 --startup-grace 600 --backoff 0 -- \
+    python examples/train_cifar10_resnet.py "${common_args[@]}" \
+    --checkpoint-dir "$out/ckpt-sup" --kfac-metrics "$out/sup.jsonl"
+
+python - "$out" <<'EOF'
+import sys
+from distributed_kfac_pytorch_tpu.observability import sink
+
+out = sys.argv[1]
+sup = [r for r in sink.read_jsonl(f'{out}/sup.jsonl.supervisor')
+       if r['kind'] == 'event']
+assert [r['event'] for r in sup] == ['supervisor_restart'], sup
+assert sup[0]['data']['reason'] == 'drain', sup
+steps = [r['step'] for r in sink.read_jsonl(f'{out}/sup.jsonl')
+         if r['kind'] == 'step']
+assert steps and steps[0] > 0, steps  # resumed, not cold-started
+print('supervisor leg: drain classified, relaunch resumed mid-epoch')
+EOF
 echo "resilience smoke OK"

@@ -4,6 +4,8 @@ EVENT_KINDS."""
 EVENT_KINDS = (
     'compile',
     'retrace',
+    'supervisor_restart',
+    'hang_detected',
 )
 
 

@@ -1,0 +1,16 @@
+"""Seeded surface drift, supervisor flavor (r17): event literals that
+bypass the registry must fail lint whether they go through an
+attribute call, a local emitter helper, or a bare record dict."""
+
+
+def emit_event(sink, name, **data):
+    sink.event_record(name, **data)
+
+
+def supervise(sink):
+    sink.event_record('supervisor_restart', reason='crash')  # registered
+    emit_event(sink, 'hang_detected', newest_age_s=31.0)     # registered
+    emit_event(sink, 'supervisor_failover', to_devices=2)    # drift:
+    #             not in this tree's EVENT_KINDS — the helper must not
+    #             launder the literal past the check
+    return {'event': 'heartbeat_stale'}                      # drift

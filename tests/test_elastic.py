@@ -198,12 +198,14 @@ class TestResizeFault:
         assert '--xla_foo=1' in flags
 
     def test_with_device_count_helper(self):
-        from distributed_kfac_pytorch_tpu.resilience.chaos import (
-            _with_device_count,
+        # Promoted to faults in r17 (the supervisor's failover path
+        # shares it with the chaos resize relaunch).
+        from distributed_kfac_pytorch_tpu.resilience.faults import (
+            xla_flags_with_device_count,
         )
-        assert _with_device_count('', 4).split() == [
+        assert xla_flags_with_device_count('', 4).split() == [
             '--xla_force_host_platform_device_count=4']
-        out = _with_device_count(
+        out = xla_flags_with_device_count(
             '--a --xla_force_host_platform_device_count=8 --b', 2)
         assert out.split() == [
             '--a', '--b', '--xla_force_host_platform_device_count=2']

@@ -129,6 +129,15 @@ class TestRuleMatrix:
         assert '--inv-pipeline-chunks' in msgs    # missing CLI flag
         assert "'unregistered_event'" in msgs     # event registry drift
         assert "'another_rogue_event'" in msgs
+        # r17 supervisor flavor: an event literal laundered through a
+        # LOCAL emitter helper (emit_event(sink, 'x')) or a bare record
+        # dict must still hit the registry check...
+        assert "'supervisor_failover'" in msgs
+        assert "'heartbeat_stale'" in msgs
+        # ...while registered supervisor names pass, through both the
+        # attribute call and the helper.
+        assert "'supervisor_restart'" not in msgs
+        assert "'hang_detected'" not in msgs
         assert all(f.family == 'surface' for f in findings)
 
     def test_surface_negative_real_tree(self):

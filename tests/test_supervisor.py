@@ -1,0 +1,806 @@
+"""Tests for the r17 failure-supervision layer.
+
+Covers the ISSUE acceptance surface: heartbeat lease
+parse/expiry/clock-skew tolerance and the emitter's stride contract;
+the supervisor unit matrix against tiny jax-free child processes
+(crash relaunch + backoff schedule, budget-exhaustion exit code,
+crash-loop detection with counter reset on progress + the diagnostic
+bundle, hang detection via lease expiry with kill-and-relaunch,
+cooperative drains, capacity-driven survivor-mesh failover and
+grow-back, lease-based dead-rank failover); the persistent-straggler
+classifier over synthetic rank shards; the configurable relaunch exit
+code (``KFAC_RELAUNCH_EXIT``); the quarantined ``--resume-step``
+refusal message; the report/gate supervision surfaces; and the
+heartbeats-off bit-identity + zero-retrace engine pins. The
+multi-launch sequence through the real LM CLI rides in the slow tier.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributed_kfac_pytorch_tpu.observability import (
+    gate as obs_gate,
+    report as obs_report,
+    sink as obs_sink,
+)
+from distributed_kfac_pytorch_tpu.resilience import (
+    faults,
+    heartbeat as hb,
+    supervisor as sup_lib,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat leases
+# ---------------------------------------------------------------------------
+
+class TestLeases:
+    def test_roundtrip(self, tmp_path):
+        path = str(tmp_path / 'rank0.lease')
+        rec = hb.write_lease(path, rank=0, step=17, incarnation=3,
+                             clock=lambda: 123.5)
+        got = hb.read_lease(path)
+        assert got == rec
+        assert got['step'] == 17 and got['incarnation'] == 3
+        assert got['wall_time'] == 123.5
+        assert got['pid'] == os.getpid()
+        # No tmp litter: publication is rename-complete.
+        assert os.listdir(tmp_path) == ['rank0.lease']
+
+    def test_missing_is_none_corrupt_raises(self, tmp_path):
+        assert hb.read_lease(str(tmp_path / 'nope.lease')) is None
+        bad = tmp_path / 'rank1.lease'
+        bad.write_text('{"torn": ')
+        with pytest.raises(ValueError, match='undecodable'):
+            hb.read_lease(str(bad))
+        notlease = tmp_path / 'rank2.lease'
+        notlease.write_text('[1, 2]')
+        with pytest.raises(ValueError, match='not a lease'):
+            hb.read_lease(str(notlease))
+
+    def test_age_and_clock_skew(self):
+        lease = {'wall_time': 100.0}
+        assert hb.lease_age(lease, now=130.0) == 30.0
+        # Clock-skew tolerance: a future-stamped lease (writer clock
+        # ahead of the reader's) is FRESH, never negative.
+        assert hb.lease_age(lease, now=95.0) == 0.0
+
+    def test_scan_tolerates_bad_files(self, tmp_path):
+        hb.write_lease(str(tmp_path / 'rank0.lease'), rank=0, step=1)
+        hb.write_lease(str(tmp_path / 'rank2.lease'), rank=2, step=5)
+        (tmp_path / 'rank1.lease').write_text('garbage')
+        (tmp_path / 'unrelated.txt').write_text('x')
+        leases, errors = hb.scan_leases(str(tmp_path))
+        assert sorted(leases) == [0, 2]
+        assert leases[2]['step'] == 5
+        assert list(errors) == ['rank1.lease']
+        # Missing directory: empty scan, no raise.
+        assert hb.scan_leases(str(tmp_path / 'gone')) == ({}, {})
+
+    def test_clear(self, tmp_path):
+        hb.write_lease(str(tmp_path / 'rank0.lease'), rank=0, step=1)
+        hb.write_lease(str(tmp_path / 'rank1.lease'), rank=1, step=1)
+        hb.clear_leases(str(tmp_path))
+        assert hb.scan_leases(str(tmp_path)) == ({}, {})
+
+
+class TestEmitter:
+    def test_stride_keys_on_global_step(self, tmp_path):
+        em = hb.HeartbeatEmitter(str(tmp_path), 0, every=3,
+                                 incarnation=2)
+        writes = []
+        for step in range(1, 8):
+            em.beat(step)
+            writes.append(hb.read_lease(em.path)['step'])
+        # First beat always publishes (resume visibility), then only
+        # step % 3 == 0.
+        assert writes == [1, 1, 3, 3, 3, 6, 6]
+        em.close()  # final off-stride step is published
+        assert hb.read_lease(em.path)['step'] == 7
+        assert hb.read_lease(em.path)['incarnation'] == 2
+
+    def test_incarnation_from_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(hb.ENV_INCARNATION, '4')
+        em = hb.HeartbeatEmitter(str(tmp_path), 1)
+        em.beat(0)
+        assert hb.read_lease(em.path)['incarnation'] == 4
+        assert hb.read_lease(em.path)['rank'] == 1
+
+    def test_validation(self, tmp_path):
+        with pytest.raises(ValueError):
+            hb.HeartbeatEmitter(str(tmp_path), 0, every=0)
+
+
+# ---------------------------------------------------------------------------
+# Backoff / crash-loop units
+# ---------------------------------------------------------------------------
+
+class TestBackoff:
+    def test_schedule(self):
+        b = sup_lib.RestartBackoff(base=1.0, factor=2.0, cap=8.0)
+        assert [b.next_delay() for _ in range(6)] == [
+            0.0, 1.0, 2.0, 4.0, 8.0, 8.0]
+        b.reset()
+        assert b.next_delay() == 0.0
+        assert b.next_delay() == 1.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            sup_lib.RestartBackoff(factor=0.5)
+
+
+class TestCrashLoop:
+    def test_trips_on_same_step(self):
+        d = sup_lib.CrashLoopDetector(after=3)
+        assert not d.observe(7)
+        assert not d.observe(7)
+        assert d.observe(7)
+
+    def test_progress_resets_counter(self):
+        d = sup_lib.CrashLoopDetector(after=2)
+        assert not d.observe(7)
+        assert not d.observe(9)   # progress: count back to 1
+        assert d.observe(9)
+
+    def test_repeated_unknown_step_is_a_loop(self):
+        # Dying before the first heartbeat every time (import error,
+        # bad config) IS a loop — relaunching cannot help.
+        d = sup_lib.CrashLoopDetector(after=2)
+        assert not d.observe(None)
+        assert d.observe(None)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            sup_lib.CrashLoopDetector(after=0)
+
+
+# ---------------------------------------------------------------------------
+# Straggler classifier (synthetic rank shards)
+# ---------------------------------------------------------------------------
+
+def _shards(slow_rank=None, skew_ms=40.0, n=12, jitter_rank=None):
+    shards = {}
+    for rank in range(3):
+        recs = []
+        for step in range(n):
+            ms = 10.0
+            if rank == slow_rank:
+                ms += skew_ms
+            if rank == jitter_rank and step == n // 2:
+                ms += 10 * skew_ms  # one spike, not sustained
+            recs.append({'kind': 'step', 'step': step,
+                         'host_step_ms': ms})
+        shards[rank] = recs
+    return shards
+
+
+class TestStragglerClassifier:
+    def test_sustained_skew_detected(self):
+        verdict = sup_lib.classify_stragglers(
+            _shards(slow_rank=2), skew_ms=20.0, min_steps=8)
+        assert verdict is not None
+        rank, skew = verdict
+        assert rank == 2
+        assert skew == pytest.approx(40.0)
+
+    def test_single_spike_is_not_persistent(self):
+        assert sup_lib.classify_stragglers(
+            _shards(jitter_rank=1), skew_ms=20.0, min_steps=8) is None
+
+    def test_frozen_shard_from_a_dead_rank_is_excluded(self):
+        # A rank removed by an earlier failover leaves its shard file
+        # frozen on disk; it must not pin the common-step
+        # intersection and blind the classifier forever.
+        shards = _shards(slow_rank=1, n=400)
+        shards[3] = [{'kind': 'step', 'step': s, 'host_step_ms': 10.0}
+                     for s in range(20)]  # froze at step 20
+        verdict = sup_lib.classify_stragglers(shards, skew_ms=20.0,
+                                              min_steps=8)
+        assert verdict is not None and verdict[0] == 1
+
+    def test_below_threshold_and_short_windows(self):
+        assert sup_lib.classify_stragglers(
+            _shards(slow_rank=0, skew_ms=5.0), skew_ms=20.0) is None
+        assert sup_lib.classify_stragglers(
+            _shards(slow_rank=0, n=4), skew_ms=20.0,
+            min_steps=8) is None
+        assert sup_lib.classify_stragglers({}, skew_ms=20.0) is None
+
+
+# ---------------------------------------------------------------------------
+# Supervisor process matrix (tiny jax-free children)
+# ---------------------------------------------------------------------------
+
+_CHILD_PRELUDE = """\
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from distributed_kfac_pytorch_tpu.resilience import heartbeat as hb
+from distributed_kfac_pytorch_tpu.resilience.preemption import (
+    RELAUNCH_EXIT_CODE,
+)
+inc = int(os.environ[hb.ENV_INCARNATION])
+d = os.environ[hb.ENV_DIR]
+sentinel = os.environ['KFAC_PREEMPT_FILE']
+def beat(step, rank=0):
+    hb.write_lease(hb.lease_path(d, rank), rank=rank, step=step,
+                   incarnation=inc)
+"""
+
+
+def _supervise(tmp_path, child_body, **kw):
+    """Run a Supervisor over a tiny python child; returns (rc, events,
+    sup). Fast real-time knobs throughout."""
+    script = _CHILD_PRELUDE.format(repo=REPO) + child_body
+    defaults = dict(
+        workdir=str(tmp_path / 'sup'),
+        hang_timeout=1.0, startup_grace=10.0, poll_secs=0.05,
+        drain_grace=5.0, term_grace=1.0, max_restarts=5,
+        backoff=sup_lib.RestartBackoff(base=0.0, cap=0.0))
+    defaults.update(kw)
+    sup = sup_lib.Supervisor([sys.executable, '-c', script], **defaults)
+    rc = sup.run()
+    events = [(r['event'], r.get('data', {}))
+              for r in obs_sink.read_jsonl(
+                  str(tmp_path / 'sup' / 'supervisor.jsonl'))
+              if r['kind'] == 'event']
+    return rc, events, sup
+
+
+class TestSupervisor:
+    def test_crash_relaunch_until_success(self, tmp_path):
+        rc, events, sup = _supervise(tmp_path, """\
+beat(5 + inc)
+sys.exit(1 if inc < 2 else 0)
+""")
+        assert rc == 0
+        kinds = [k for k, _ in events]
+        assert kinds == ['supervisor_restart', 'supervisor_restart']
+        assert all(d['reason'] == 'crash' and d['rc'] == 1
+                   for _, d in events)
+        assert [d['last_step'] for _, d in events] == [5, 6]
+        assert sup.restarts == 2 and sup.launches == 3
+
+    def test_budget_exhaustion_exit_code(self, tmp_path):
+        rc, events, sup = _supervise(tmp_path, """\
+beat(inc)  # progressing, so the crash-loop detector never trips
+sys.exit(1)
+""", max_restarts=2, crash_loop_after=10)
+        assert rc == sup_lib.EXHAUSTED_EXIT == 76
+        assert sup.launches == 3  # initial + 2 budgeted relaunches
+        assert [k for k, _ in events] == ['supervisor_restart'] * 2
+
+    def test_crash_loop_distinct_exit_and_diagnostic(self, tmp_path):
+        rc, events, sup = _supervise(tmp_path, """\
+beat(7)  # the SAME step fails every launch
+sys.exit(1)
+""", crash_loop_after=2, max_restarts=10)
+        assert rc == sup_lib.CRASH_LOOP_EXIT == 77
+        kinds = [k for k, _ in events]
+        assert kinds == ['supervisor_restart', 'crash_loop']
+        loop = dict(events[-1][1])
+        assert loop['failure_step'] == 7 and loop['consecutive'] == 2
+        diag_path = loop['diagnostic']
+        diag = json.load(open(diag_path))
+        assert diag['failure_step'] == 7
+        assert diag['consecutive_failures'] == 2
+        assert diag['history']  # launch trail for the post-mortem
+        assert diag['leases']['0']['step'] == 7
+
+    def test_crash_loop_counter_resets_on_progress(self, tmp_path):
+        # Steps advance every launch: the loop detector must never
+        # trip even at a threshold of 2 — the budget is the limiter.
+        rc, events, _sup = _supervise(tmp_path, """\
+beat(inc)
+sys.exit(1)
+""", crash_loop_after=2, max_restarts=3)
+        assert rc == sup_lib.EXHAUSTED_EXIT
+        assert 'crash_loop' not in [k for k, _ in events]
+
+    def test_hang_detected_kill_and_relaunch(self, tmp_path):
+        rc, events, sup = _supervise(tmp_path, """\
+if inc == 0:
+    beat(3)
+    time.sleep(60)  # stop beating without exiting
+sys.exit(0)
+""", hang_timeout=0.5)
+        assert rc == 0
+        kinds = [k for k, _ in events]
+        assert kinds == ['hang_detected', 'supervisor_restart']
+        hang = dict(events[0][1])
+        assert hang['last_step'] == 3
+        assert hang['newest_age_s'] >= 0.5
+        restart = dict(events[1][1])
+        assert restart['reason'] == 'hang'
+
+    def test_cooperative_drain_is_not_budgeted(self, tmp_path):
+        rc, events, sup = _supervise(tmp_path, """\
+beat(2)
+sys.exit(RELAUNCH_EXIT_CODE if inc == 0 else 0)
+""", max_restarts=0)
+        # max_restarts=0: any budgeted restart would exhaust — the
+        # graceful drain must not touch the budget.
+        assert rc == 0
+        assert [k for k, _ in events] == ['supervisor_restart']
+        assert events[0][1]['reason'] == 'drain'
+        assert sup.restarts == 0
+
+    _COOPERATIVE_LOOP = """\
+open(os.path.join(d, 'world%d.txt' % inc), 'w').write(
+    os.environ.get('XLA_FLAGS', ''))
+if inc == 0:
+    for i in range(600):
+        beat(i)
+        if os.path.exists(sentinel):
+            sys.exit(RELAUNCH_EXIT_CODE)
+        time.sleep(0.02)
+    sys.exit(1)
+sys.exit(0)
+"""
+
+    def test_capacity_failover_shrinks_world(self, tmp_path):
+        cap = tmp_path / 'capacity'
+        cap.write_text('2')
+        rc, events, sup = _supervise(
+            tmp_path, self._COOPERATIVE_LOOP,
+            devices=4, capacity_file=str(cap))
+        assert rc == 0
+        kinds = [k for k, _ in events]
+        assert kinds == ['supervisor_failover']
+        data = dict(events[0][1])
+        assert data['reason'] == 'capacity'
+        assert data['from_devices'] == 4 and data['to_devices'] == 2
+        hbdir = tmp_path / 'sup' / 'heartbeats'
+        assert '=4' in (hbdir / 'world0.txt').read_text()
+        assert '=2' in (hbdir / 'world1.txt').read_text()
+
+    def test_capacity_growback(self, tmp_path):
+        cap = tmp_path / 'capacity'
+        cap.write_text('4')
+        rc, events, sup = _supervise(
+            tmp_path, self._COOPERATIVE_LOOP,
+            devices=4, start_devices=2, capacity_file=str(cap))
+        assert rc == 0
+        assert [k for k, _ in events] == ['supervisor_growback']
+        data = dict(events[0][1])
+        assert data['from_devices'] == 2 and data['to_devices'] == 4
+        hbdir = tmp_path / 'sup' / 'heartbeats'
+        assert '=2' in (hbdir / 'world0.txt').read_text()
+        assert '=4' in (hbdir / 'world1.txt').read_text()
+
+    def test_dead_rank_failover_to_survivor_mesh(self, tmp_path):
+        rc, events, sup = _supervise(tmp_path, """\
+if inc == 0:
+    beat(0, rank=1)     # rank 1 beats once, then goes silent
+    for i in range(600):
+        beat(i, rank=0)  # rank 0 stays alive (wedged on collectives)
+        time.sleep(0.02)
+    sys.exit(1)
+sys.exit(0)
+""", devices=4, failover_grace=0.5, hang_timeout=30.0)
+        assert rc == 0
+        assert [k for k, _ in events] == ['supervisor_failover']
+        data = dict(events[0][1])
+        assert data['reason'] == 'dead_rank'
+        assert data['dead_ranks'] == '1' and data['live_ranks'] == '0'
+        # 4 devices across 2 ranks, 1 survivor -> 2 devices.
+        assert data['from_devices'] == 4 and data['to_devices'] == 2
+        assert sup.world == 2
+
+    def test_dead_rank_without_shrinkable_world_is_budgeted(
+            self, tmp_path):
+        # No --devices (launcher owns the topology): there is no
+        # survivor mesh to shrink onto, so the kill/relaunch must
+        # burn the restart budget instead of looping free forever.
+        rc, events, sup = _supervise(tmp_path, """\
+beat(0, rank=1)          # rank 1 wedges EVERY incarnation
+for i in range(600):
+    beat(i, rank=0)
+    time.sleep(0.02)
+sys.exit(1)
+""", failover_grace=0.4, hang_timeout=30.0, max_restarts=1)
+        assert rc == sup_lib.EXHAUSTED_EXIT
+        assert [k for k, _ in events] == ['supervisor_restart']
+        assert events[0][1]['reason'] == 'dead_rank'
+        assert sup.restarts == 2  # second attempt exhausted the budget
+
+    def test_faults_cleared_on_relaunch_unless_kept(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv(faults.ENV_VAR, 'crash@1')
+        rc, _events, _sup = _supervise(tmp_path, """\
+beat(1)
+sys.exit(1 if os.environ.get('KFAC_CHAOS') else 0)
+""")
+        assert rc == 0  # relaunch ran fault-free
+
+    def test_validation(self, tmp_path):
+        with pytest.raises(ValueError, match='no command'):
+            sup_lib.Supervisor([], workdir=str(tmp_path))
+        with pytest.raises(ValueError, match='hang-timeout'):
+            sup_lib.Supervisor(['x'], workdir=str(tmp_path),
+                               hang_timeout=0)
+
+
+# ---------------------------------------------------------------------------
+# Configurable relaunch exit code (satellite)
+# ---------------------------------------------------------------------------
+
+class TestRelaunchExitEnv:
+    def _probe(self, env_val):
+        env = {**os.environ, 'PYTHONPATH': REPO}
+        if env_val is None:
+            env.pop('KFAC_RELAUNCH_EXIT', None)
+        else:
+            env['KFAC_RELAUNCH_EXIT'] = env_val
+        return subprocess.run(
+            [sys.executable, '-c',
+             'from distributed_kfac_pytorch_tpu.resilience.preemption '
+             'import RELAUNCH_EXIT_CODE; print(RELAUNCH_EXIT_CODE)'],
+            env=env, capture_output=True, text=True, timeout=60)
+
+    def test_default_75(self):
+        out = self._probe(None)
+        assert out.returncode == 0 and out.stdout.strip() == '75'
+
+    def test_override(self):
+        out = self._probe('42')
+        assert out.returncode == 0 and out.stdout.strip() == '42'
+
+    def test_invalid_fails_closed(self):
+        out = self._probe('banana')
+        assert out.returncode != 0
+        assert 'KFAC_RELAUNCH_EXIT' in out.stderr
+        out = self._probe('0')
+        assert out.returncode != 0 and '1..255' in out.stderr
+
+    def test_supervisor_rejects_verdict_collision(self):
+        env = {**os.environ, 'PYTHONPATH': REPO,
+               'KFAC_RELAUNCH_EXIT': str(sup_lib.CRASH_LOOP_EXIT)}
+        out = subprocess.run(
+            [sys.executable, '-c',
+             'from distributed_kfac_pytorch_tpu.resilience import '
+             'supervisor as s; s.Supervisor(["x"], workdir="w")'],
+            env=env, capture_output=True, text=True, timeout=60,
+            cwd=str(REPO))
+        assert out.returncode != 0
+        assert 'collides' in out.stderr
+
+
+# ---------------------------------------------------------------------------
+# Quarantined --resume-step refusal (satellite)
+# ---------------------------------------------------------------------------
+
+class TestResumeStepQuarantined:
+    def test_message_names_dir_and_reason(self, tmp_path):
+        import argparse
+
+        from distributed_kfac_pytorch_tpu.resilience import (
+            cli as resil_cli,
+        )
+        from distributed_kfac_pytorch_tpu.training import (
+            checkpoint as ckpt_lib,
+        )
+        mgr = ckpt_lib.CheckpointManager(str(tmp_path / 'steps'))
+        os.makedirs(tmp_path / 'steps' / '5')
+        moved = mgr.quarantine(
+            5, reason='integrity checksum mismatch '
+                      '(recorded 123, computed 456)')
+        assert moved is not None and moved.endswith('.quarantined')
+        args = argparse.Namespace(checkpoint_dir=str(tmp_path),
+                                  resume_step=5)
+        with pytest.raises(SystemExit) as exc:
+            resil_cli._walk_restore(mgr, {}, args, kind='step',
+                                    explicit=5)
+        msg = str(exc.value)
+        # Pinned message surface: the quarantine DIR and the WHY.
+        assert moved in msg
+        assert 'QUARANTINED' in msg
+        assert 'integrity checksum mismatch' in msg
+        assert '--resume-step' in msg
+
+    def test_live_bundle_supersedes_quarantined_history(self, tmp_path):
+        from distributed_kfac_pytorch_tpu.training import (
+            checkpoint as ckpt_lib,
+        )
+        mgr = ckpt_lib.CheckpointManager(str(tmp_path / 'steps'))
+        os.makedirs(tmp_path / 'steps' / '5')
+        mgr.quarantine(5, reason='bit rot')
+        # The replay re-saved the label: info must be None so resume
+        # proceeds against the live bundle.
+        os.makedirs(tmp_path / 'steps' / '5')
+        assert mgr.quarantine_info(5) is None
+        assert len(mgr.quarantined_paths(5)) == 1
+
+
+# ---------------------------------------------------------------------------
+# Report / gate supervision surfaces
+# ---------------------------------------------------------------------------
+
+def _write_supervised_run(tmp_path):
+    run = tmp_path / 'run.jsonl'
+    s = obs_sink.JsonlMetricsSink(str(run), meta={'run': 'sup'})
+    for i in range(4):
+        s.step_record(i, {'loss': 1.0}, host_step_ms=10.0)
+    s.close()
+    side = obs_sink.JsonlMetricsSink(f'{run}.supervisor',
+                                     meta={'supervisor': True})
+    side.event_record('supervisor_restart', reason='crash', rc=1,
+                      restart=1, budget=5, backoff_s=0.0, last_step=2)
+    side.event_record('hang_detected', last_step=3, newest_age_s=31.0)
+    side.event_record('supervisor_restart', reason='hang', rc=-9,
+                      restart=2, budget=5, backoff_s=1.0, last_step=3)
+    side.event_record('supervisor_failover', reason='capacity',
+                      from_devices=4, to_devices=2)
+    side.event_record('supervisor_growback', reason='capacity',
+                      from_devices=2, to_devices=4)
+    side.close()
+    return run
+
+
+class TestObservabilitySurfaces:
+    def test_report_json_supervision_key(self, tmp_path, capsys):
+        run = _write_supervised_run(tmp_path)
+        assert obs_report.main([str(run), '--json']) == 0
+        parsed = json.loads(capsys.readouterr().out)
+        sup = parsed['supervision']
+        assert sup['restarts'] == 2
+        assert sup['hangs'] == 1
+        assert sup['failovers'] == 1 and sup['growbacks'] == 1
+        assert sup['crash_loops'] == 0
+        assert sup['n_events'] == 5
+
+    def test_report_text_supervision_section(self, tmp_path, capsys):
+        run = _write_supervised_run(tmp_path)
+        assert obs_report.main([str(run)]) == 0
+        out = capsys.readouterr().out
+        assert '-- supervision (5 supervisor event(s)) --' in out
+        assert 'restarts: 2' in out
+
+    def test_report_without_sidecar_is_null(self, tmp_path, capsys):
+        run = tmp_path / 'run.jsonl'
+        s = obs_sink.JsonlMetricsSink(str(run))
+        s.step_record(0, {'loss': 1.0}, host_step_ms=10.0)
+        s.close()
+        assert obs_report.main([str(run), '--json']) == 0
+        assert json.loads(capsys.readouterr().out)['supervision'] is None
+
+    def test_gate_counts_supervisor_restarts(self, tmp_path, capsys):
+        run = _write_supervised_run(tmp_path)
+        base = tmp_path / 'base.json'
+        # Baseline from a clean run (no sidecar).
+        clean = tmp_path / 'clean.jsonl'
+        s = obs_sink.JsonlMetricsSink(str(clean))
+        for i in range(4):
+            s.step_record(i, {'loss': 1.0}, host_step_ms=10.0)
+        s.close()
+        assert obs_gate.main([str(clean), '--write-baseline',
+                              str(base)]) == 0
+        capsys.readouterr()
+        # The supervised run regressed: 2 restarts vs baseline 0.
+        rc = obs_gate.main([str(run), '--baseline', str(base),
+                            '--json', '--no-anomaly'])
+        verdict = json.loads(capsys.readouterr().out)
+        assert verdict['current']['supervisor_restarts'] == 2
+        assert rc == 1
+        assert any(b['metric'] == 'supervisor_restarts'
+                   for b in verdict['breaches'])
+
+    def test_event_kinds_registered(self):
+        for kind in ('supervisor_restart', 'supervisor_failover',
+                     'supervisor_growback', 'hang_detected',
+                     'crash_loop'):
+            assert kind in obs_sink.EVENT_KINDS
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: heartbeats are pure (bit-identity + zero retrace)
+# ---------------------------------------------------------------------------
+
+class TestEngineHeartbeat:
+    def _run(self, tmp_path, with_heartbeat):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from distributed_kfac_pytorch_tpu import KFAC, launch
+        from distributed_kfac_pytorch_tpu.parallel import (
+            distributed as D,
+        )
+        from distributed_kfac_pytorch_tpu.training import engine
+
+        if self._cache is None:
+            import flax.linen as nn
+
+            class Net(nn.Module):
+                @nn.compact
+                def __call__(self, x):
+                    return nn.Dense(4)(nn.tanh(nn.Dense(8)(x)))
+
+            kfac = KFAC(Net(), factor_update_freq=1, inv_update_freq=2,
+                        damping=0.003, lr=0.1)
+            variables, _ = kfac.init(jax.random.PRNGKey(0),
+                                     jnp.zeros((2, 6)))
+            params0 = variables['params']
+            mesh = D.make_kfac_mesh(jax.devices()[:2])
+            dkfac = D.DistributedKFAC(kfac, mesh, params0)
+            tx = optax.sgd(0.05)
+            step_fn = dkfac.build_train_step(
+                lambda out, b: jnp.mean((out - b[1]) ** 2), tx,
+                donate=False)
+            type(self)._cache = (mesh, dkfac, tx, step_fn, params0)
+        mesh, dkfac, tx, step_fn, params0 = self._cache
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        params = jax.device_put(params0, NamedSharding(mesh, P()))
+        state = engine.TrainState(params=params,
+                                  opt_state=tx.init(params),
+                                  kfac_state=dkfac.init_state(params),
+                                  extra_vars={})
+        rng = np.random.RandomState(0)
+        data = [(rng.randn(8, 6).astype(np.float32),
+                 rng.randn(8, 4).astype(np.float32))
+                for _ in range(6)]
+        heartbeat = None
+        if with_heartbeat:
+            heartbeat = hb.HeartbeatEmitter(str(tmp_path / 'hb'), 0,
+                                            every=2)
+        losses = []
+
+        class Sink:
+            def step_record(self, step, metrics, host_step_ms=None,
+                            fired=None):
+                losses.append(metrics['loss'])
+
+            def epoch_record(self, *a, **k):
+                pass
+
+            def flush(self):
+                pass
+
+        hyper = {'lr': 0.05, 'damping': 0.003,
+                 'factor_update_freq': 1, 'inv_update_freq': 2}
+        engine.train_epoch(step_fn, state,
+                           launch.global_batches(mesh, iter(data)),
+                           hyper, metrics_sink=Sink(),
+                           heartbeat=heartbeat)
+        if heartbeat is not None:
+            heartbeat.close()
+        import jax as _jax
+        return ([float(_jax.device_get(v)) for v in losses],
+                step_fn, heartbeat)
+
+    _cache = None
+
+    def test_bit_identity_and_zero_retraces(self, tmp_path):
+        off, step_fn, _ = self._run(tmp_path / 'off', False)
+        on, step_fn2, emitter = self._run(tmp_path / 'on', True)
+        # Heartbeats are pure host file I/O: per-step losses are
+        # BIT-identical and no program variant retraced.
+        assert on == off
+        assert step_fn is step_fn2
+        assert all(v == 1 for v in step_fn.trace_counts.values()), \
+            step_fn.trace_counts
+        lease = hb.read_lease(emitter.path)
+        assert lease is not None
+        assert lease['step'] == 6  # final close() publishes step 6
+
+
+# ---------------------------------------------------------------------------
+# Slow tier: multi-launch sequence through the real LM CLI
+# ---------------------------------------------------------------------------
+
+def _lm_cmd(tmp_path, metrics, ckpt):
+    return [sys.executable,
+            os.path.join(REPO, 'examples', 'train_language_model.py'),
+            '--arch', 'transformer', '--epochs', '1',
+            '--emsize', '16', '--nhid', '16', '--nlayers', '1',
+            '--nheads', '2', '--bptt', '8', '--batch-size', '8',
+            '--kfac-update-freq', '2', '--warmup-epochs', '0',
+            '--log-dir', str(tmp_path / 'logs'),
+            '--checkpoint-dir', str(ckpt),
+            '--checkpoint-steps', '1', '--metrics-interval', '1',
+            '--kfac-metrics', str(metrics)]
+
+
+@pytest.mark.slow
+class TestLMCLISupervised:
+    def test_crash_hang_shrink_growback_sequence(self, tmp_path):
+        """The acceptance sequence through the REAL LM CLI: an injected
+        crash recovers under the supervisor, an injected hang is
+        detected via lease expiry and recovers, a capacity drop shrinks
+        4 -> 2 devices through the elastic resume (supervisor_failover
+        then topology_change), and restored capacity grows back 2 -> 4
+        (supervisor_growback). scripts/supervisor_smoke.sh is the
+        standalone CI form."""
+        # Corpus sized so the 10% val split still yields >= 1 full
+        # bptt-8 batch (smaller corpora make evaluate() raise
+        # zero-batches and the crash legs misclassify).
+        env = {**os.environ, 'PYTHONPATH': REPO, 'JAX_PLATFORMS': 'cpu',
+               'KFAC_SYNTHETIC_LM': '1024', 'KFAC_COMPILE_CACHE': '0',
+               'PYTHONUNBUFFERED': '1'}
+        env['XLA_FLAGS'] = ' '.join(
+            f for f in env.get('XLA_FLAGS', '').split()
+            if 'xla_force_host_platform_device_count' not in f)
+        cap = tmp_path / 'capacity'
+
+        def supervise(chaos, *, phase, devices=None,
+                      start_devices=None, capacity=None,
+                      hang_timeout=600.0):
+            # Each phase is a fresh training run (own checkpoint tree
+            # and metrics stream): a completed prior phase would
+            # otherwise resume-at-end and no-op the fault.
+            metrics = tmp_path / f'run{phase}.jsonl'
+            ckpt = tmp_path / f'ckpt{phase}'
+            if capacity is not None:
+                cap.write_text(str(capacity))
+            run_env = dict(env)
+            if chaos:
+                run_env['KFAC_CHAOS'] = chaos
+            else:
+                run_env.pop('KFAC_CHAOS', None)
+            cmd = ([sys.executable, '-m',
+                    'distributed_kfac_pytorch_tpu.resilience'
+                    '.supervisor',
+                    '--workdir', str(tmp_path / f'sup{phase}'),
+                    '--metrics', str(metrics),
+                    '--events', str(tmp_path / f'events{phase}.jsonl'),
+                    '--hang-timeout', str(hang_timeout),
+                    '--startup-grace', '600',
+                    '--poll', '0.2', '--drain-grace', '300',
+                    '--backoff', '0', '--max-restarts', '3']
+                   + (['--devices', str(devices)] if devices else [])
+                   + (['--start-devices', str(start_devices)]
+                      if start_devices else [])
+                   + (['--capacity-file', str(cap)] if capacity
+                      else [])
+                   + ['--'] + _lm_cmd(tmp_path, metrics, ckpt))
+            out = subprocess.run(cmd, env=run_env, capture_output=True,
+                                 text=True, timeout=1200)
+            events = [r['event'] for r in obs_sink.read_jsonl(
+                str(tmp_path / f'events{phase}.jsonl'))
+                if r['kind'] == 'event']
+            return out, events, metrics
+
+        # Phase 1: crash@1 — the supervisor relaunches and the run
+        # completes.
+        out, events, _m = supervise('crash@1', phase=1)
+        assert out.returncode == 0, \
+            f'{out.stdout[-2000:]}\n{out.stderr[-3000:]}'
+        assert events == ['supervisor_restart']
+
+        # Phase 2: hang@2 — lease expiry past the timeout, kill,
+        # relaunch from the step-1 checkpoint, complete.
+        out, events, _m = supervise('hang@2', phase=2,
+                                    hang_timeout=20.0)
+        assert out.returncode == 0, \
+            f'{out.stdout[-2000:]}\n{out.stderr[-3000:]}'
+        assert events == ['hang_detected', 'supervisor_restart']
+
+        # Phase 3: capacity loss mid-run — drain, shrink 4 -> 2 via
+        # the elastic resume (supervisor_failover then the training
+        # stream's topology_change).
+        out, events, metrics = supervise(None, phase=3, devices=4,
+                                         capacity=2)
+        assert out.returncode == 0, \
+            f'{out.stdout[-2000:]}\n{out.stderr[-3000:]}'
+        assert events == ['supervisor_failover']
+        stream = obs_sink.read_jsonl(str(metrics))
+        tc = [r for r in stream if r.get('event') == 'topology_change']
+        assert tc and tc[-1]['data']['to_devices'] == 2, tc
+
+        # Phase 4: capacity returned — a job running shrunken grows
+        # back 2 -> 4.
+        out, events, metrics = supervise(None, phase=4, devices=4,
+                                         start_devices=2, capacity=4)
+        assert out.returncode == 0, \
+            f'{out.stdout[-2000:]}\n{out.stderr[-3000:]}'
+        assert events == ['supervisor_growback']
+        stream = obs_sink.read_jsonl(str(metrics))
+        tc = [r for r in stream if r.get('event') == 'topology_change']
+        assert tc and tc[-1]['data']['to_devices'] == 4, tc
