@@ -1,0 +1,47 @@
+"""Fleet scheduling: training-as-a-service over one device pool.
+
+The r18 layer above the single-job stack (ROADMAP item 4): where the
+r17 supervisor keeps ONE job alive, the fleet keeps a *job mix*
+healthy — packing many declarative jobs onto a shared pool, shrinking
+a running job to admit an urgent one (and regrowing it after), aging
+starved priorities, and quarantining crash-looping jobs without
+stopping the rest. Three parts:
+
+  - :mod:`jobspec` — the declarative :class:`jobspec.JobSpec`
+    (workload argv, device min/max, priority, optional
+    ``TUNED_<workload>.json`` applied on placement) with fail-closed
+    parsing: a bad jobs file can never silently run a job with a
+    constraint dropped.
+  - :mod:`scheduler` — the pool manager
+    (``python -m distributed_kfac_pytorch_tpu.fleet``): a priority
+    waterfill over per-job r17 supervisors, each driven through its
+    own capacity-file control channel; scheduler decisions are
+    registered events (``fleet_admit`` / ``fleet_preempt`` /
+    ``fleet_regrow`` / ``fleet_quarantine`` / ``fleet_complete``) in
+    ``<workdir>/fleet.jsonl``, and terminal events carry per-job SLO
+    rows the report/gate consume.
+  - :mod:`chaos` — fleet-level fault injection
+    (``KFAC_FLEET_CHAOS``: ``job-kill@K``, ``pool-loss@K->N``,
+    ``queue-flood@K``), parsed fail-closed like the training-level
+    chaos spec.
+
+See README "Fleet scheduling". Everything loads lazily, mirroring
+``resilience``/``observability``.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+_LAZY = ('jobspec', 'scheduler', 'chaos')
+
+__all__ = list(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        mod = importlib.import_module(
+            f'distributed_kfac_pytorch_tpu.fleet.{name}')
+        globals()[name] = mod
+        return mod
+    raise AttributeError(name)

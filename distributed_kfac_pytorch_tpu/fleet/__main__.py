@@ -1,0 +1,8 @@
+"""``python -m distributed_kfac_pytorch_tpu.fleet`` entry point."""
+
+import sys
+
+from distributed_kfac_pytorch_tpu.fleet.scheduler import main
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,125 @@
+"""Fleet-level fault injectors (the chaos half of the fleet layer).
+
+Where ``resilience.faults`` injects failures INTO one training
+process, this module injects them into the *fleet*: the scheduler
+reads a :class:`FleetFaultPlan` from the ``KFAC_FLEET_CHAOS`` env var
+(or takes one directly) and fires each fault at the named scheduler
+tick. Spec grammar — comma-separated ``kind@tick``::
+
+    job-kill@K        at tick K, SIGKILL the oldest running job's
+                      child process (located via its newest heartbeat
+                      lease pid) — the killed-worker path ONE LEVEL
+                      UP: the job's own supervisor must classify the
+                      crash and relaunch it under its budget while the
+                      fleet keeps scheduling everyone else
+    pool-loss@K->N    at tick K, force the pool's device capacity to
+                      N — the slice-loss path: the scheduler must
+                      shrink (and, below every job's minimum, preempt
+                      back to the queue) running jobs until the mix
+                      fits, via each job's capacity-file control
+                      channel
+    queue-flood@K     at tick K, enqueue a burst of high-priority
+                      clones of the fleet's highest-priority job —
+                      the starvation path: priority aging must still
+                      admit the starved low-priority job
+
+A scheduler *tick* is one pass of the fleet loop (one ``--poll``
+interval). Parsing fails CLOSED exactly like the training-level
+chaos spec (r16): unknown kinds, malformed ticks and duplicated kinds
+raise before the fleet launches anything, with the full kind menu in
+the message. Faults are one-shot per fleet run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+ENV_VAR = 'KFAC_FLEET_CHAOS'
+_KINDS = ('job-kill', 'pool-loss', 'queue-flood')
+_GRAMMAR = 'job-kill@K, pool-loss@K->N, queue-flood@K'
+#: How many clones a queue-flood enqueues, and the arrival spacing
+#: between them. The flood is a SUSTAINED stream, not one burst:
+#: uniform-rate priority aging can only reorder a waiter past
+#: later-arriving competitors (two jobs aging from the same instant
+#: keep their relative order forever), so a single burst could never
+#: exercise the starvation-freedom property the fault exists to prove
+#: — a clone arriving ``a`` seconds after the starved job is overtaken
+#: exactly when ``a > priority_gap * aging_secs``, independent of job
+#: runtimes.
+FLOOD_COPIES = 4
+FLOOD_SPACING_S = 1.5
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetFaultPlan:
+    """Scheduler-tick-indexed fleet fault schedule (None = unarmed)."""
+    job_kill_at: int | None = None
+    pool_loss_at: int | None = None
+    pool_loss_to: int | None = None  # forced pool size for pool_loss
+    queue_flood_at: int | None = None
+
+    def any(self) -> bool:
+        return any(v is not None for v in dataclasses.astuple(self))
+
+
+def parse_spec(spec: str | None) -> FleetFaultPlan | None:
+    """Parse a ``kind@tick[,kind@tick...]`` spec; None/'' -> None.
+
+    Fails closed at parse time — an unknown kind, a malformed tick or
+    a duplicated kind raises here, before any job is admitted, so a
+    fleet chaos run can never silently schedule fault-free because
+    its spec never matched at fire time (the r16 discipline). The
+    ``pool-loss`` kind takes ``pool-loss@<tick>-><devices>`` (e.g.
+    ``pool-loss@3->2``: from tick 3 the pool only has 2 devices).
+    """
+    if not spec:
+        return None
+    fields: dict = {}
+    for part in spec.split(','):
+        part = part.strip()
+        if not part:
+            continue
+        kind, sep, at = part.partition('@')
+        if sep and kind == 'pool-loss':
+            tick_s, arrow, to_s = at.partition('->')
+            if not (arrow and tick_s.isdigit() and to_s.isdigit()):
+                raise ValueError(
+                    f'bad {ENV_VAR} fault spec {part!r}: expected '
+                    "'pool-loss@<tick>-><devices>' (e.g. "
+                    f"'pool-loss@3->2'); valid fault kinds: "
+                    f'{_GRAMMAR}')
+            _set_once(fields, 'pool_loss_at', int(tick_s), part, spec)
+            fields['pool_loss_to'] = int(to_s)
+            continue
+        if not sep or kind not in _KINDS:
+            raise ValueError(
+                f'bad {ENV_VAR} fault spec {part!r}: unknown fault '
+                f'kind {kind!r} — valid fault kinds: {_GRAMMAR}')
+        if not at.isdigit():
+            raise ValueError(
+                f'bad {ENV_VAR} fault spec {part!r}: {at!r} is not a '
+                f'scheduler tick; valid fault kinds: {_GRAMMAR}')
+        _set_once(fields, kind.replace('-', '_') + '_at', int(at),
+                  part, spec)
+    return FleetFaultPlan(**fields) if fields else None
+
+
+def _set_once(fields: dict, key: str, value: int, part: str,
+              spec: str) -> None:
+    """Duplicated kinds fail closed (one tick per kind — the dropped
+    injection would otherwise never fire and the chaos run would
+    'pass' without testing anything; same rationale as
+    ``resilience.faults._set_once``)."""
+    if key in fields:
+        raise ValueError(
+            f'bad {ENV_VAR} spec {spec!r}: fault kind in {part!r} '
+            'appears more than once (each kind fires at ONE tick; '
+            'chain separate fleet runs for repeated faults)')
+    fields[key] = value
+
+
+def plan_from_env() -> FleetFaultPlan | None:
+    """The fleet's fault plan per ``$KFAC_FLEET_CHAOS`` (None = no
+    chaos)."""
+    return parse_spec(os.environ.get(ENV_VAR))

@@ -1,0 +1,222 @@
+"""Declarative job specifications for the fleet scheduler.
+
+A :class:`JobSpec` is everything the fleet needs to run one training
+job on the shared pool: the workload command line, its device
+min/max, a priority, and the optional per-workload tuned artifact
+(``TUNED_<workload>.json``, r12) applied on placement.
+
+Parsing is **fail-closed**, matching the r12 ``--tuned-config``
+contract: a job object with an unknown field, a missing required
+field, or an ill-typed value raises here — before anything launches —
+with the FULL field menu in the message, so a typo'd jobs file can
+never silently run a job with its constraint dropped.
+:func:`load_jobs` softens that per job only: each invalid entry is
+returned as a reject (the scheduler quarantines it with exactly one
+``fleet_quarantine`` event and keeps scheduling the valid ones), while
+an unparseable file is a hard error.
+
+Jobs-file shape (JSON)::
+
+    {"jobs": [{"name": "lm-a", "argv": ["python", "examples/..."],
+               "priority": 1, "min_devices": 1, "max_devices": 4,
+               "tuned_config": "TUNED_flagship_lm.json"},
+              ...]}
+
+A bare top-level list of job objects is accepted too.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+#: One line per field — error messages cite the WHOLE menu (the
+#: chaos-spec discipline from r16: a bad jobs file is fixable from the
+#: traceback alone).
+FIELD_MENU = (
+    'name (str, required, unique), '
+    'argv (list[str], required — the workload command), '
+    'priority (int, default 0; higher = more urgent), '
+    'min_devices (int >= 1, default 1), '
+    'max_devices (int >= min_devices, default min_devices), '
+    'tuned_config (str path, optional — appended as --tuned-config '
+    'on placement, fail-closed in the child per the r12 contract), '
+    'gate_baseline (str path, optional — BASELINE_OBS.json gated '
+    'against the job stream at completion), '
+    'max_restarts (int >= 0, default 5), '
+    'keep_faults (bool, default false — re-inject KFAC_CHAOS on '
+    'every relaunch, the crash-loop legs\' shape), '
+    'env (object of str->str, optional per-job child environment), '
+    'after_s (number >= 0, default 0 — the job becomes eligible this '
+    'many seconds after the fleet starts; models staggered arrivals)'
+)
+
+_REQUIRED = ('name', 'argv')
+_OPTIONAL = ('priority', 'min_devices', 'max_devices', 'tuned_config',
+             'gate_baseline', 'max_restarts', 'keep_faults', 'env',
+             'after_s')
+
+
+@dataclasses.dataclass(frozen=True)
+class JobSpec:
+    """One declarative fleet job (validated — build via
+    :func:`parse_job`, or directly from tests)."""
+    name: str
+    argv: tuple
+    priority: int = 0
+    min_devices: int = 1
+    max_devices: int = 1
+    tuned_config: str | None = None
+    gate_baseline: str | None = None
+    max_restarts: int = 5
+    keep_faults: bool = False
+    env: tuple = ()          # ((key, value), ...) — hashable
+    after_s: float = 0.0
+
+    def env_dict(self) -> dict:
+        return dict(self.env)
+
+
+def _bad(what: str) -> ValueError:
+    return ValueError(f'bad JobSpec: {what}; valid fields: '
+                      f'{FIELD_MENU}')
+
+
+def parse_job(obj, *, index: int = 0) -> JobSpec:
+    """One job object -> :class:`JobSpec`, failing closed.
+
+    ``index`` names the entry in error messages when the object has no
+    usable ``name`` of its own.
+    """
+    label = f'jobs[{index}]'
+    if not isinstance(obj, dict):
+        raise _bad(f'{label} is not an object '
+                   f'({type(obj).__name__})')
+    if isinstance(obj.get('name'), str) and obj['name']:
+        label = f'job {obj["name"]!r}'
+    unknown = sorted(set(obj) - set(_REQUIRED) - set(_OPTIONAL))
+    if unknown:
+        raise _bad(f'{label} has unknown field(s) {unknown}')
+    missing = sorted(k for k in _REQUIRED if k not in obj)
+    if missing:
+        raise _bad(f'{label} is missing required field(s) {missing}')
+    name = obj['name']
+    if not isinstance(name, str) or not name:
+        raise _bad(f'{label}: name must be a non-empty string, '
+                   f'got {name!r}')
+    argv = obj['argv']
+    if (not isinstance(argv, (list, tuple)) or not argv
+            or not all(isinstance(a, str) for a in argv)):
+        raise _bad(f'{label}: argv must be a non-empty list of '
+                   f'strings, got {argv!r}')
+
+    def _int(key, default, floor):
+        v = obj.get(key, default)
+        if isinstance(v, bool) or not isinstance(v, int):
+            raise _bad(f'{label}: {key} must be an integer, got {v!r}')
+        if v < floor:
+            raise _bad(f'{label}: {key} must be >= {floor}, got {v}')
+        return v
+
+    priority = obj.get('priority', 0)
+    if isinstance(priority, bool) or not isinstance(priority, int):
+        raise _bad(f'{label}: priority must be an integer, '
+                   f'got {priority!r}')
+    min_devices = _int('min_devices', 1, 1)
+    max_devices = _int('max_devices', min_devices, 1)
+    if max_devices < min_devices:
+        raise _bad(f'{label}: max_devices {max_devices} is below '
+                   f'min_devices {min_devices}')
+    max_restarts = _int('max_restarts', 5, 0)
+    for key in ('tuned_config', 'gate_baseline'):
+        v = obj.get(key)
+        if v is not None and (not isinstance(v, str) or not v):
+            raise _bad(f'{label}: {key} must be a non-empty string '
+                       f'path, got {v!r}')
+    keep_faults = obj.get('keep_faults', False)
+    if not isinstance(keep_faults, bool):
+        raise _bad(f'{label}: keep_faults must be a boolean, '
+                   f'got {keep_faults!r}')
+    env = obj.get('env', {})
+    if (not isinstance(env, dict)
+            or not all(isinstance(k, str) and isinstance(v, str)
+                       for k, v in env.items())):
+        raise _bad(f'{label}: env must be an object of string->string,'
+                   f' got {env!r}')
+    after_s = obj.get('after_s', 0.0)
+    if isinstance(after_s, bool) or not isinstance(after_s,
+                                                   (int, float)):
+        raise _bad(f'{label}: after_s must be a number, '
+                   f'got {after_s!r}')
+    if after_s < 0:
+        raise _bad(f'{label}: after_s must be >= 0, got {after_s}')
+    return JobSpec(
+        name=name, argv=tuple(argv), priority=priority,
+        min_devices=min_devices, max_devices=max_devices,
+        tuned_config=obj.get('tuned_config'),
+        gate_baseline=obj.get('gate_baseline'),
+        max_restarts=max_restarts, keep_faults=keep_faults,
+        env=tuple(sorted(env.items())), after_s=float(after_s))
+
+
+def parse_jobs(obj) -> tuple[list[JobSpec], list[tuple[str, str]]]:
+    """A decoded jobs document -> ``(specs, rejects)``.
+
+    ``rejects`` pairs a job label with its parse error — each one is a
+    job that fails CLOSED (never scheduled; the fleet records exactly
+    one ``fleet_quarantine`` event per reject). A document that is not
+    a list (or ``{"jobs": [...]}``) is a hard :class:`ValueError`.
+    Duplicate names reject the later occurrence: two jobs would race
+    for one artifact namespace.
+    """
+    if isinstance(obj, dict) and isinstance(obj.get('jobs'), list):
+        entries = obj['jobs']
+    elif isinstance(obj, list):
+        entries = obj
+    else:
+        raise _bad('jobs document must be a list of job objects or '
+                   '{"jobs": [...]}')
+    specs: list[JobSpec] = []
+    rejects: list[tuple[str, str]] = []
+    seen: set[str] = set()
+    for i, entry in enumerate(entries):
+        try:
+            spec = parse_job(entry, index=i)
+        except ValueError as e:
+            name = (entry.get('name') if isinstance(entry, dict)
+                    else None)
+            label = (name if isinstance(name, str) and name
+                     else f'jobs[{i}]')
+            rejects.append((str(label), str(e)))
+            continue
+        if spec.name in seen:
+            # Label distinct from the scheduled job's name: the
+            # report's per-job SLO table keys rows by name, and the
+            # reject's quarantine row must not be overwritten by the
+            # valid namesake's terminal row.
+            rejects.append((f'{spec.name} (duplicate, jobs[{i}])',
+                            f'duplicate job name {spec.name!r} '
+                            '(names key the per-job artifact '
+                            'namespace and must be unique)'))
+            continue
+        seen.add(spec.name)
+        specs.append(spec)
+    return specs, rejects
+
+
+def load_jobs(path: str) -> tuple[list[JobSpec],
+                                  list[tuple[str, str]]]:
+    """Read a jobs file; see :func:`parse_jobs` for the contract.
+
+    An unreadable or undecodable file is a hard :class:`ValueError`
+    (there is nothing partial to schedule).
+    """
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except OSError as e:
+        raise ValueError(f'cannot read jobs file {path}: {e}') from e
+    except json.JSONDecodeError as e:
+        raise ValueError(f'jobs file {path} is not valid JSON: '
+                         f'{e}') from e
+    return parse_jobs(obj)

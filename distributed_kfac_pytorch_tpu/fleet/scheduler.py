@@ -1,0 +1,750 @@
+"""Fleet scheduler: many training jobs, one device pool.
+
+    python -m distributed_kfac_pytorch_tpu.fleet jobs.json \\
+        --pool-devices 8 --workdir ./fleet
+
+The training-as-a-service layer (ISSUE r18, ROADMAP item 4): a
+priority queue of declarative :class:`fleet.jobspec.JobSpec`\\ s packed
+onto one device pool. Every placed job runs under its own r17
+:class:`resilience.supervisor.Supervisor` — the fleet never touches a
+training process directly; its one control channel per job is the
+job's **capacity file** (the supervisor's ``--capacity-file``
+contract): writing a smaller world drains the job and relaunches it
+shrunken through the r11 elastic resume (N→M→N bit-identity pinned),
+writing a larger one grows it back. Device worlds ride the
+``XLA_FLAGS`` host-platform device count
+(``faults.xla_flags_with_device_count``), so the whole layer is
+CPU-testable; on a real fleet the resource manager owns device counts
+and this scheduler models its placement step.
+
+Scheduling policy (one **tick** = one ``--poll`` pass):
+
+  - *Allocation* is a priority waterfill: jobs (running first among
+    equals, then queued by effective priority and arrival) each get
+    their ``min_devices`` while capacity lasts, then leftovers are
+    dealt out up to ``max_devices`` in the same order. The diff
+    against the current assignment becomes capacity-file writes:
+    shrinks emit ``fleet_preempt``, growths ``fleet_regrow``, new
+    placements ``fleet_admit``. Admitting an urgent job therefore
+    *shrinks* the lowest-priority shrinkable job rather than waiting
+    for it to finish, and the victim regrows as soon as the urgent
+    job completes. Incumbents always keep at least ``min_devices``:
+    admission can shrink a running job, never evict it — full
+    preempt-back-to-queue is reserved for pool capacity loss (the
+    alternative livelocks; see ``_allocate``).
+  - *Starvation-freedom*: a queued job's effective priority is
+    ``priority + wait_seconds / aging_secs`` — a sustained flood of
+    high-priority arrivals can delay a low-priority job, never
+    starve it.
+  - *Isolation*: a job whose supervisor gives up — crash-loop exit
+    (77, diagnostic bundle already written), restart-budget
+    exhaustion (76) or any other failing exit — is **quarantined**
+    (one ``fleet_quarantine`` event carrying its SLO row and
+    diagnostic path) and the fleet keeps scheduling everyone else.
+    Rejected job specs fail closed the same way: one
+    ``fleet_quarantine`` event each, never a partial launch
+    (the r12 ``--tuned-config`` discipline, one level up).
+  - *Pool capacity* may itself move: ``--capacity-file`` is polled
+    with the same torn-read tolerance as the per-job channel (keep
+    the last known pool, one ``capacity_degraded`` event per
+    episode), and the ``KFAC_FLEET_CHAOS`` plan (``fleet.chaos``) can
+    force losses for the chaos legs. Jobs that no longer fit even at
+    ``min_devices`` are preempted back to the queue.
+
+Observability: scheduler decisions are durable events
+(``fleet_admit`` / ``fleet_preempt`` / ``fleet_regrow`` /
+``fleet_quarantine`` / ``fleet_complete``, registered in
+``sink.EVENT_KINDS``) in the fleet's own ``<workdir>/fleet.jsonl``
+stream; terminal events carry the job's SLO row (queue wait, run
+time, restarts, preemption count, final gate verdict against the
+spec's ``gate_baseline``). ``observability.report`` renders the
+per-job table under its ``fleet`` section (``--json`` key pinned) and
+``observability.gate`` counts ``fleet_quarantines`` (absolute
+tolerance). Per-job telemetry is namespaced under
+``<workdir>/jobs/<name>/`` (metrics stream + ``.supervisor`` sidecar
++ heartbeats), so every job remains individually reportable.
+
+Exit codes: 0 = every job completed; 1 = at least one job
+quarantined/failed (or the fleet was interrupted); 2 = usage / jobs
+file unreadable; 3 = ``--deadline`` exceeded (jobs drained).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+import zlib
+
+from distributed_kfac_pytorch_tpu.fleet import chaos as fleet_chaos
+from distributed_kfac_pytorch_tpu.fleet.jobspec import (
+    JobSpec,
+    load_jobs,
+)
+from distributed_kfac_pytorch_tpu.resilience import (
+    heartbeat as hb_lib,
+)
+from distributed_kfac_pytorch_tpu.resilience import (
+    supervisor as sup_lib,
+)
+
+#: Fleet exit code when --deadline expires with jobs still unfinished.
+DEADLINE_EXIT = 3
+
+#: Supervisor keyword arguments a fleet may override per run (the
+#: ``sup_options`` constructor argument / the CLI pass-through flags).
+SUP_OPTION_KEYS = ('hang_timeout', 'startup_grace', 'failover_grace',
+                   'poll_secs', 'drain_grace', 'term_grace',
+                   'crash_loop_after')
+
+
+class _Job:
+    """Mutable runtime state around one immutable :class:`JobSpec`."""
+
+    def __init__(self, spec: JobSpec, seq: int, now: float):
+        self.spec = spec
+        self.seq = seq
+        self.state = 'queued'   # queued/running/stopping/done/quarantined
+        self.submit_time = now
+        self.eligible_at = now + spec.after_s
+        self.admit_time: float | None = None   # first placement
+        self.end_time: float | None = None
+        self.assigned = 0
+        self.preemptions = 0
+        self.restarts_total = 0
+        self.sup: sup_lib.Supervisor | None = None
+        self.thread: threading.Thread | None = None
+        self.rc: int | None = None
+        self.error: str | None = None
+        self.jobdir: str | None = None
+        self.metrics: str | None = None
+        self.capacity_path: str | None = None
+
+
+class FleetScheduler:
+    """One fleet run: queue, place, watch, rebalance, report.
+
+    ``clock``/``sleep`` are injectable for tests; all timing knobs are
+    in seconds. ``sup_options`` overrides per-job supervisor knobs
+    (:data:`SUP_OPTION_KEYS`); per-job restart budgets/keep-faults
+    come from each :class:`JobSpec`.
+    """
+
+    def __init__(self, specs: list[JobSpec], *, pool_devices: int,
+                 workdir: str, rejects=None,
+                 poll_secs: float = 0.5, aging_secs: float = 30.0,
+                 capacity_file: str | None = None,
+                 plan: fleet_chaos.FleetFaultPlan | None = None,
+                 sup_options: dict | None = None,
+                 backoff_base: float = 1.0, backoff_cap: float = 60.0,
+                 backoff_jitter: float = 0.5,
+                 clock=time.time, sleep=time.sleep):
+        if pool_devices < 1:
+            raise ValueError(f'pool must have >= 1 device, '
+                             f'got {pool_devices}')
+        if aging_secs < 0:
+            raise ValueError(f'{aging_secs=} must be >= 0 (0 = no '
+                             'priority aging)')
+        bad = sorted(set(sup_options or ()) - set(SUP_OPTION_KEYS))
+        if bad:
+            raise ValueError(f'unknown sup_options {bad} '
+                             f'(one of {SUP_OPTION_KEYS})')
+        self.pool_devices = int(pool_devices)
+        self.workdir = os.path.abspath(workdir)
+        self.poll_secs = float(poll_secs)
+        self.aging_secs = float(aging_secs)
+        self.capacity_file = capacity_file
+        self.plan = plan
+        self.sup_options = dict(sup_options or {})
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self.backoff_jitter = float(backoff_jitter)
+        self._clock = clock
+        self._sleep = sleep
+        self._stop: str | None = None
+        self._seq = 0
+        self._last_pool = self.pool_devices
+        self._pool_file = (sup_lib.CapacityFile(capacity_file)
+                           if capacity_file else None)
+        self._forced_pool: int | None = None
+        self._fired: set[str] = set()
+        self.initial_specs = list(specs)
+        self.jobs: list[_Job] = []
+        self.rejects = list(rejects or [])
+        os.makedirs(self.workdir, exist_ok=True)
+        from distributed_kfac_pytorch_tpu.observability.sink import (
+            JsonlMetricsSink,
+        )
+        self.events_path = os.path.join(self.workdir, 'fleet.jsonl')
+        self.events = JsonlMetricsSink(
+            self.events_path, process_index=0,
+            meta={'fleet': True, 'pool_devices': self.pool_devices,
+                  'n_jobs': len(specs), 'aging_secs': self.aging_secs})
+        now = self._clock()
+        for spec in specs:
+            self.submit(spec, now=now)
+
+    # -- queue ----------------------------------------------------------
+
+    def submit(self, spec: JobSpec, now: float | None = None) -> _Job:
+        """Enqueue one job (initial pack, a late arrival, or a chaos
+        flood clone). Eligibility honors ``spec.after_s`` relative to
+        NOW, so mid-run submissions are immediate by default."""
+        if now is None:
+            now = self._clock()
+        self._seq += 1
+        job = _Job(spec, self._seq, now)
+        self.jobs.append(job)
+        return job
+
+    # -- event plumbing -------------------------------------------------
+
+    def _event(self, name: str, **data) -> None:
+        self.events.event_record(name, **data)
+        detail = ' '.join(f'{k}={v}' for k, v in sorted(data.items()))
+        print(f'fleet: {name} {detail}', file=sys.stderr, flush=True)
+
+    # -- pool capacity --------------------------------------------------
+
+    def _pool_capacity(self) -> int:
+        """The pool's current device capacity: the static
+        ``pool_devices`` unless a capacity file (the resource
+        manager's live view) or an injected pool-loss says less. The
+        file read shares the supervisor's torn-read discipline
+        (``supervisor.CapacityFile``): keep the last known pool, one
+        ``capacity_degraded`` event per degradation episode, never
+        crash the scheduling loop."""
+        cap = self.pool_devices
+        if self._pool_file is not None:
+            pool, error = self._pool_file.read()
+            if error is not None:
+                self._event('capacity_degraded',
+                            path=self.capacity_file, error=error,
+                            last_target=pool)
+            if pool is not None:
+                cap = min(cap, pool)
+        if self._forced_pool is not None:
+            cap = min(cap, self._forced_pool)
+        return max(0, cap)
+
+    # -- chaos ----------------------------------------------------------
+
+    def _fire_chaos(self, tick: int) -> None:
+        plan = self.plan
+        if plan is None:
+            return
+        if plan.pool_loss_at is not None \
+                and tick >= plan.pool_loss_at \
+                and 'pool-loss' not in self._fired:
+            self._fired.add('pool-loss')
+            self._forced_pool = plan.pool_loss_to
+            print(f'fleet chaos: pool-loss — capacity forced to '
+                  f'{plan.pool_loss_to} at tick {tick}',
+                  file=sys.stderr, flush=True)
+        if plan.queue_flood_at is not None \
+                and tick >= plan.queue_flood_at \
+                and 'queue-flood' not in self._fired:
+            self._fired.add('queue-flood')
+            if not self.initial_specs:
+                # Every initial spec was rejected: nothing to clone.
+                # The flood degrades to a no-op instead of killing
+                # the scheduling loop with a bare max() error.
+                print('fleet chaos: queue-flood skipped — no valid '
+                      'initial spec to clone', file=sys.stderr,
+                      flush=True)
+                return
+            template = max(self.initial_specs,
+                           key=lambda s: s.priority)
+            for i in range(fleet_chaos.FLOOD_COPIES):
+                clone = JobSpec(
+                    name=f'{template.name}-flood{i}',
+                    argv=template.argv,
+                    priority=template.priority + 1,
+                    min_devices=template.min_devices,
+                    max_devices=template.max_devices,
+                    max_restarts=template.max_restarts,
+                    env=template.env,
+                    # Sustained arrival stream (see fleet.chaos:
+                    # FLOOD_SPACING_S) — a same-instant burst could
+                    # never be overtaken by uniform-rate aging.
+                    after_s=fleet_chaos.FLOOD_SPACING_S * i)
+                self.submit(clone)
+            print(f'fleet chaos: queue-flood — '
+                  f'{fleet_chaos.FLOOD_COPIES} priority-'
+                  f'{template.priority + 1} clones of '
+                  f'{template.name!r} arriving every '
+                  f'{fleet_chaos.FLOOD_SPACING_S}s from tick {tick}',
+                  file=sys.stderr, flush=True)
+        if plan.job_kill_at is not None \
+                and tick >= plan.job_kill_at \
+                and 'job-kill' not in self._fired:
+            # Deferred until a running job has heartbeated: the lease
+            # pid is how the fleet reaches a child it never spawned
+            # (the supervisor owns the Popen). The scan is filtered
+            # to the job's CURRENT incarnation — a dead child's
+            # lingering lease would otherwise name a stale pid — and
+            # the one-shot fault is only consumed by a SUCCESSFUL
+            # kill: a failed/raced kill retries next tick instead of
+            # silently spending the injection as a no-op.
+            for job in self.jobs:
+                if job.state != 'running' or job.sup is None:
+                    continue
+                leases, _ = hb_lib.scan_leases(
+                    job.sup.heartbeat_dir,
+                    incarnation=job.sup.launches - 1)
+                if not leases:
+                    continue
+                newest = max(leases.values(),
+                             key=lambda lease: lease['wall_time'])
+                try:
+                    os.kill(int(newest['pid']), signal.SIGKILL)
+                except (OSError, ValueError) as e:
+                    print(f'fleet chaos: job-kill failed ({e}) — '
+                          'retrying next tick', file=sys.stderr,
+                          flush=True)
+                    continue
+                self._fired.add('job-kill')
+                print(f'fleet chaos: job-kill — SIGKILL pid '
+                      f'{newest["pid"]} of job {job.spec.name!r} at '
+                      f'tick {tick}', file=sys.stderr, flush=True)
+                break
+
+    # -- placement ------------------------------------------------------
+
+    def _write_capacity(self, job: _Job, world: int) -> None:
+        with open(job.capacity_path, 'w') as f:
+            f.write(f'{world}\n')
+
+    def _start(self, job: _Job, world: int, now: float) -> None:
+        """Place one queued job: namespaced artifact tree, capacity
+        file seeded with the granted world, a fresh supervisor on its
+        own thread. The argv gains the per-job metrics path and the
+        spec's tuned artifact (``--tuned-config`` — fail-closed in
+        the child per the r12 contract) unless already present."""
+        spec = job.spec
+        job.jobdir = os.path.join(self.workdir, 'jobs', spec.name)
+        os.makedirs(job.jobdir, exist_ok=True)
+        job.capacity_path = os.path.join(job.jobdir, 'capacity')
+        argv = list(spec.argv)
+        if '--kfac-metrics' in argv[:-1]:
+            # The spec owns its metrics path: follow it — the gate
+            # verdict, the .supervisor sidecar placement and the
+            # straggler shards all key off the REAL stream, not the
+            # default namespace. (A trailing value-less flag falls
+            # through: the child CLI rejects it and the job fails
+            # visibly under its supervisor.)
+            job.metrics = argv[argv.index('--kfac-metrics') + 1]
+        else:
+            job.metrics = os.path.join(job.jobdir, 'metrics.jsonl')
+            argv += ['--kfac-metrics', job.metrics]
+        if spec.tuned_config and '--tuned-config' not in argv:
+            argv += ['--tuned-config', spec.tuned_config]
+        self._write_capacity(job, world)
+        opts = dict(self.sup_options)
+        job.sup = sup_lib.Supervisor(
+            argv, workdir=job.jobdir, instance=spec.name,
+            heartbeat_dir=os.path.join(job.jobdir, 'heartbeats'),
+            metrics_path=job.metrics,
+            extra_env=spec.env_dict(),
+            devices=spec.max_devices, start_devices=world,
+            min_devices=spec.min_devices,
+            capacity_file=job.capacity_path,
+            max_restarts=spec.max_restarts,
+            keep_faults=spec.keep_faults,
+            backoff=sup_lib.RestartBackoff(
+                base=self.backoff_base, cap=self.backoff_cap,
+                jitter=self.backoff_jitter,
+                # Per-job decorrelated stream, stable across requeues.
+                seed=zlib.crc32(spec.name.encode())),
+            clock=self._clock, sleep=self._sleep, **opts)
+        job.state = 'running'
+        job.assigned = world
+        first = job.admit_time is None
+        if first:
+            job.admit_time = now
+        job.thread = threading.Thread(
+            target=self._run_job, args=(job,),
+            name=f'fleet-{spec.name}', daemon=True)
+        job.thread.start()
+        self._event('fleet_admit', job=spec.name,
+                    priority=spec.priority, devices=world,
+                    queue_wait_s=round(now - job.eligible_at, 3),
+                    readmitted=not first)
+
+    @staticmethod
+    def _run_job(job: _Job) -> None:
+        try:
+            job.rc = job.sup.run(install_signals=False)
+        except BaseException as e:  # a dead watcher must still reap
+            job.rc = -1
+            job.error = f'{type(e).__name__}: {e}'
+
+    # -- reaping --------------------------------------------------------
+
+    def _slo(self, job: _Job, now: float) -> dict:
+        return {
+            'job': job.spec.name, 'rc': job.rc,
+            'devices': job.assigned,
+            'queue_wait_s': round(
+                (job.admit_time or now) - job.eligible_at, 3),
+            'run_s': round(now - (job.admit_time or now), 3),
+            'restarts': job.restarts_total,
+            'preemptions': job.preemptions,
+            'gate': self._gate_verdict(job),
+        }
+
+    def _gate_verdict(self, job: _Job) -> str | None:
+        """The job's final gate verdict against its spec's committed
+        baseline ('pass'/'fail'/'error'), or None when the spec names
+        no baseline. Read from the job's namespaced stream plus its
+        supervisor sidecar — the same merge the gate CLI does."""
+        if not job.spec.gate_baseline or not job.metrics:
+            return None
+        from distributed_kfac_pytorch_tpu.observability import (
+            gate as gate_lib,
+        )
+        from distributed_kfac_pytorch_tpu.observability.sink import (
+            SUPERVISOR_SIDECAR_SUFFIX,
+            read_jsonl_tolerant,
+        )
+        try:
+            records, _torn = read_jsonl_tolerant(job.metrics)
+            sidecar = job.metrics + SUPERVISOR_SIDECAR_SUFFIX
+            if os.path.exists(sidecar):
+                side, _torn = read_jsonl_tolerant(sidecar)
+                records = records + side
+            baseline = gate_lib.read_baseline(job.spec.gate_baseline)
+            current = gate_lib.gate_metrics(records)
+            breaches, _skipped = gate_lib.compare(
+                current, baseline['metrics'], allow_missing=True)
+            return 'fail' if breaches else 'pass'
+        except (OSError, ValueError):
+            return 'error'
+
+    def _reap(self, now: float) -> None:
+        for job in self.jobs:
+            if job.state not in ('running', 'stopping'):
+                continue
+            if job.thread is not None and job.thread.is_alive():
+                continue
+            if job.thread is not None:
+                job.thread.join()
+            if job.sup is not None:
+                job.restarts_total += job.sup.restarts
+            job.thread = None
+            job.sup = None
+            if job.state == 'stopping':
+                if job.rc == 0:
+                    # The child finished its last step and exited 0
+                    # while the drain was in flight: that is a
+                    # completion, not a preemption — requeueing would
+                    # re-run the whole job from its checkpoint.
+                    job.state = 'done'
+                    job.end_time = now
+                    self._event('fleet_complete',
+                                **self._slo(job, now))
+                    continue
+                if self._stop is None:
+                    # Fleet-initiated preempt-to-queue: the job
+                    # drained (checkpoint durable; any other exit in
+                    # the drain window — the relaunch code, a kill
+                    # escalation, even a crash racing the drain —
+                    # gets a fresh placement, where its own
+                    # supervisor's budgets re-apply) and waits for
+                    # capacity; its aging clock keeps running from
+                    # original eligibility.
+                    job.state = 'queued'
+                    job.assigned = 0
+                    continue
+                # The FLEET is shutting down (signal/deadline): the
+                # preempt-drain is terminal — fall through to the
+                # quarantine path so the job still gets its SLO row
+                # ('drained (fleet stopping)') instead of vanishing
+                # from the report as a forever-'queued' ghost.
+            job.end_time = now
+            if job.rc == 0:
+                job.state = 'done'
+                self._event('fleet_complete', **self._slo(job, now))
+                continue
+            job.state = 'quarantined'
+            if job.rc == sup_lib.RELAUNCH_EXIT_CODE \
+                    and self._stop is not None:
+                # A healthy job drained by fleet shutdown/deadline —
+                # not a job failure, but not a completion either.
+                reason = 'drained (fleet stopping)'
+            elif job.rc == sup_lib.CRASH_LOOP_EXIT:
+                reason = 'crash_loop'
+            elif job.rc == sup_lib.EXHAUSTED_EXIT:
+                reason = 'restart_budget_exhausted'
+            elif job.error:
+                reason = f'supervisor error: {job.error}'
+            else:
+                reason = f'failed rc {job.rc}'
+            diag = (os.path.join(job.jobdir, sup_lib.DIAGNOSTIC_NAME)
+                    if job.jobdir else None)
+            if diag is None or not os.path.exists(diag):
+                diag = None
+            self._event('fleet_quarantine', reason=reason,
+                        diagnostic=diag, **self._slo(job, now))
+
+    # -- allocation -----------------------------------------------------
+
+    def _effective_priority(self, job: _Job, now: float) -> float:
+        eff = float(job.spec.priority)
+        if job.state == 'queued' and self.aging_secs > 0:
+            eff += max(0.0, now - job.eligible_at) / self.aging_secs
+        return eff
+
+    def _allocate(self, pool: int, now: float) -> None:
+        """The waterfill pass: recompute every placement against the
+        current pool and commit the diff (capacity-file writes,
+        supervisor starts, preempt-to-queue stops).
+
+        Running jobs are served their ``min_devices`` FIRST: an
+        arriving higher-priority job can *shrink* incumbents down to
+        their minimum (drain -> smaller world through the capacity
+        channel) but never evict one outright — eviction back to the
+        queue happens only when the POOL itself no longer covers the
+        running mix's minimum (pool loss). Without that tier the
+        allocator livelocks: a queued job that outranks a running one
+        evicts it, the evictee requeues and ages, out-ranks its
+        replacement, evicts it back — an endless drain/relaunch
+        ping-pong in which nobody finishes (regression-pinned by the
+        queue-flood aging test's preemption count)."""
+        running = [j for j in self.jobs if j.state == 'running']
+        queued = [j for j in self.jobs
+                  if j.state == 'queued' and now >= j.eligible_at]
+        order = sorted(
+            running + queued,
+            key=lambda j: (-self._effective_priority(j, now),
+                           0 if j.state == 'running' else 1, j.seq))
+        assign: dict[_Job, int] = {}
+        rem = pool
+        for tier_state in ('running', 'queued'):
+            for j in order:
+                if j.state != tier_state:
+                    continue
+                take = (j.spec.min_devices
+                        if rem >= j.spec.min_devices else 0)
+                assign[j] = take
+                rem -= take
+        for j in order:
+            if assign[j]:
+                extra = min(j.spec.max_devices - assign[j], rem)
+                assign[j] += extra
+                rem -= extra
+        pool_shrank = pool < self._last_pool
+        self._last_pool = pool
+        shrink_reason = 'pool-loss' if pool_shrank else 'admission'
+        for j in running:
+            a = assign[j]
+            if a == 0:
+                # Not even min_devices fits: preempt back to the
+                # queue via a graceful drain (checkpoint durable; the
+                # job resumes whenever capacity returns).
+                j.preemptions += 1
+                self._event('fleet_preempt', job=j.spec.name,
+                            from_devices=j.assigned, to_devices=0,
+                            reason=shrink_reason, requeued=True)
+                j.state = 'stopping'
+                j.sup.request_stop('fleet preempt')
+            elif a < j.assigned:
+                j.preemptions += 1
+                self._event('fleet_preempt', job=j.spec.name,
+                            from_devices=j.assigned, to_devices=a,
+                            reason=shrink_reason, requeued=False)
+                self._write_capacity(j, a)
+                j.assigned = a
+            elif a > j.assigned:
+                self._event('fleet_regrow', job=j.spec.name,
+                            from_devices=j.assigned, to_devices=a,
+                            reason='capacity')
+                self._write_capacity(j, a)
+                j.assigned = a
+        for j in order:
+            if j.state == 'queued' and assign.get(j, 0) \
+                    >= j.spec.min_devices:
+                self._start(j, assign[j], now)
+
+    # -- the loop -------------------------------------------------------
+
+    def _install_signals(self) -> None:
+        def handler(signum, frame):
+            self._stop = f'signal {signal.Signals(signum).name}'
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, handler)
+
+    def request_stop(self, reason: str = 'stop requested') -> None:
+        self._stop = str(reason)
+
+    def run(self, install_signals: bool = True,
+            deadline_s: float | None = None) -> int:
+        """Schedule until every job reaches a terminal state (done or
+        quarantined). Returns the fleet exit code (module docstring).
+        ``deadline_s`` bounds the whole run — the fleet-level hang
+        backstop; on expiry every job is drained and
+        :data:`DEADLINE_EXIT` returned."""
+        if install_signals:
+            self._install_signals()
+        try:
+            return self._run(deadline_s)
+        finally:
+            self.events.close()
+
+    def _shutdown(self, reason: str) -> None:
+        print(f'fleet: {reason} — draining every running job',
+              file=sys.stderr, flush=True)
+        for job in self.jobs:
+            if job.state in ('running', 'stopping') \
+                    and job.sup is not None:
+                job.sup.request_stop(reason)
+        for job in self.jobs:
+            if job.thread is not None:
+                job.thread.join()
+
+    def _run(self, deadline_s: float | None) -> int:
+        start = self._clock()
+        # Rejected specs fail closed with exactly one quarantine event
+        # each (the r12 tuned-config contract, one level up): the
+        # fleet schedules the valid jobs and the record shows why the
+        # rest never ran.
+        for label, error in self.rejects:
+            self._event('fleet_quarantine', job=str(label),
+                        reason='jobspec rejected (fail-closed)',
+                        error=str(error)[:300], rc=None, devices=0,
+                        queue_wait_s=0.0, run_s=0.0, restarts=0,
+                        preemptions=0, gate=None, diagnostic=None)
+        for job in list(self.jobs):
+            if job.spec.min_devices > self.pool_devices:
+                job.state = 'quarantined'
+                self._event(
+                    'fleet_quarantine', job=job.spec.name,
+                    reason=f'unsatisfiable: min_devices '
+                           f'{job.spec.min_devices} exceeds the pool '
+                           f'({self.pool_devices})',
+                    rc=None, devices=0, queue_wait_s=0.0, run_s=0.0,
+                    restarts=0, preemptions=0, gate=None,
+                    diagnostic=None)
+        tick = 0
+        while True:
+            now = self._clock()
+            if self._stop is not None:
+                self._shutdown(self._stop)
+                self._reap(self._clock())
+                return 1
+            if deadline_s is not None and now - start > deadline_s:
+                self._stop = f'deadline {deadline_s}s exceeded'
+                self._shutdown(self._stop)
+                self._reap(self._clock())
+                return DEADLINE_EXIT
+            self._fire_chaos(tick)
+            pool = self._pool_capacity()
+            self._reap(now)
+            if not any(j.state in ('queued', 'running', 'stopping')
+                       for j in self.jobs):
+                break
+            self._allocate(pool, now)
+            tick += 1
+            self._sleep(self.poll_secs)
+        failed = [j.spec.name for j in self.jobs
+                  if j.state != 'done'] + \
+                 [label for label, _ in self.rejects]
+        if failed:
+            print(f'fleet: finished with {len(failed)} quarantined/'
+                  f'failed job(s): {sorted(failed)}',
+                  file=sys.stderr, flush=True)
+            return 1
+        return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog='python -m distributed_kfac_pytorch_tpu.fleet',
+        description='Multi-job fleet scheduler over one device pool: '
+                    'priority admission with aging, preempt-by-shrink '
+                    'and regrow through per-job capacity files, '
+                    'crash-loop isolation, per-job SLO events. Exit: '
+                    '0 = all jobs completed, 1 = some quarantined/'
+                    'failed, 2 = usage, '
+                    f'{DEADLINE_EXIT} = deadline exceeded.')
+    p.add_argument('jobs', help='jobs file (JSON; see README "Fleet '
+                                'scheduling" for the JobSpec schema)')
+    p.add_argument('--pool-devices', type=int, required=True,
+                   metavar='N',
+                   help='device capacity of the pool (worlds ride the '
+                        'XLA_FLAGS host-platform device count — the '
+                        'CPU-testable model of a real resource '
+                        "manager's allocation)")
+    p.add_argument('--workdir', default='./fleet',
+                   help='fleet state dir: fleet.jsonl event stream + '
+                        'per-job artifact trees under jobs/<name>/')
+    p.add_argument('--capacity-file', default=None, metavar='PATH',
+                   help='file holding the pool\'s live device count '
+                        '(capped at --pool-devices); torn reads keep '
+                        'the last known pool with one '
+                        'capacity_degraded event per episode')
+    p.add_argument('--poll', type=float, default=0.5, metavar='S',
+                   help='scheduler tick interval')
+    p.add_argument('--aging-secs', type=float, default=30.0,
+                   metavar='S',
+                   help='a queued job gains one effective priority '
+                        'point per S seconds of waiting (starvation-'
+                        'freedom under sustained high-priority '
+                        'arrivals; 0 = no aging)')
+    p.add_argument('--deadline', type=float, default=0.0, metavar='S',
+                   help='drain everything and exit '
+                        f'{DEADLINE_EXIT} after S seconds '
+                        '(0 = no deadline)')
+    p.add_argument('--hang-timeout', type=float, default=300.0,
+                   metavar='S', help='per-job supervisor hang timeout')
+    p.add_argument('--startup-grace', type=float, default=900.0,
+                   metavar='S')
+    p.add_argument('--failover-grace', type=float, default=0.0,
+                   metavar='S')
+    p.add_argument('--job-poll', type=float, default=0.5, metavar='S',
+                   help='per-job supervisor lease/capacity poll')
+    p.add_argument('--drain-grace', type=float, default=300.0,
+                   metavar='S')
+    p.add_argument('--term-grace', type=float, default=10.0,
+                   metavar='S')
+    p.add_argument('--crash-loop-after', type=int, default=3,
+                   metavar='K')
+    p.add_argument('--backoff', type=float, default=1.0, metavar='S')
+    p.add_argument('--backoff-cap', type=float, default=60.0,
+                   metavar='S')
+    p.add_argument('--backoff-jitter', type=float, default=0.5,
+                   metavar='F')
+    args = p.parse_args(argv)
+    try:
+        specs, rejects = load_jobs(args.jobs)
+        plan = fleet_chaos.plan_from_env()
+    except ValueError as e:
+        print(f'error: {e}', file=sys.stderr)
+        return 2
+    if not specs and not rejects:
+        print(f'error: jobs file {args.jobs} names no jobs',
+              file=sys.stderr)
+        return 2
+    fleet = FleetScheduler(
+        specs, rejects=rejects, pool_devices=args.pool_devices,
+        workdir=args.workdir, poll_secs=args.poll,
+        aging_secs=args.aging_secs, capacity_file=args.capacity_file,
+        plan=plan,
+        sup_options=dict(hang_timeout=args.hang_timeout,
+                         startup_grace=args.startup_grace,
+                         failover_grace=args.failover_grace,
+                         poll_secs=args.job_poll,
+                         drain_grace=args.drain_grace,
+                         term_grace=args.term_grace,
+                         crash_loop_after=args.crash_loop_after),
+        backoff_base=args.backoff, backoff_cap=args.backoff_cap,
+        backoff_jitter=args.backoff_jitter)
+    return fleet.run(deadline_s=args.deadline or None)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

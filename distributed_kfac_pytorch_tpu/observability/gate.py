@@ -26,6 +26,10 @@ vector —
     the supervisor (``supervisor_restart`` events, merged from the
     ``<jsonl>.supervisor`` sidecar) — same recovered-but-regressed
     logic one process level up.
+  - ``fleet_quarantines`` (r18): quarantined-job count from the fleet
+    scheduler's event stream (``fleet_quarantine`` events) — the same
+    logic one level up again: the pool stayed healthy, but a job mix
+    that quarantined a member regressed against one that ran clean.
 
 — and compares it against a committed baseline with per-metric
 relative tolerances, exiting non-zero on any breach so CI can block
@@ -80,9 +84,16 @@ DEFAULT_TOLERANCES = {
     # supervisor_restart events (the <jsonl>.supervisor sidecar is
     # merged by main(); inline events count too).
     'supervisor_restarts': 0.0,
+    # r18 fleet: quarantined jobs (crash loops, exhausted budgets,
+    # rejected specs) are the fleet-level recovered-but-regressed
+    # signal — the pool stayed healthy, but a job mix that quarantined
+    # one regressed against a baseline mix that ran clean. Counted
+    # from fleet_quarantine events when the gate is pointed at a fleet
+    # scheduler's event stream (absolute count, like retraces).
+    'fleet_quarantines': 0.0,
 }
 _ABSOLUTE_METRICS = ('retraces', 'selfheal_rollbacks',
-                     'supervisor_restarts')
+                     'supervisor_restarts', 'fleet_quarantines')
 
 
 def gate_metrics(records: list[dict]) -> dict:
@@ -101,6 +112,9 @@ def gate_metrics(records: list[dict]) -> dict:
     sup_restarts = sum(1 for r in records
                        if r.get('kind') == 'event'
                        and r.get('event') == 'supervisor_restart')
+    fleet_q = sum(1 for r in records
+                  if r.get('kind') == 'event'
+                  and r.get('event') == 'fleet_quarantine')
     out = {
         'n_steps': dist['n_steps'] if dist else 0,
         'step_p50_ms': dist['p50_ms'] if dist else None,
@@ -111,6 +125,7 @@ def gate_metrics(records: list[dict]) -> dict:
         'retraces': retraces,
         'selfheal_rollbacks': rollbacks,
         'supervisor_restarts': sup_restarts,
+        'fleet_quarantines': fleet_q,
     }
     for k, v in out.items():
         if isinstance(v, float) and not math.isfinite(v):

@@ -33,7 +33,14 @@ Prints, from the recorded stream alone (no live process needed):
     restarts, hang detections, survivor-mesh failovers/grow-backs,
     crash loops — merged from the ``run.jsonl.supervisor`` sidecar
     the supervisor writes next to the stream
-    (``resilience.supervisor``).
+    (``resilience.supervisor``);
+  - fleet scheduling (r18): when pointed at a fleet scheduler's own
+    event stream (``<fleet-workdir>/fleet.jsonl``), the scheduler's
+    decision counts (admits, preempts/regrows, quarantines) plus one
+    SLO row per finished job — queue wait, run time, restarts,
+    preemption count, final gate verdict — carried by its
+    ``fleet_complete``/``fleet_quarantine`` events
+    (``fleet.scheduler``).
 
 A torn/truncated FINAL line (a host crashed mid-append) is skipped and
 counted in the header instead of refusing the stream; torn lines
@@ -124,7 +131,22 @@ def step_time_distribution(records: list[dict]) -> dict | None:
 # ``summarize`` also picks up any supervision events recorded inline.
 _SUPERVISION_KINDS = ('supervisor_restart', 'supervisor_failover',
                       'supervisor_growback', 'hang_detected',
-                      'crash_loop')
+                      'crash_loop', 'capacity_degraded')
+
+# The fleet scheduler's event vocabulary (registered in
+# sink.EVENT_KINDS). Fleet events live in the fleet's OWN stream
+# (``<fleet-workdir>/fleet.jsonl`` — the scheduler outlives every job
+# it packs); pointing this report at that stream renders the fleet
+# section with one SLO row per job, built from the data each
+# fleet_complete / fleet_quarantine event carries.
+_FLEET_KINDS = ('fleet_admit', 'fleet_preempt', 'fleet_regrow',
+                'fleet_quarantine', 'fleet_complete')
+
+#: The per-job SLO row keys a fleet_complete / fleet_quarantine event
+#: contributes to the report's ``fleet.jobs`` table (pinned by
+#: tests/test_fleet.py — the --json consumer's contract).
+FLEET_SLO_KEYS = ('outcome', 'rc', 'devices', 'queue_wait_s', 'run_s',
+                  'restarts', 'preemptions', 'gate', 'reason')
 
 
 def _series(records, key):
@@ -267,6 +289,36 @@ def summarize(records: list[dict],
             'crash_loops': count('crash_loop'),
         }
 
+    # Fleet scheduling (r18): per-job SLO rows plus scheduler decision
+    # counts. The terminal events (fleet_complete / fleet_quarantine)
+    # carry each job's SLO data, so the table needs no second stream;
+    # same newest-window cap discipline for the event detail list.
+    fleet_events = [{'event': r['event'], **dict(r.get('data', {}))}
+                    for r in events if r['event'] in _FLEET_KINDS]
+    fleet = None
+    if fleet_events:
+        count = lambda kind: sum(1 for e in fleet_events
+                                 if e['event'] == kind)
+        jobs: dict[str, dict] = {}
+        for e in fleet_events:
+            if e['event'] not in ('fleet_complete', 'fleet_quarantine'):
+                continue
+            row = {k: e.get(k) for k in FLEET_SLO_KEYS}
+            row['outcome'] = ('complete'
+                              if e['event'] == 'fleet_complete'
+                              else 'quarantined')
+            jobs[str(e.get('job'))] = row
+        fleet = {
+            'n_events': len(fleet_events),
+            'events': fleet_events[-50:],
+            'admits': count('fleet_admit'),
+            'preempts': count('fleet_preempt'),
+            'regrows': count('fleet_regrow'),
+            'quarantines': count('fleet_quarantine'),
+            'completes': count('fleet_complete'),
+            'jobs': jobs,
+        }
+
     autotune_events = [{'event': r['event'], **dict(r.get('data', {}))}
                        for r in events
                        if r['event'].startswith('autotune')]
@@ -291,6 +343,7 @@ def summarize(records: list[dict],
         'autotune': autotune,
         'selfheal': selfheal,
         'supervision': supervision,
+        'fleet': fleet,
         'memory': memory,
         'compiles': compiles,
         'retraces': retraces,
@@ -488,6 +541,24 @@ def print_report(s: dict, out=None, torn: int = 0,
               f"{_fmt(float('nan') if mean_skew is None else mean_skew, ' ms')}"
               f"  max "
               f"{_fmt(float('nan') if max_skew is None else max_skew, ' ms')}")
+    if s.get('fleet'):
+        fl = s['fleet']
+        w()
+        w(f"-- fleet ({fl['n_events']} scheduler event(s), "
+          f"{len(fl['jobs'])} finished job(s)) --")
+        w(f"admits: {fl['admits']}   preempts: {fl['preempts']} / "
+          f"regrows: {fl['regrows']}   completes: {fl['completes']}   "
+          f"quarantines: {fl['quarantines']}")
+        for name in sorted(fl['jobs']):
+            row = fl['jobs'][name]
+            gate_note = ('' if row.get('gate') is None
+                         else f"  gate {row['gate']}")
+            w(f"  {name:<20} {row['outcome']:<12} rc {row['rc']}  "
+              f"wait {_fmt(_num(row['queue_wait_s']), ' s')}  "
+              f"run {_fmt(_num(row['run_s']), ' s')}  "
+              f"restarts {row['restarts']}  "
+              f"preemptions {row['preemptions']}{gate_note}")
+        _print_event_detail(w, fl['events'], fl['n_events'])
     if s.get('supervision'):
         sup = s['supervision']
         w()
@@ -522,6 +593,7 @@ def print_report(s: dict, out=None, torn: int = 0,
                     if k not in ('compile', 'retrace',
                                  'ckpt_quarantine')
                     and k not in _SUPERVISION_KINDS
+                    and k not in _FLEET_KINDS
                     and not k.startswith('autotune')
                     and not k.startswith('selfheal')}
     if resil_counts:
@@ -584,6 +656,7 @@ def summary_json(s: dict, *, torn: int = 0,
         'autotune': s['autotune'],
         'selfheal': s['selfheal'],
         'supervision': s['supervision'],
+        'fleet': s['fleet'],
         'event_counts': s['event_counts'],
         'kfac': {
             'factor_updates': s['factor_updates'],

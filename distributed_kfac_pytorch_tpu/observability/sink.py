@@ -111,6 +111,23 @@ EVENT_KINDS = (
     'hang_detected',        # heartbeat leases expired; child killed
     'crash_loop',           # same step failed K consecutive launches;
                             # diagnostic bundle written, distinct exit
+    'capacity_degraded',    # capacity file torn/unreadable mid-write:
+                            # last known target kept, one warning per
+                            # degradation episode (r18)
+    # r18 fleet scheduler (fleet.scheduler; README "Fleet scheduling"
+    # — written to the fleet's own <workdir>/fleet.jsonl stream, which
+    # the report's fleet section and the gate's fleet_quarantines
+    # metric consume):
+    'fleet_admit',          # a queued job was placed on the pool
+    'fleet_preempt',        # a running job's world shrank (urgent
+                            # admission or pool capacity loss)
+    'fleet_regrow',         # freed capacity grew a shrunken job back
+    'fleet_quarantine',     # a job was isolated (crash loop / budget
+                            # exhaustion / rejected spec) — the fleet
+                            # keeps scheduling the rest
+    'fleet_complete',       # a job ran to completion; data carries
+                            # its SLO row (queue wait, run time,
+                            # restarts, preemptions, gate verdict)
 )
 # Dead incarnations kept per metrics path (<path>.prev.1 newest ..
 # .prev.N oldest); older ones are pruned on relaunch.

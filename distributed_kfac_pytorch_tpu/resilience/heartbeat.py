@@ -111,13 +111,20 @@ def lease_age(lease: dict, now: float | None = None) -> float:
     return max(0.0, now - float(lease['wall_time']))
 
 
-def scan_leases(directory: str
+def scan_leases(directory: str, incarnation: int | None = None
                 ) -> tuple[dict[int, dict], dict[str, str]]:
     """All readable leases in ``directory`` plus per-file errors.
 
     Returns ``({rank: lease}, {filename: error})`` — an unreadable
     lease degrades to an error entry instead of failing the scan (one
     sick rank must not blind the watcher to the rest of the mesh).
+
+    ``incarnation``: when given, only leases stamped with that launch
+    incarnation count as live; a mixed-incarnation lease — left behind
+    by an earlier launch, or by a quarantined job that shared the
+    directory — degrades to an error entry instead of masquerading as
+    a live rank (its stale timestamp would otherwise fire an instant
+    false hang/dead-rank verdict; r18 satellite).
     """
     leases: dict[int, dict] = {}
     errors: dict[str, str] = {}
@@ -137,8 +144,24 @@ def scan_leases(directory: str
         except ValueError as e:
             errors[name] = str(e)
             continue
-        if lease is not None:
-            leases[rank] = lease
+        if lease is None:
+            continue
+        if incarnation is not None:
+            try:
+                inc = int(lease.get('incarnation', 0))
+            except (TypeError, ValueError):
+                # A corrupt/foreign incarnation field degrades like
+                # any other unreadable lease — one sick rank must not
+                # crash the watcher.
+                errors[name] = (f'bad incarnation field '
+                                f'{lease.get("incarnation")!r}')
+                continue
+            if inc != int(incarnation):
+                errors[name] = (f'stale incarnation {inc} '
+                                f'(watching incarnation '
+                                f'{incarnation})')
+                continue
+        leases[rank] = lease
     return leases, errors
 
 

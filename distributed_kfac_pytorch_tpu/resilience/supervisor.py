@@ -67,16 +67,27 @@ Supervisor decisions are durable: every event
 ``supervisor_growback`` / ``hang_detected`` / ``crash_loop`` — all
 registered in ``sink.EVENT_KINDS``) is written to a sidecar JSONL
 (default ``<metrics>.supervisor`` next to the child's ``--kfac-metrics``
-stream when ``--metrics`` is given, else ``<workdir>/supervisor.jsonl``)
-that ``observability.report`` merges into its supervision section and
+stream when ``--metrics`` is given, else
+``<workdir>/supervisor.<instance>.jsonl``) that
+``observability.report`` merges into its supervision section and
 ``observability.gate`` reads for the ``supervisor_restarts`` metric.
+
+Default artifact paths are namespaced per supervisor *instance* (a
+pid-unique token, or ``--instance NAME``): the heartbeat lease
+subdirectory, the workdir event stream and the drain sentinel all
+carry the token, so several concurrent supervisors — the fleet
+scheduler (``distributed_kfac_pytorch_tpu.fleet``) runs one per job —
+can share one scratch directory without mixing leases or interleaving
+streams (r18 satellite).
 """
 
 from __future__ import annotations
 
 import argparse
+import itertools
 import json
 import os
+import random
 import signal
 import subprocess
 import sys
@@ -102,25 +113,47 @@ CRASH_LOOP_EXIT = 77
 
 DIAGNOSTIC_NAME = 'crash_loop_diagnostic.json'
 
+#: Per-process supervisor counter: combined with the pid it tokens the
+#: default artifact namespace (heartbeat subdirectory, event-stream
+#: and drain-sentinel names) so concurrent supervisors — separate
+#: processes OR several in one fleet process — sharing a scratch
+#: workdir cannot collide.
+_INSTANCES = itertools.count(1)
+
 
 class RestartBackoff:
-    """Exponential relaunch backoff with a cap.
+    """Exponential relaunch backoff with a cap and decorrelation jitter.
 
-    ``next_delay()`` returns 0, base, base*factor, ... capped at
-    ``cap`` (the first restart after a healthy stretch is free — the
-    checkpoint is fresh and most faults are transient); ``reset()``
-    re-arms after progress.
+    ``next_delay()`` returns 0, then the exponential schedule
+    base, base*factor, ... capped at ``cap`` (the first restart after a
+    healthy stretch is free — the checkpoint is fresh and most faults
+    are transient); ``reset()`` re-arms after progress.
+
+    Each nonzero delay is drawn uniformly from
+    ``[d*(1-jitter), d]`` (``d`` = the deterministic schedule value):
+    a pool-wide fault that kills many supervised jobs at once would
+    otherwise relaunch them all on the SAME schedule and thundering-
+    herd the pool every base*factor^n seconds forever (r18 satellite).
+    ``jitter=0`` restores the deterministic schedule; ``seed`` makes
+    the draw reproducible for tests (and lets a fleet give every job
+    its own decorrelated stream).
     """
 
     def __init__(self, base: float = 1.0, factor: float = 2.0,
-                 cap: float = 60.0):
+                 cap: float = 60.0, jitter: float = 0.5,
+                 seed: int | None = None):
         if base < 0 or factor < 1.0 or cap < 0:
             raise ValueError(
                 f'bad backoff ({base=}, {factor=}, {cap=}): need '
                 'base >= 0, factor >= 1, cap >= 0')
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError(f'backoff jitter must be in [0, 1], '
+                             f'got {jitter}')
         self.base = float(base)
         self.factor = float(factor)
         self.cap = float(cap)
+        self.jitter = float(jitter)
+        self._rng = random.Random(seed)
         self._failures = 0
 
     def next_delay(self) -> float:
@@ -128,10 +161,51 @@ class RestartBackoff:
         self._failures += 1
         if n == 0:
             return 0.0
-        return min(self.cap, self.base * self.factor ** (n - 1))
+        d = min(self.cap, self.base * self.factor ** (n - 1))
+        if self.jitter and d > 0:
+            d *= 1.0 - self.jitter * self._rng.random()
+        return d
 
     def reset(self) -> None:
         self._failures = 0
+
+
+class CapacityFile:
+    """Torn-read-tolerant poll of a resource manager's capacity file.
+
+    The file is a plain overwrite (not an atomic rename), so a poll
+    can catch it mid-write: empty, truncated, or non-integer.
+    ``read()`` returns ``(value, error)`` — ``value`` is the newest
+    good integer, or the LAST known one while degraded (None before
+    any good read, and while the file simply does not exist yet);
+    ``error`` is the exception string exactly ONCE at the start of
+    each degradation episode (the caller emits one
+    ``capacity_degraded`` event per episode, never per poll). Shared
+    by the supervisor's per-job channel and the fleet scheduler's
+    pool view so the degradation protocol cannot fork (r18).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self.last: int | None = None
+        self._degraded = False
+
+    def read(self) -> tuple[int | None, str | None]:
+        try:
+            with open(self.path) as f:
+                value = int(f.read().strip())
+        except FileNotFoundError:
+            # Absence is not degradation: capacity tracking may not
+            # have started yet (and must not trigger a resize).
+            return self.last, None
+        except (OSError, ValueError) as e:
+            if self._degraded:
+                return self.last, None
+            self._degraded = True
+            return self.last, str(e)
+        self._degraded = False
+        self.last = value
+        return value, None
 
 
 class CrashLoopDetector:
@@ -246,9 +320,11 @@ class Supervisor:
     """
 
     def __init__(self, cmd: list[str], *, workdir: str,
+                 instance: str | None = None,
                  heartbeat_dir: str | None = None,
                  events_path: str | None = None,
                  metrics_path: str | None = None,
+                 extra_env: dict | None = None,
                  devices: int | None = None,
                  start_devices: int | None = None,
                  min_devices: int = 1,
@@ -280,10 +356,23 @@ class Supervisor:
             raise ValueError(f'{devices=} below {min_devices=}')
         self.cmd = list(cmd)
         self.workdir = os.path.abspath(workdir)
+        # Per-launch artifact namespace (r18 satellite): two concurrent
+        # supervisors pointed at ONE scratch workdir (a fleet packing
+        # several jobs onto a shared filesystem) must not mix heartbeat
+        # leases — each other's ranks would read as a dead subset — or
+        # clobber each other's event stream (the sink's atomic rewrite
+        # is last-writer-wins on a shared path). Defaults therefore
+        # land under a unique per-supervisor token; explicit
+        # --heartbeat-dir / --events / --metrics paths are honored
+        # verbatim (the <metrics>.supervisor sidecar convention the
+        # report/gate readers rely on is unchanged).
+        self.instance = (str(instance) if instance
+                         else f'{os.getpid()}.{next(_INSTANCES)}')
         self.heartbeat_dir = (os.path.abspath(heartbeat_dir)
                               if heartbeat_dir
                               else os.path.join(self.workdir,
-                                                'heartbeats'))
+                                                'heartbeats',
+                                                self.instance))
         from distributed_kfac_pytorch_tpu.observability.sink import (
             SUPERVISOR_SIDECAR_SUFFIX,
         )
@@ -291,9 +380,13 @@ class Supervisor:
         if events_path is None:
             events_path = (metrics_path + SUPERVISOR_SIDECAR_SUFFIX
                            if metrics_path
-                           else os.path.join(self.workdir,
-                                             'supervisor.jsonl'))
-        self.sentinel = os.path.join(self.workdir, 'drain.sentinel')
+                           else os.path.join(
+                               self.workdir,
+                               f'supervisor.{self.instance}.jsonl'))
+        self.events_path = events_path
+        self.extra_env = dict(extra_env or {})
+        self.sentinel = os.path.join(
+            self.workdir, f'drain.{self.instance}.sentinel')
         self.devices = devices
         self.world = (start_devices if start_devices is not None
                       else devices)
@@ -318,6 +411,8 @@ class Supervisor:
         self._stop: str | None = None
         self._straggler_handled: set[int] = set()
         self._next_straggler_check = 0.0
+        self._capacity = (CapacityFile(capacity_file)
+                          if capacity_file else None)
         os.makedirs(self.workdir, exist_ok=True)
         os.makedirs(self.heartbeat_dir, exist_ok=True)
         from distributed_kfac_pytorch_tpu.observability.sink import (
@@ -343,6 +438,11 @@ class Supervisor:
 
     def _child_env(self) -> dict:
         env = dict(os.environ)
+        # Per-job overrides (the fleet's KFAC_CHAOS / tuned paths ride
+        # here): merged BEFORE the one-shot fault clearing below so an
+        # injected fault spec obeys the same relaunch discipline.
+        env.update({str(k): str(v)
+                    for k, v in self.extra_env.items()})
         env[hb_lib.ENV_DIR] = self.heartbeat_dir
         env[hb_lib.ENV_INCARNATION] = str(self.launches)
         env['KFAC_PREEMPT_FILE'] = self.sentinel
@@ -402,14 +502,21 @@ class Supervisor:
     def _capacity_target(self) -> int | None:
         """The world size the capacity file currently allows (clamped
         to [min_devices, devices]), or None when capacity tracking is
-        off / the file is absent or unreadable (an unreadable resource
-        view must not trigger a resize)."""
-        if self.capacity_file is None or self.devices is None:
+        off / the file has never been readable.
+
+        A torn/empty/non-integer read keeps the LAST known target and
+        emits exactly one ``capacity_degraded`` warning event per
+        degradation episode (:class:`CapacityFile`): a momentarily
+        unreadable resource view must neither crash the supervision
+        loop nor trigger a spurious resize (r18 satellite;
+        regression-pinned with a mid-write truncated file)."""
+        if self._capacity is None or self.devices is None:
             return None
-        try:
-            with open(self.capacity_file) as f:
-                cap = int(f.read().strip())
-        except (OSError, ValueError):
+        cap, error = self._capacity.read()
+        if error is not None:
+            self._event('capacity_degraded', path=self.capacity_file,
+                        error=error, last_target=cap)
+        if cap is None:
             return None
         return max(self.min_devices, min(self.devices, cap))
 
@@ -455,7 +562,13 @@ class Supervisor:
             if self._stop is not None:
                 return ('stop', self._stop)
             now = self._clock()
-            leases, _errors = hb_lib.scan_leases(self.heartbeat_dir)
+            # Incarnation-filtered: a lease left behind by an earlier
+            # incarnation (or a quarantined job that shared the dir)
+            # is that run's last words, not a live rank — counting it
+            # here would fire an instant false hang/dead-rank verdict
+            # on its stale timestamp.
+            leases, _errors = hb_lib.scan_leases(
+                self.heartbeat_dir, incarnation=self.launches - 1)
             if leases:
                 ages = {r: hb_lib.lease_age(lease, now)
                         for r, lease in leases.items()}
@@ -581,15 +694,28 @@ class Supervisor:
         for sig in (signal.SIGTERM, signal.SIGINT):
             signal.signal(sig, handler)
 
-    def run(self) -> int:
+    def run(self, install_signals: bool = True) -> int:
         """Supervise until the command succeeds, the budget runs out,
         a crash loop is detected, or the supervisor is told to stop.
-        Returns the process exit code."""
-        self._install_signals()
+        Returns the process exit code.
+
+        ``install_signals=False`` skips the SIGTERM/SIGINT handlers —
+        required when the supervisor runs off the main thread (the
+        fleet scheduler runs one per job); the embedding process owns
+        the signals and requests a stop by setting ``request_stop``.
+        """
+        if install_signals:
+            self._install_signals()
         try:
             return self._run()
         finally:
             self.events.close()
+
+    def request_stop(self, reason: str = 'stop requested') -> None:
+        """Ask the supervision loop to drain the child and return
+        (thread-safe: the watcher polls the flag every ``poll_secs``).
+        The fleet's preempt-to-queue and shutdown paths use this."""
+        self._stop = str(reason)
 
     def _run(self) -> int:
         while True:
@@ -726,13 +852,21 @@ def main(argv=None) -> int:
     p.add_argument('--workdir', default='./supervisor',
                    help='supervisor state dir (heartbeat leases, drain '
                         'sentinel, event stream, crash-loop diagnostic)')
+    p.add_argument('--instance', default=None, metavar='NAME',
+                   help='artifact namespace token for the default '
+                        'heartbeat subdirectory / event stream / drain '
+                        'sentinel (default: a pid-unique token, so '
+                        'concurrent supervisors sharing a workdir '
+                        'cannot mix leases or clobber streams; set a '
+                        'stable name for predictable paths)')
     p.add_argument('--heartbeat-dir', default=None,
-                   help='lease directory (default <workdir>/heartbeats;'
-                        ' exported to the child as KFAC_HEARTBEAT_DIR)')
+                   help='lease directory (default <workdir>/heartbeats/'
+                        '<instance>; exported to the child as '
+                        'KFAC_HEARTBEAT_DIR)')
     p.add_argument('--events', default=None, metavar='PATH',
                    help='supervisor event JSONL (default '
                         '<metrics>.supervisor when --metrics is given, '
-                        'else <workdir>/supervisor.jsonl)')
+                        'else <workdir>/supervisor.<instance>.jsonl)')
     p.add_argument('--metrics', default=None, metavar='PATH',
                    help="the child's --kfac-metrics path: names the "
                         'event sidecar the report/gate merge, and '
@@ -776,6 +910,13 @@ def main(argv=None) -> int:
                         'relaunches (0, S, 2S, 4S, ... capped)')
     p.add_argument('--backoff-cap', type=float, default=60.0,
                    metavar='S')
+    p.add_argument('--backoff-jitter', type=float, default=0.5,
+                   metavar='F',
+                   help='decorrelation jitter fraction in [0, 1]: each '
+                        'nonzero delay is drawn uniformly from '
+                        '[d*(1-F), d] so many jobs relaunching after a '
+                        'pool-wide fault do not thundering-herd on the '
+                        'same schedule (0 = deterministic)')
     p.add_argument('--poll', type=float, default=0.5, metavar='S',
                    help='lease/capacity poll interval')
     p.add_argument('--drain-grace', type=float, default=300.0,
@@ -819,7 +960,8 @@ def main(argv=None) -> int:
     if not cmd:
         p.error('no command given (append: -- python examples/...)')
     sup = Supervisor(
-        cmd, workdir=args.workdir, heartbeat_dir=args.heartbeat_dir,
+        cmd, workdir=args.workdir, instance=args.instance,
+        heartbeat_dir=args.heartbeat_dir,
         events_path=args.events, metrics_path=args.metrics,
         devices=args.devices, start_devices=args.start_devices,
         min_devices=args.min_devices,
@@ -831,7 +973,8 @@ def main(argv=None) -> int:
         max_restarts=args.max_restarts,
         crash_loop_after=args.crash_loop_after,
         backoff=RestartBackoff(base=args.backoff,
-                               cap=args.backoff_cap),
+                               cap=args.backoff_cap,
+                               jitter=args.backoff_jitter),
         poll_secs=args.poll, drain_grace=args.drain_grace,
         term_grace=args.term_grace, keep_faults=args.keep_faults)
     return sup.run()

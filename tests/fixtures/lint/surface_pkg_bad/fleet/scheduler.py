@@ -1,0 +1,16 @@
+"""Seeded surface drift, fleet flavor (r18): the scheduler's event
+vocabulary must draw from the registry like every other emitter —
+through the attribute call, the module-local ``_event`` helper, and
+bare record dicts alike."""
+
+
+def _event(sink, name, **data):
+    sink.event_record(name, **data)
+
+
+def schedule(sink):
+    sink.event_record('fleet_admit', job='a', devices=2)  # registered
+    _event(sink, 'fleet_evicted', job='a')                # drift: not
+    #             in this tree's EVENT_KINDS — the local helper must
+    #             not launder the literal past the check
+    return {'event': 'fleet_oversubscribed'}              # drift

@@ -6,6 +6,7 @@ EVENT_KINDS = (
     'retrace',
     'supervisor_restart',
     'hang_detected',
+    'fleet_admit',
 )
 
 

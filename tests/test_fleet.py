@@ -1,0 +1,656 @@
+"""Tests for the r18 fleet scheduler (training-as-a-service layer).
+
+Covers the ISSUE acceptance surface with jax-free child processes
+(the tests/test_supervisor.py discipline): fail-closed JobSpec and
+fleet-chaos spec parsing; urgent admission preempting the
+lowest-priority shrinkable job and regrowing it after (world sizes
+asserted via the per-incarnation ``topology_change`` events and the
+victim's supervisor failover/growback trail); crash-loop isolation
+(the looping job is quarantined with its diagnostic while the rest of
+the pack completes); priority aging admitting a starved low-priority
+job under a sustained ``queue-flood``; pool-loss shrink and
+preempt-to-queue; ``job-kill`` recovery inside the job's own
+supervisor budget; and the report/gate fleet surfaces (per-job SLO
+rows under the pinned ``fleet`` key, the ``fleet_quarantines`` gate
+metric).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from distributed_kfac_pytorch_tpu.fleet import chaos as fleet_chaos
+from distributed_kfac_pytorch_tpu.fleet import jobspec as js
+from distributed_kfac_pytorch_tpu.fleet import (
+    scheduler as fleet_sched,
+)
+from distributed_kfac_pytorch_tpu.observability import (
+    gate as obs_gate,
+    report as obs_report,
+    sink as obs_sink,
+)
+from distributed_kfac_pytorch_tpu.resilience import (
+    supervisor as sup_lib,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+#: Stdlib-only module dirs the jax-free test children import from
+#: directly (bypassing the jax-importing package __init__).
+RESIL = os.path.join(REPO, 'distributed_kfac_pytorch_tpu',
+                     'resilience')
+OBS = os.path.join(REPO, 'distributed_kfac_pytorch_tpu',
+                   'observability')
+
+
+# ---------------------------------------------------------------------------
+# JobSpec parsing (fail-closed)
+# ---------------------------------------------------------------------------
+
+def _job(name='j', **extra):
+    return {'name': name, 'argv': ['python', 'train.py'], **extra}
+
+
+class TestJobSpecParsing:
+    def test_roundtrip_and_defaults(self):
+        spec = js.parse_job(_job('lm', priority=3, min_devices=2,
+                                 max_devices=4,
+                                 tuned_config='TUNED_lm.json',
+                                 env={'A': 'b'}, after_s=1.5))
+        assert spec.name == 'lm' and spec.priority == 3
+        assert (spec.min_devices, spec.max_devices) == (2, 4)
+        assert spec.tuned_config == 'TUNED_lm.json'
+        assert spec.env_dict() == {'A': 'b'}
+        assert spec.after_s == 1.5
+        d = js.parse_job(_job())
+        assert (d.priority, d.min_devices, d.max_devices,
+                d.max_restarts, d.keep_faults) == (0, 1, 1, 5, False)
+        # max_devices defaults to min_devices, not 1.
+        assert js.parse_job(_job(min_devices=3)).max_devices == 3
+
+    def test_unknown_field_fails_closed_with_menu(self):
+        with pytest.raises(ValueError) as e:
+            js.parse_job(_job(bogus_knob=1))
+        msg = str(e.value)
+        assert "'bogus_knob'" in msg
+        # The FULL field menu rides in the message (the chaos-spec
+        # discipline: fixable from the traceback alone).
+        for field in ('priority', 'min_devices', 'tuned_config',
+                      'gate_baseline', 'after_s'):
+            assert field in msg
+
+    def test_missing_and_ill_typed_fields(self):
+        with pytest.raises(ValueError, match='missing required'):
+            js.parse_job({'name': 'x'})
+        with pytest.raises(ValueError, match='argv'):
+            js.parse_job({'name': 'x', 'argv': []})
+        with pytest.raises(ValueError, match='argv'):
+            js.parse_job({'name': 'x', 'argv': 'python train.py'})
+        with pytest.raises(ValueError, match='priority'):
+            js.parse_job(_job(priority='high'))
+        with pytest.raises(ValueError, match='min_devices'):
+            js.parse_job(_job(min_devices=0))
+        with pytest.raises(ValueError, match='below min_devices'):
+            js.parse_job(_job(min_devices=4, max_devices=2))
+        with pytest.raises(ValueError, match='env'):
+            js.parse_job(_job(env={'A': 1}))
+        with pytest.raises(ValueError, match='after_s'):
+            js.parse_job(_job(after_s=-1))
+
+    def test_parse_jobs_rejects_and_duplicates(self):
+        specs, rejects = js.parse_jobs({'jobs': [
+            _job('a'), {'name': 'b'}, _job('a'), _job('c')]})
+        assert [s.name for s in specs] == ['a', 'c']
+        assert rejects[0][0] == 'b' and 'missing' in rejects[0][1]
+        # Distinct label: the reject's quarantine row must not share
+        # the scheduled job's key in the report's per-job table.
+        assert rejects[1][0] == 'a (duplicate, jobs[2])'
+        assert 'duplicate' in rejects[1][1]
+
+    def test_load_jobs_file_forms_and_hard_errors(self, tmp_path):
+        f = tmp_path / 'jobs.json'
+        f.write_text(json.dumps([_job('a')]))
+        specs, rejects = js.load_jobs(str(f))
+        assert [s.name for s in specs] == ['a'] and not rejects
+        f.write_text(json.dumps({'jobs': [_job('b')]}))
+        assert js.load_jobs(str(f))[0][0].name == 'b'
+        f.write_text('{"not": "jobs"}')
+        with pytest.raises(ValueError, match='jobs document'):
+            js.load_jobs(str(f))
+        f.write_text('{torn')
+        with pytest.raises(ValueError, match='not valid JSON'):
+            js.load_jobs(str(f))
+        with pytest.raises(ValueError, match='cannot read'):
+            js.load_jobs(str(tmp_path / 'missing.json'))
+
+
+# ---------------------------------------------------------------------------
+# Fleet chaos spec parsing (fail-closed, full menu)
+# ---------------------------------------------------------------------------
+
+class TestFleetChaosSpec:
+    def test_parse(self):
+        plan = fleet_chaos.parse_spec(
+            'job-kill@2,pool-loss@3->2,queue-flood@1')
+        assert plan.job_kill_at == 2
+        assert (plan.pool_loss_at, plan.pool_loss_to) == (3, 2)
+        assert plan.queue_flood_at == 1
+        assert fleet_chaos.parse_spec('') is None
+        assert fleet_chaos.parse_spec(None) is None
+
+    def test_unknown_kind_fails_closed_with_menu(self):
+        with pytest.raises(ValueError) as e:
+            fleet_chaos.parse_spec('explode@3')
+        msg = str(e.value)
+        assert "'explode'" in msg
+        for kind in ('job-kill@K', 'pool-loss@K->N', 'queue-flood@K'):
+            assert kind in msg
+
+    def test_malformed_and_duplicate_fail_closed(self):
+        with pytest.raises(ValueError, match='not a scheduler tick'):
+            fleet_chaos.parse_spec('job-kill@soon')
+        with pytest.raises(ValueError, match='pool-loss'):
+            fleet_chaos.parse_spec('pool-loss@3')
+        with pytest.raises(ValueError, match='more than once'):
+            fleet_chaos.parse_spec('job-kill@1,job-kill@5')
+        with pytest.raises(ValueError, match='more than once'):
+            fleet_chaos.parse_spec('pool-loss@1->2,pool-loss@4->1')
+
+    def test_env_plan(self, monkeypatch):
+        monkeypatch.setenv(fleet_chaos.ENV_VAR, 'queue-flood@7')
+        assert fleet_chaos.plan_from_env().queue_flood_at == 7
+        monkeypatch.delenv(fleet_chaos.ENV_VAR)
+        assert fleet_chaos.plan_from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# Fleet scheduler over tiny jax-free children
+# ---------------------------------------------------------------------------
+
+_CHILD_PRELUDE = """\
+import os, sys, time
+# Stdlib-only modules imported DIRECTLY (not through the package
+# __init__, which pulls in jax): ~0.9 s of import per child process,
+# across dozens of launches, would dominate the fast tier.
+sys.path.insert(0, {resil!r})
+sys.path.insert(0, {obs!r})
+import heartbeat as hb
+import sink as sink_lib
+from preemption import RELAUNCH_EXIT_CODE
+inc = int(os.environ[hb.ENV_INCARNATION])
+d = os.environ[hb.ENV_DIR]
+sentinel = os.environ['KFAC_PREEMPT_FILE']
+metrics = sys.argv[sys.argv.index('--kfac-metrics') + 1]
+world = 0
+for flag in os.environ.get('XLA_FLAGS', '').split():
+    if flag.startswith('--xla_force_host_platform_device_count='):
+        world = int(flag.split('=')[1])
+def beat(step, rank=0):
+    hb.write_lease(hb.lease_path(d, rank), rank=rank, step=step,
+                   incarnation=inc)
+"""
+
+#: A cooperative training stand-in: records its world as a
+#: topology_change event (the real CLIs' elastic-resume signal), then
+#: beats until done, draining gracefully on the preemption sentinel.
+_COOPERATIVE = """\
+s = sink_lib.JsonlMetricsSink(metrics, meta={{'incarnation': inc}})
+s.event_record('topology_change', global_step=0, resharded=True,
+               from_devices=0, to_devices=world)
+s.close()
+for i in range({steps}):
+    beat(i)
+    if os.path.exists(sentinel):
+        sys.exit(RELAUNCH_EXIT_CODE)
+    time.sleep(0.02)
+sys.exit(0)
+"""
+
+_FAST_SUP = dict(hang_timeout=30.0, startup_grace=60.0,
+                 poll_secs=0.05, drain_grace=15.0, term_grace=2.0)
+
+
+def _spec(name, body, **kw):
+    script = _CHILD_PRELUDE.format(resil=RESIL, obs=OBS) + body
+    return js.parse_job({'name': name,
+                         'argv': [sys.executable, '-c', script], **kw})
+
+
+def _run_fleet(tmp_path, specs, pool, *, rejects=None, plan=None,
+               aging_secs=0.0, sup_options=None, **kw):
+    opts = dict(_FAST_SUP)
+    opts.update(sup_options or {})
+    fleet = fleet_sched.FleetScheduler(
+        specs, rejects=rejects, pool_devices=pool,
+        workdir=str(tmp_path / 'fleet'), poll_secs=0.05,
+        aging_secs=aging_secs, plan=plan, sup_options=opts,
+        backoff_base=0.0, backoff_cap=0.0, **kw)
+    rc = fleet.run(install_signals=False, deadline_s=120)
+    events = [(r['event'], r.get('data', {}))
+              for r in obs_sink.read_jsonl(fleet.events_path)
+              if r['kind'] == 'event']
+    return rc, events, fleet
+
+
+def _job_metrics(tmp_path, name):
+    return str(tmp_path / 'fleet' / 'jobs' / name / 'metrics.jsonl')
+
+
+def _sidecar_events(tmp_path, name):
+    path = _job_metrics(tmp_path, name) \
+        + obs_sink.SUPERVISOR_SIDECAR_SUFFIX
+    return [(r['event'], r.get('data', {}))
+            for r in obs_sink.read_jsonl(path) if r['kind'] == 'event']
+
+
+def _topology_worlds(metrics_path):
+    """to_devices per incarnation, oldest first — the child records
+    its world at every (re)launch, and the sink chains the dead
+    incarnations, so the full resize history is reconstructible."""
+    records = []
+    for p in reversed(obs_sink.incarnation_paths(metrics_path)):
+        records.extend(obs_sink.read_incarnation(p))
+    records.extend(obs_sink.read_jsonl(metrics_path))
+    return [r['data']['to_devices'] for r in records
+            if r.get('kind') == 'event'
+            and r['event'] == 'topology_change']
+
+
+class TestFleetScheduler:
+    # The fast tier keeps the ISSUE acceptance pins (urgent
+    # admission, crash-loop isolation, aging under queue-flood,
+    # fail-closed rejects, SLO/report surfaces); the remaining
+    # end-to-end process scenarios (basic pack, pool-loss shrink and
+    # preempt-to-queue, job-kill) ride the slow tier — the fast tier
+    # already runs within seconds of the tier-1 wall-clock budget.
+
+    @pytest.mark.slow
+    def test_pack_completes(self, tmp_path):
+        specs = [_spec('a', _COOPERATIVE.format(steps=6), priority=1,
+                       max_devices=2),
+                 _spec('b', _COOPERATIVE.format(steps=6), priority=2,
+                       max_devices=2)]
+        rc, events, _fleet = _run_fleet(tmp_path, specs, pool=4)
+        assert rc == 0
+        kinds = [k for k, _ in events]
+        assert kinds[:2] == ['fleet_admit', 'fleet_admit']
+        assert sorted(kinds[2:]) == ['fleet_complete', 'fleet_complete']
+        # Higher priority admits first and both get their max.
+        assert events[0][1]['job'] == 'b'
+        assert all(d['devices'] == 2 for k, d in events
+                   if k == 'fleet_admit')
+
+    def test_urgent_admission_preempts_and_regrows(self, tmp_path):
+        # steady owns the whole pool; urgent (higher priority,
+        # min 2) arrives late: the fleet must SHRINK steady 4 -> 2
+        # rather than queue urgent, then grow steady back 2 -> 4 when
+        # urgent completes — the N->M->N loop, driven purely through
+        # the per-job capacity files.
+        specs = [
+            _spec('steady', _COOPERATIVE.format(steps=90), priority=1,
+                  min_devices=1, max_devices=4),
+            _spec('urgent', _COOPERATIVE.format(steps=8), priority=9,
+                  min_devices=2, max_devices=2, after_s=0.7),
+        ]
+        rc, events, _fleet = _run_fleet(tmp_path, specs, pool=4)
+        assert rc == 0
+        kinds = [k for k, _ in events]
+        assert kinds == ['fleet_admit', 'fleet_preempt', 'fleet_admit',
+                         'fleet_complete', 'fleet_regrow',
+                         'fleet_complete']
+        by_kind = dict(zip(kinds, (d for _, d in events)))
+        assert events[0][1]['job'] == 'steady'
+        assert events[0][1]['devices'] == 4
+        pre = by_kind['fleet_preempt']
+        assert (pre['job'], pre['from_devices'], pre['to_devices']) \
+            == ('steady', 4, 2)
+        assert pre['reason'] == 'admission' and not pre['requeued']
+        assert events[2][1]['job'] == 'urgent'
+        assert events[2][1]['devices'] == 2
+        assert events[3][1]['job'] == 'urgent'
+        re = by_kind['fleet_regrow']
+        assert (re['job'], re['from_devices'], re['to_devices']) \
+            == ('steady', 2, 4)
+        assert events[5][1]['job'] == 'steady'
+        assert events[5][1]['preemptions'] == 1
+        # World sizes through the victim's own telemetry: the
+        # supervisor decision trail in its sidecar...
+        side = [(k, d.get('from_devices'), d.get('to_devices'))
+                for k, d in _sidecar_events(tmp_path, 'steady')]
+        assert ('supervisor_failover', 4, 2) in side
+        assert ('supervisor_growback', 2, 4) in side
+        # ...and the per-incarnation topology_change events: the
+        # child actually RAN at 4, then 2, then 4 devices.
+        assert _topology_worlds(_job_metrics(tmp_path, 'steady')) \
+            == [4, 2, 4]
+        assert _topology_worlds(_job_metrics(tmp_path, 'urgent')) \
+            == [2]
+
+    def test_crash_loop_job_quarantined_others_complete(self, tmp_path):
+        # 'bad' fails at the SAME step every launch: its supervisor
+        # must trip the crash-loop detector (exit 77 + diagnostic)
+        # and the fleet must quarantine it — then keep scheduling:
+        # 'good' (lower priority, admitted after) still completes.
+        specs = [
+            _spec('bad', 'beat(7)\nsys.exit(1)\n', priority=5,
+                  max_restarts=10),
+            _spec('good', _COOPERATIVE.format(steps=6), priority=1),
+        ]
+        rc, events, _fleet = _run_fleet(
+            tmp_path, specs, pool=1,
+            sup_options={'crash_loop_after': 2})
+        assert rc == 1
+        kinds = [k for k, _ in events]
+        assert kinds == ['fleet_admit', 'fleet_quarantine',
+                         'fleet_admit', 'fleet_complete']
+        quarantine = events[1][1]
+        assert quarantine['job'] == 'bad'
+        assert quarantine['rc'] == sup_lib.CRASH_LOOP_EXIT == 77
+        assert quarantine['reason'] == 'crash_loop'
+        diag = json.load(open(quarantine['diagnostic']))
+        assert diag['failure_step'] == 7
+        assert events[3][1]['job'] == 'good'
+
+    def test_rejected_spec_one_quarantine_event(self, tmp_path):
+        # A bad JobSpec fails CLOSED with exactly one fleet_quarantine
+        # event (the r12 tuned-config contract one level up) while the
+        # valid job runs.
+        specs, rejects = js.parse_jobs([
+            _job('broken', min_devices=0),
+            json.loads(json.dumps({
+                'name': 'ok',
+                'argv': _spec('ok',
+                              _COOPERATIVE.format(steps=4)).argv})),
+        ])
+        assert [r[0] for r in rejects] == ['broken']
+        rc, events, _fleet = _run_fleet(tmp_path, specs, pool=1,
+                                        rejects=rejects)
+        assert rc == 1  # the reject is a visible failure
+        quarantines = [d for k, d in events if k == 'fleet_quarantine']
+        assert len(quarantines) == 1
+        assert quarantines[0]['job'] == 'broken'
+        assert 'fail-closed' in quarantines[0]['reason']
+        assert 'min_devices' in quarantines[0]['error']
+        assert [d['job'] for k, d in events
+                if k == 'fleet_complete'] == ['ok']
+
+    def test_unsatisfiable_min_devices_quarantined(self, tmp_path):
+        specs = [_spec('huge', 'sys.exit(0)\n', min_devices=8,
+                       max_devices=8),
+                 _spec('ok', _COOPERATIVE.format(steps=4))]
+        rc, events, _fleet = _run_fleet(tmp_path, specs, pool=2)
+        assert rc == 1
+        q = [d for k, d in events if k == 'fleet_quarantine']
+        assert len(q) == 1 and q[0]['job'] == 'huge'
+        assert 'unsatisfiable' in q[0]['reason']
+        assert [d['job'] for k, d in events
+                if k == 'fleet_complete'] == ['ok']
+
+    def test_priority_aging_admits_starved_job_under_flood(
+            self, tmp_path, monkeypatch):
+        # Pool of 1; a priority-5 worker plus a sustained queue-flood
+        # of priority-6 clones (3 clones 1 s apart — both constants
+        # shrunk from the production values to keep the fast tier
+        # fast) starve the priority-0 job. Aging overtakes exactly
+        # the clones that arrive more than priority_gap * aging_secs
+        # (= 6 * 0.3 = 1.8 s) after the starved job — flood2
+        # (~2.05 s) — INDEPENDENT of job runtimes, because
+        # uniform-rate aging preserves relative order among
+        # already-queued jobs. Without aging the starved job would be
+        # admitted dead last.
+        monkeypatch.setattr(fleet_chaos, 'FLOOD_SPACING_S', 1.0)
+        monkeypatch.setattr(fleet_chaos, 'FLOOD_COPIES', 3)
+        specs = [
+            _spec('starved', _COOPERATIVE.format(steps=4), priority=0),
+            _spec('worker', _COOPERATIVE.format(steps=20), priority=5),
+        ]
+        rc, events, _fleet = _run_fleet(
+            tmp_path, specs, pool=1, aging_secs=0.3,
+            plan=fleet_chaos.parse_spec('queue-flood@1'))
+        assert rc == 0
+        admits = [d['job'] for k, d in events if k == 'fleet_admit']
+        assert len(admits) == 5  # worker + starved + 3 flood clones
+        assert admits[0] == 'worker'  # the flood outranks base prio 0
+        # Starvation-freedom, deterministically: the starved job is
+        # admitted ahead of the late flood tail.
+        assert admits.index('starved') \
+            < admits.index('worker-flood2')
+        assert 'starved' in [d['job'] for k, d in events
+                             if k == 'fleet_complete']
+
+    @pytest.mark.slow
+    def test_pool_loss_shrinks_running_job(self, tmp_path):
+        specs = [_spec('a', _COOPERATIVE.format(steps=70),
+                       min_devices=1, max_devices=4)]
+        rc, events, _fleet = _run_fleet(
+            tmp_path, specs, pool=4,
+            plan=fleet_chaos.parse_spec('pool-loss@10->2'))
+        assert rc == 0
+        kinds = [k for k, _ in events]
+        assert kinds == ['fleet_admit', 'fleet_preempt',
+                         'fleet_complete']
+        pre = events[1][1]
+        assert (pre['from_devices'], pre['to_devices']) == (4, 2)
+        assert pre['reason'] == 'pool-loss'
+        assert ('supervisor_failover', 4, 2) in [
+            (k, d.get('from_devices'), d.get('to_devices'))
+            for k, d in _sidecar_events(tmp_path, 'a')]
+        assert _topology_worlds(_job_metrics(tmp_path, 'a')) == [4, 2]
+
+    @pytest.mark.slow
+    def test_pool_loss_below_min_preempts_to_queue(self, tmp_path):
+        # Pool drops below the two running jobs' combined minimum:
+        # the lower-priority job is drained back to the QUEUE (not
+        # killed, not quarantined) and readmitted once the survivor
+        # completes.
+        specs = [
+            _spec('keep', _COOPERATIVE.format(steps=40), priority=2),
+            _spec('bump', _COOPERATIVE.format(steps=40), priority=1),
+        ]
+        rc, events, _fleet = _run_fleet(
+            tmp_path, specs, pool=2,
+            plan=fleet_chaos.parse_spec('pool-loss@10->1'))
+        assert rc == 0
+        pre = [d for k, d in events if k == 'fleet_preempt']
+        assert len(pre) == 1
+        assert pre[0]['job'] == 'bump' and pre[0]['requeued']
+        assert pre[0]['to_devices'] == 0
+        readmits = [d for k, d in events
+                    if k == 'fleet_admit' and d['readmitted']]
+        assert [d['job'] for d in readmits] == ['bump']
+        assert sorted(d['job'] for k, d in events
+                      if k == 'fleet_complete') == ['bump', 'keep']
+
+    @pytest.mark.slow
+    def test_job_kill_recovers_inside_job_budget(self, tmp_path):
+        # The fleet-chaos kill reaches the child via its lease pid;
+        # the job's OWN supervisor classifies the crash and relaunches
+        # under its budget — the fleet records one completion with
+        # restarts=1 and no quarantine.
+        specs = [_spec('a', _COOPERATIVE.format(steps=60))]
+        rc, events, _fleet = _run_fleet(
+            tmp_path, specs, pool=1,
+            plan=fleet_chaos.parse_spec('job-kill@5'))
+        assert rc == 0
+        kinds = [k for k, _ in events]
+        assert kinds == ['fleet_admit', 'fleet_complete']
+        assert events[1][1]['restarts'] == 1
+        side = _sidecar_events(tmp_path, 'a')
+        assert [k for k, _ in side] == ['supervisor_restart']
+        assert side[0][1]['reason'] == 'crash'
+
+
+# ---------------------------------------------------------------------------
+# Observability surfaces (report fleet key, gate metric)
+# ---------------------------------------------------------------------------
+
+def _write_fleet_stream(tmp_path, with_quarantine=True):
+    run = tmp_path / 'fleet.jsonl'
+    s = obs_sink.JsonlMetricsSink(str(run), meta={'fleet': True})
+    s.event_record('fleet_admit', job='a', priority=1, devices=4,
+                   queue_wait_s=0.0, readmitted=False)
+    s.event_record('fleet_preempt', job='a', from_devices=4,
+                   to_devices=2, reason='admission', requeued=False)
+    s.event_record('fleet_admit', job='u', priority=9, devices=2,
+                   queue_wait_s=0.1, readmitted=False)
+    s.event_record('fleet_complete', job='u', rc=0, devices=2,
+                   queue_wait_s=0.1, run_s=1.5, restarts=0,
+                   preemptions=0, gate='pass')
+    s.event_record('fleet_regrow', job='a', from_devices=2,
+                   to_devices=4, reason='capacity')
+    s.event_record('fleet_complete', job='a', rc=0, devices=4,
+                   queue_wait_s=0.0, run_s=9.0, restarts=1,
+                   preemptions=1, gate=None)
+    if with_quarantine:
+        s.event_record('fleet_quarantine', job='bad', rc=77,
+                       devices=1, queue_wait_s=0.0, run_s=2.0,
+                       restarts=1, preemptions=0, gate=None,
+                       reason='crash_loop', diagnostic='/d.json')
+    s.close()
+    return run
+
+
+class TestFleetObservability:
+    def test_event_kinds_registered(self):
+        for kind in ('fleet_admit', 'fleet_preempt', 'fleet_regrow',
+                     'fleet_quarantine', 'fleet_complete',
+                     'capacity_degraded'):
+            assert kind in obs_sink.EVENT_KINDS
+
+    def test_report_json_fleet_key_and_slo_rows(self, tmp_path,
+                                                capsys):
+        run = _write_fleet_stream(tmp_path)
+        assert obs_report.main([str(run), '--json']) == 0
+        parsed = json.loads(capsys.readouterr().out)
+        fleet = parsed['fleet']
+        assert fleet['admits'] == 2 and fleet['completes'] == 2
+        assert fleet['preempts'] == 1 and fleet['regrows'] == 1
+        assert fleet['quarantines'] == 1
+        assert sorted(fleet['jobs']) == ['a', 'bad', 'u']
+        # The per-job SLO row contract (pinned): every row carries
+        # exactly these keys.
+        for row in fleet['jobs'].values():
+            assert set(row) == {'outcome', 'rc', 'devices',
+                                'queue_wait_s', 'run_s', 'restarts',
+                                'preemptions', 'gate', 'reason'}
+        assert set(row) == set(obs_report.FLEET_SLO_KEYS)
+        a = fleet['jobs']['a']
+        assert (a['outcome'], a['preemptions'], a['restarts']) \
+            == ('complete', 1, 1)
+        assert fleet['jobs']['u']['gate'] == 'pass'
+        bad = fleet['jobs']['bad']
+        assert (bad['outcome'], bad['rc']) == ('quarantined', 77)
+
+    def test_report_text_fleet_section(self, tmp_path, capsys):
+        run = _write_fleet_stream(tmp_path)
+        assert obs_report.main([str(run)]) == 0
+        out = capsys.readouterr().out
+        assert ('-- fleet (7 scheduler event(s), 3 finished job(s)) '
+                '--') in out
+        assert 'admits: 2   preempts: 1 / regrows: 1' in out
+        assert 'quarantined' in out and 'gate pass' in out
+
+    def test_report_without_fleet_events_is_null(self, tmp_path,
+                                                 capsys):
+        run = tmp_path / 'run.jsonl'
+        s = obs_sink.JsonlMetricsSink(str(run))
+        s.step_record(0, {'loss': 1.0}, host_step_ms=10.0)
+        s.close()
+        assert obs_report.main([str(run), '--json']) == 0
+        assert json.loads(capsys.readouterr().out)['fleet'] is None
+
+    def test_gate_fleet_quarantines_round_trip(self, tmp_path,
+                                               capsys):
+        quarantined = _write_fleet_stream(tmp_path / 'q')
+        clean = _write_fleet_stream(tmp_path / 'c',
+                                    with_quarantine=False)
+        base = tmp_path / 'base.json'
+        assert obs_gate.main([str(clean), '--write-baseline',
+                              str(base), '--allow-missing']) == 0
+        capsys.readouterr()
+        rc = obs_gate.main([str(quarantined), '--baseline', str(base),
+                            '--json', '--no-anomaly',
+                            '--allow-missing'])
+        verdict = json.loads(capsys.readouterr().out)
+        assert verdict['current']['fleet_quarantines'] == 1
+        assert rc == 1
+        assert any(b['metric'] == 'fleet_quarantines'
+                   for b in verdict['breaches'])
+
+
+# ---------------------------------------------------------------------------
+# Reap semantics at the preempt/complete race
+# ---------------------------------------------------------------------------
+
+class TestReapStopRace:
+    def _fleet_and_job(self, tmp_path, rc):
+        fleet = fleet_sched.FleetScheduler(
+            [], pool_devices=1, workdir=str(tmp_path / 'fleet'))
+        job = fleet.submit(js.parse_job(_job('a')))
+        job.state = 'stopping'   # fleet-initiated preempt in flight
+        job.admit_time = job.eligible_at
+        job.rc = rc
+        return fleet, job
+
+    def test_child_finishing_during_drain_completes(self, tmp_path):
+        # The child exits 0 while the preempt drain is in flight:
+        # that is a completion — requeueing would re-run the whole
+        # job from its checkpoint (and double its SLO row).
+        fleet, job = self._fleet_and_job(tmp_path, rc=0)
+        try:
+            fleet._reap(fleet._clock())
+            assert job.state == 'done'
+            fleet.events.flush()
+            events = [r['event'] for r in obs_sink.read_jsonl(
+                fleet.events_path) if r['kind'] == 'event']
+            assert events == ['fleet_complete']
+        finally:
+            fleet.events.close()
+
+    def test_drained_child_requeues(self, tmp_path):
+        fleet, job = self._fleet_and_job(
+            tmp_path, rc=sup_lib.RELAUNCH_EXIT_CODE)
+        try:
+            fleet._reap(fleet._clock())
+            assert job.state == 'queued' and job.assigned == 0
+        finally:
+            fleet.events.close()
+
+    def test_drain_during_shutdown_keeps_slo_row(self, tmp_path):
+        # A preempt-draining job caught by fleet shutdown must reach
+        # a TERMINAL state with its SLO row on the stream — not
+        # linger as a forever-'queued' ghost the report never shows.
+        fleet, job = self._fleet_and_job(
+            tmp_path, rc=sup_lib.RELAUNCH_EXIT_CODE)
+        try:
+            fleet._stop = 'signal SIGTERM'
+            fleet._reap(fleet._clock())
+            assert job.state == 'quarantined'
+            fleet.events.flush()
+            q = [r['data'] for r in obs_sink.read_jsonl(
+                fleet.events_path) if r['kind'] == 'event'
+                and r['event'] == 'fleet_quarantine']
+            assert len(q) == 1
+            assert q[0]['reason'] == 'drained (fleet stopping)'
+        finally:
+            fleet.events.close()
+
+
+# ---------------------------------------------------------------------------
+# Scheduler construction validation
+# ---------------------------------------------------------------------------
+
+class TestValidation:
+    def test_pool_and_options(self, tmp_path):
+        with pytest.raises(ValueError, match='pool'):
+            fleet_sched.FleetScheduler([], pool_devices=0,
+                                       workdir=str(tmp_path / 'f'))
+        with pytest.raises(ValueError, match='sup_options'):
+            fleet_sched.FleetScheduler(
+                [], pool_devices=1, workdir=str(tmp_path / 'f2'),
+                sup_options={'bogus': 1})
+        with pytest.raises(ValueError, match='aging'):
+            fleet_sched.FleetScheduler(
+                [], pool_devices=1, workdir=str(tmp_path / 'f3'),
+                aging_secs=-1)

@@ -138,6 +138,11 @@ class TestRuleMatrix:
         # attribute call and the helper.
         assert "'supervisor_restart'" not in msgs
         assert "'hang_detected'" not in msgs
+        # r18 fleet flavor: the scheduler's event literals hit the
+        # same registry check through every emitter shape.
+        assert "'fleet_evicted'" in msgs
+        assert "'fleet_oversubscribed'" in msgs
+        assert "'fleet_admit'" not in msgs
         assert all(f.family == 'surface' for f in findings)
 
     def test_surface_negative_real_tree(self):

@@ -472,8 +472,9 @@ def test_cli_no_perf_anomalies_flag(tmp_path):
 REPORT_JSON_KEYS = {
     'meta', 'n_records', 'n_steps', 'n_epochs', 'step_range',
     'step_time', 'stages', 'memory', 'compiles', 'retraces',
-    'autotune', 'selfheal', 'supervision', 'event_counts', 'kfac',
-    'health_events', 'health_event_counts', 'stragglers', 'torn_lines',
+    'autotune', 'selfheal', 'supervision', 'fleet', 'event_counts',
+    'kfac', 'health_events', 'health_event_counts', 'stragglers',
+    'torn_lines',
 }
 
 

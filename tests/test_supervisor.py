@@ -17,8 +17,10 @@ multi-launch sequence through the real LM CLI rides in the slow tier.
 
 import json
 import os
+import pathlib
 import subprocess
 import sys
+import threading
 
 import pytest
 
@@ -34,6 +36,12 @@ from distributed_kfac_pytorch_tpu.resilience import (
 )
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+#: Stdlib-only module dirs the jax-free test children import from
+#: directly (bypassing the jax-importing package __init__).
+RESIL = os.path.join(REPO, 'distributed_kfac_pytorch_tpu',
+                     'resilience')
+OBS = os.path.join(REPO, 'distributed_kfac_pytorch_tpu',
+                   'observability')
 
 
 # ---------------------------------------------------------------------------
@@ -123,16 +131,45 @@ class TestEmitter:
 
 class TestBackoff:
     def test_schedule(self):
-        b = sup_lib.RestartBackoff(base=1.0, factor=2.0, cap=8.0)
+        # jitter=0 pins the raw exponential ladder.
+        b = sup_lib.RestartBackoff(base=1.0, factor=2.0, cap=8.0,
+                                   jitter=0.0)
         assert [b.next_delay() for _ in range(6)] == [
             0.0, 1.0, 2.0, 4.0, 8.0, 8.0]
         b.reset()
         assert b.next_delay() == 0.0
         assert b.next_delay() == 1.0
 
+    def test_jitter_is_seeded_and_decorrelates(self):
+        # Seeded draws reproduce exactly and track the schedule:
+        # every nonzero delay lands in [d*(1-jitter), d], under cap.
+        import random
+
+        kw = dict(base=1.0, factor=2.0, cap=8.0, jitter=0.5, seed=123)
+        b = sup_lib.RestartBackoff(**kw)
+        delays = [b.next_delay() for _ in range(6)]
+        rng = random.Random(123)
+        expect = [0.0] + [
+            min(8.0, 2.0 ** n) * (1.0 - 0.5 * rng.random())
+            for n in range(5)]
+        assert delays == pytest.approx(expect)
+        assert delays[0] == 0.0
+        for n, d in enumerate(delays[1:]):
+            sched = min(8.0, 2.0 ** n)
+            assert sched * 0.5 <= d <= sched
+        # Two jobs with different seeds decorrelate (the thundering-
+        # herd fix): identical schedules are astronomically unlikely.
+        b1 = sup_lib.RestartBackoff(base=1.0, cap=8.0, seed=1)
+        b2 = sup_lib.RestartBackoff(base=1.0, cap=8.0, seed=2)
+        s1 = [b1.next_delay() for _ in range(5)]
+        s2 = [b2.next_delay() for _ in range(5)]
+        assert s1 != s2
+
     def test_validation(self):
         with pytest.raises(ValueError):
             sup_lib.RestartBackoff(factor=0.5)
+        with pytest.raises(ValueError, match='jitter'):
+            sup_lib.RestartBackoff(jitter=1.5)
 
 
 class TestCrashLoop:
@@ -219,11 +256,12 @@ class TestStragglerClassifier:
 
 _CHILD_PRELUDE = """\
 import os, sys, time
-sys.path.insert(0, {repo!r})
-from distributed_kfac_pytorch_tpu.resilience import heartbeat as hb
-from distributed_kfac_pytorch_tpu.resilience.preemption import (
-    RELAUNCH_EXIT_CODE,
-)
+# Stdlib-only modules imported DIRECTLY (not through the package
+# __init__, which pulls in jax): ~0.9 s of import per child process,
+# across dozens of launches, would dominate the fast tier.
+sys.path.insert(0, {resil!r})
+import heartbeat as hb
+from preemption import RELAUNCH_EXIT_CODE
 inc = int(os.environ[hb.ENV_INCARNATION])
 d = os.environ[hb.ENV_DIR]
 sentinel = os.environ['KFAC_PREEMPT_FILE']
@@ -235,8 +273,10 @@ def beat(step, rank=0):
 
 def _supervise(tmp_path, child_body, **kw):
     """Run a Supervisor over a tiny python child; returns (rc, events,
-    sup). Fast real-time knobs throughout."""
-    script = _CHILD_PRELUDE.format(repo=REPO) + child_body
+    sup). Fast real-time knobs throughout. Events are read from
+    ``sup.events_path`` — the default stream name carries the
+    per-instance namespace token (r18 satellite)."""
+    script = _CHILD_PRELUDE.format(resil=RESIL, obs=OBS) + child_body
     defaults = dict(
         workdir=str(tmp_path / 'sup'),
         hang_timeout=1.0, startup_grace=10.0, poll_secs=0.05,
@@ -246,8 +286,7 @@ def _supervise(tmp_path, child_body, **kw):
     sup = sup_lib.Supervisor([sys.executable, '-c', script], **defaults)
     rc = sup.run()
     events = [(r['event'], r.get('data', {}))
-              for r in obs_sink.read_jsonl(
-                  str(tmp_path / 'sup' / 'supervisor.jsonl'))
+              for r in obs_sink.read_jsonl(sup.events_path)
               if r['kind'] == 'event']
     return rc, events, sup
 
@@ -355,7 +394,7 @@ sys.exit(0)
         data = dict(events[0][1])
         assert data['reason'] == 'capacity'
         assert data['from_devices'] == 4 and data['to_devices'] == 2
-        hbdir = tmp_path / 'sup' / 'heartbeats'
+        hbdir = pathlib.Path(sup.heartbeat_dir)
         assert '=4' in (hbdir / 'world0.txt').read_text()
         assert '=2' in (hbdir / 'world1.txt').read_text()
 
@@ -369,7 +408,7 @@ sys.exit(0)
         assert [k for k, _ in events] == ['supervisor_growback']
         data = dict(events[0][1])
         assert data['from_devices'] == 2 and data['to_devices'] == 4
-        hbdir = tmp_path / 'sup' / 'heartbeats'
+        hbdir = pathlib.Path(sup.heartbeat_dir)
         assert '=2' in (hbdir / 'world0.txt').read_text()
         assert '=4' in (hbdir / 'world1.txt').read_text()
 
@@ -427,6 +466,173 @@ sys.exit(1 if os.environ.get('KFAC_CHAOS') else 0)
 
 
 # ---------------------------------------------------------------------------
+# Torn capacity file (r18 satellite): keep last target, one warning
+# ---------------------------------------------------------------------------
+
+class TestCapacityDegraded:
+    def _events(self, sup):
+        try:
+            stream = obs_sink.read_jsonl(sup.events_path)
+        except FileNotFoundError:
+            return []  # nothing ever flushed: no events
+        return [(r['event'], r.get('data', {}))
+                for r in stream if r['kind'] == 'event']
+
+    def test_torn_reads_keep_last_target_one_event(self, tmp_path):
+        cap = tmp_path / 'capacity'
+        cap.write_text('3\n')
+        sup = sup_lib.Supervisor(['x'], workdir=str(tmp_path / 'sup'),
+                                 devices=4, capacity_file=str(cap))
+        try:
+            assert sup._capacity_target() == 3
+            # Mid-write truncation: the resource manager's plain
+            # overwrite caught between open and write — empty file.
+            cap.write_text('')
+            assert sup._capacity_target() == 3  # last known kept
+            cap.write_text('4 devices')  # non-integer
+            assert sup._capacity_target() == 3
+            # One degradation episode = exactly ONE warning event,
+            # however many polls it spans.
+            assert [k for k, _ in self._events(sup)] \
+                == ['capacity_degraded']
+            data = self._events(sup)[0][1]
+            assert data['last_target'] == 3
+            # Recovery re-arms the warning; a later episode gets its
+            # own single event.
+            cap.write_text('2')
+            assert sup._capacity_target() == 2
+            cap.write_text('')
+            assert sup._capacity_target() == 2
+            assert [k for k, _ in self._events(sup)] \
+                == ['capacity_degraded', 'capacity_degraded']
+        finally:
+            sup.events.close()
+
+    def test_missing_file_is_not_degraded(self, tmp_path):
+        sup = sup_lib.Supervisor(
+            ['x'], workdir=str(tmp_path / 'sup'), devices=4,
+            capacity_file=str(tmp_path / 'never-written'))
+        try:
+            # Absent file: no view yet — no target, no warning (the
+            # resource manager may simply not have started).
+            assert sup._capacity_target() is None
+            assert self._events(sup) == []
+        finally:
+            sup.events.close()
+
+    def test_event_kind_registered(self):
+        assert 'capacity_degraded' in obs_sink.EVENT_KINDS
+
+
+# ---------------------------------------------------------------------------
+# Per-instance artifact namespacing (r18 satellite)
+# ---------------------------------------------------------------------------
+
+class TestArtifactNamespacing:
+    def test_two_supervisors_one_workdir_do_not_collide(self,
+                                                        tmp_path):
+        workdir = str(tmp_path / 'shared')
+        script = _CHILD_PRELUDE.format(resil=RESIL, obs=OBS) + 'beat(3)\n'
+        sups = [sup_lib.Supervisor([sys.executable, '-c', script],
+                                   workdir=workdir,
+                                   hang_timeout=30.0,
+                                   startup_grace=30.0, poll_secs=0.05,
+                                   term_grace=1.0)
+                for _ in range(2)]
+        # Default paths are namespaced per instance: no shared lease
+        # dir, event stream or drain sentinel.
+        a, b = sups
+        assert a.heartbeat_dir != b.heartbeat_dir
+        assert a.events_path != b.events_path
+        assert a.sentinel != b.sentinel
+        # Run both concurrently (the fleet's threading shape): each
+        # sees exactly its own child's lease — a shared dir would make
+        # each watcher count the other's rank.
+        threads = [threading.Thread(
+            target=lambda s=s: s.run(install_signals=False))
+            for s in sups]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        for s in sups:
+            leases, errors = hb.scan_leases(s.heartbeat_dir)
+            assert sorted(leases) == [0] and not errors
+            assert leases[0]['step'] == 3
+            stream = obs_sink.read_jsonl(s.events_path)
+            assert stream[0]['kind'] == 'meta'  # intact, not clobbered
+
+    def test_explicit_instance_names_paths(self, tmp_path):
+        sup = sup_lib.Supervisor(['x'], workdir=str(tmp_path / 'w'),
+                                 instance='jobA')
+        try:
+            assert sup.heartbeat_dir.endswith(
+                os.path.join('heartbeats', 'jobA'))
+            assert sup.events_path.endswith('supervisor.jobA.jsonl')
+            assert sup.sentinel.endswith('drain.jobA.sentinel')
+        finally:
+            sup.events.close()
+
+    def test_metrics_sidecar_convention_unchanged(self, tmp_path):
+        # The report/gate contract: with --metrics the sidecar stays
+        # exactly <metrics>.supervisor — namespacing never moves it.
+        metrics = str(tmp_path / 'run.jsonl')
+        sup = sup_lib.Supervisor(['x'], workdir=str(tmp_path / 'w'),
+                                 metrics_path=metrics)
+        try:
+            assert sup.events_path == metrics \
+                + obs_sink.SUPERVISOR_SIDECAR_SUFFIX
+        finally:
+            sup.events.close()
+
+
+# ---------------------------------------------------------------------------
+# Mixed-incarnation leases (r18 satellite)
+# ---------------------------------------------------------------------------
+
+class TestScanLeasesIncarnation:
+    def test_stale_incarnation_degrades_to_error(self, tmp_path):
+        # Leases left behind by a quarantined job (or any earlier
+        # incarnation sharing the dir) must not masquerade as live
+        # ranks: their stale timestamps would fire an instant false
+        # hang/dead-rank verdict.
+        hb.write_lease(str(tmp_path / 'rank0.lease'), rank=0, step=9,
+                       incarnation=2)
+        hb.write_lease(str(tmp_path / 'rank1.lease'), rank=1, step=4,
+                       incarnation=0)
+        leases, errors = hb.scan_leases(str(tmp_path), incarnation=2)
+        assert sorted(leases) == [0]
+        assert list(errors) == ['rank1.lease']
+        assert 'stale incarnation 0' in errors['rank1.lease']
+        # Unfiltered scan still sees everything (the last-words /
+        # diagnostic reader).
+        leases, errors = hb.scan_leases(str(tmp_path))
+        assert sorted(leases) == [0, 1] and not errors
+
+    def test_corrupt_incarnation_field_degrades_not_crashes(
+            self, tmp_path):
+        path = tmp_path / 'rank0.lease'
+        path.write_text(json.dumps({'schema': 1, 'rank': 0, 'pid': 1,
+                                    'step': 2, 'wall_time': 1.0,
+                                    'incarnation': 'garbage'}))
+        hb.write_lease(str(tmp_path / 'rank1.lease'), rank=1, step=3,
+                       incarnation=0)
+        leases, errors = hb.scan_leases(str(tmp_path), incarnation=0)
+        assert sorted(leases) == [1]
+        assert 'bad incarnation' in errors['rank0.lease']
+
+    def test_legacy_lease_without_incarnation_field(self, tmp_path):
+        path = tmp_path / 'rank0.lease'
+        path.write_text(json.dumps({'schema': 1, 'rank': 0, 'pid': 1,
+                                    'step': 2, 'wall_time': 1.0}))
+        # Missing field reads as incarnation 0.
+        leases, errors = hb.scan_leases(str(tmp_path), incarnation=0)
+        assert sorted(leases) == [0] and not errors
+        leases, errors = hb.scan_leases(str(tmp_path), incarnation=3)
+        assert not leases and list(errors) == ['rank0.lease']
+
+
+# ---------------------------------------------------------------------------
 # Configurable relaunch exit code (satellite)
 # ---------------------------------------------------------------------------
 
@@ -439,8 +645,9 @@ class TestRelaunchExitEnv:
             env['KFAC_RELAUNCH_EXIT'] = env_val
         return subprocess.run(
             [sys.executable, '-c',
-             'from distributed_kfac_pytorch_tpu.resilience.preemption '
-             'import RELAUNCH_EXIT_CODE; print(RELAUNCH_EXIT_CODE)'],
+             f'import sys; sys.path.insert(0, {RESIL!r})\n'
+             'from preemption import RELAUNCH_EXIT_CODE\n'
+             'print(RELAUNCH_EXIT_CODE)'],
             env=env, capture_output=True, text=True, timeout=60)
 
     def test_default_75(self):
