@@ -77,6 +77,8 @@ def run_one(use_kfac: bool, args, data):
         inv_pipeline_chunks=args.inv_pipeline_chunks,
         deferred_factor_reduction=args.deferred_factor_reduction,
         inv_staleness=args.inv_staleness,
+        inv_lowrank_rank=args.inv_lowrank_rank,
+        inv_lowrank_dim_threshold=args.inv_lowrank_dim_threshold,
         kfac_cov_update_freq=1, damping=args.damping,
         kl_clip=0.001, eigh_method=args.eigh_method,
         eigh_polish_iters=args.eigh_polish_iters,
@@ -287,6 +289,13 @@ def main(argv=None):
                    help='r14 one-window-stale off-critical-path '
                         'inverses — the staleness convergence A/B arm '
                         '(PERF.md r14 decision rule)')
+    p.add_argument('--inv-lowrank-rank', type=int, default=0,
+                   help='r19 randomized truncated-eigendecomposition '
+                        'rank for dims >= --inv-lowrank-dim-threshold '
+                        '(0 = exact dispatch) — the low-rank '
+                        'convergence A/B arm (PERF.md r19)')
+    p.add_argument('--inv-lowrank-dim-threshold', type=int,
+                   default=2048)
     p.add_argument('--damping', type=float, default=0.003)
     # KFACParamScheduler knobs (the round-3 analysis prescribed a
     # damping/update-freq schedule for the conv/BN study; VERDICT r3 #6).

@@ -48,7 +48,8 @@ def emit(obj):
     print(json.dumps(obj), flush=True)
 
 
-def run_leg(args, k: int) -> dict:
+def run_leg(args, k: int, kfac_extra: dict | None = None,
+            label: str | None = None) -> dict:
     import jax
     import jax.numpy as jnp
     import optax
@@ -72,7 +73,7 @@ def run_leg(args, k: int) -> dict:
     kfac = KFAC(model, factor_update_freq=args.factor_update_freq,
                 inv_update_freq=i_freq, damping=0.003, lr=0.1,
                 inverse_method=args.inverse_method or None,
-                inv_pipeline_chunks=k)
+                inv_pipeline_chunks=k, **(kfac_extra or {}))
     ids = jax.random.randint(jax.random.PRNGKey(1),
                              (args.batch, args.seq), 0, args.vocab)
     tgt = jax.random.randint(jax.random.PRNGKey(2),
@@ -112,7 +113,8 @@ def run_leg(args, k: int) -> dict:
     # timed epoch re-executes compiled programs only.
     engine.train_epoch(step, state, [batch] * (2 * i_freq), hyper)
     n_timed = args.windows * i_freq
-    mpath = os.path.join(args.metrics_dir, f'firing_spread_k{k}.jsonl')
+    mpath = os.path.join(args.metrics_dir,
+                         f'firing_spread_{label or f"k{k}"}.jsonl')
     sink = osink.JsonlMetricsSink(mpath, interval=1)
     engine.train_epoch(step, state, [batch] * n_timed, hyper,
                        metrics_sink=sink)
@@ -146,7 +148,7 @@ def run_leg(args, k: int) -> dict:
                 if n != 1}
     assert not retraced, f'variants retraced during the bench: {retraced}'
     return {
-        'leg': f'k{k}',
+        'leg': label or f'k{k}',
         'inv_pipeline_chunks': k,
         'n_timed_steps': n_timed,
         'windows': args.windows,
@@ -204,6 +206,20 @@ def main(argv=None):
                         'divide it (8 = the nearest chunk-divisible '
                         'stress cadence to the tracked i10)')
     p.add_argument('--chunks', type=int, nargs='+', default=[1, 2, 4])
+    p.add_argument('--lowrank', action='store_true',
+                   help='r19 randomized low-rank A/B instead of the '
+                        'chunk sweep: one exact leg and one with '
+                        '--lowrank-rank engaged on every dim >= '
+                        '--lowrank-dim-threshold (both monolithic '
+                        'k=1), emitting the per-window inverse-cost '
+                        'ratio — the "decomposition cost reduced '
+                        '>= 3x" acceptance number (PERF.md r19)')
+    p.add_argument('--lowrank-rank', type=int, default=64,
+                   help='--lowrank truncation rank')
+    p.add_argument('--lowrank-dim-threshold', type=int, default=1024,
+                   help='--lowrank engagement threshold (the CPU-'
+                        'scaled config-4 d512 ladder engages its '
+                        '2048/2049 FFN dims at the default)')
     p.add_argument('--windows', type=int, default=6,
                    help='timed cadence windows per leg')
     p.add_argument('--metrics-dir', default=None)
@@ -220,7 +236,8 @@ def main(argv=None):
     import jax
     rows = []
     header = {
-        'bench': 'firing_spread',
+        'bench': ('firing_spread_lowrank' if args.lowrank
+                  else 'firing_spread'),
         'workload': (f'transformer_lm_{args.size}'
                      + (f'_d{args.d_model}L{args.num_layers}'
                         if args.d_model else '')
@@ -232,7 +249,32 @@ def main(argv=None):
                  'are backend-local (PERF.md r6 CPU conventions), '
                  'on-chip re-run owed per PERF.md r9 decision rule'),
     }
+    if args.lowrank:
+        header['lowrank'] = {'rank': args.lowrank_rank,
+                             'dim_threshold': args.lowrank_dim_threshold}
     emit(header)
+
+    if args.lowrank:
+        exact = run_leg(args, 1, label='exact')
+        emit(exact)
+        rows.append(exact)
+        low = run_leg(args, 1, label='lowrank', kfac_extra=dict(
+            inv_lowrank_rank=args.lowrank_rank,
+            inv_lowrank_dim_threshold=args.lowrank_dim_threshold))
+        low['inv_lowrank_rank'] = args.lowrank_rank
+        low['inv_lowrank_dim_threshold'] = args.lowrank_dim_threshold
+        if low['window_inverse_ms'] > 0:
+            low['decomposition_cost_ratio'] = round(
+                exact['window_inverse_ms'] / low['window_inverse_ms'],
+                2)
+        emit(low)
+        rows.append(low)
+        if args.out:
+            with open(args.out, 'w') as f:
+                json.dump({'header': header, 'legs': rows}, f, indent=1)
+            print(f'wrote {args.out}', file=sys.stderr)
+        return 0
+
     baseline = None
     for k in args.chunks:
         row = run_leg(args, k)

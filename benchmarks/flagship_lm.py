@@ -424,9 +424,15 @@ def run_quality_leg(args):
     else:
         # 'stale' = the composed r14 overlap config (staleness + the
         # exact deferred reduce); 'eager' = its matched default-
-        # schedule baseline; 'expand'/'reduce' = the r13 approx legs.
+        # schedule baseline; 'expand'/'reduce' = the r13 approx legs;
+        # 'lowrank' = the r19 randomized truncated path engaged on the
+        # rung's FFN dims vs its matched 'exact' baseline.
         overlap = (dict(deferred_factor_reduction=True,
                         inv_staleness=1) if leg == 'stale' else {})
+        if leg == 'lowrank':
+            thr = args.ab_lowrank_threshold or 2 * d
+            overlap = dict(inv_lowrank_rank=args.ab_lowrank_rank,
+                           inv_lowrank_dim_threshold=thr)
         kfac = KFAC(model, factor_update_freq=f_freq,
                     inv_update_freq=i_freq, damping=0.003,
                     lr=args.ab_lr, kl_clip=0.001,
@@ -739,11 +745,29 @@ def main(argv=None):
                         'difference isolates the one-window inverse '
                         'staleness (PERF.md r14 decision rule; '
                         'committed FLAGSHIP_LM_r14_STALENESS.jsonl)')
+    p.add_argument('--lowrank-ab', action='store_true',
+                   help='r19 randomized low-rank convergence A/B: for '
+                        'each --ladder d_model, one leg with the '
+                        'default exact dispatch ("exact") and one '
+                        'with --ab-lowrank-rank engaged on the '
+                        "rung's FFN factor dims (\"lowrank\", "
+                        'threshold 2*d by default), identical '
+                        'hyperparameters — the loss-curve difference '
+                        'isolates the truncation (PERF.md r19 '
+                        'decision rule; committed '
+                        'FLAGSHIP_LM_r19_LOWRANK.jsonl)')
+    p.add_argument('--ab-lowrank-rank', type=int, default=64,
+                   help='--lowrank-ab truncation rank (must be below '
+                        'every engaged dim)')
+    p.add_argument('--ab-lowrank-threshold', type=int, default=0,
+                   help='--lowrank-ab engagement threshold; 0 = '
+                        "2*d_model (engages the rung's 4d FFN dims, "
+                        'keeps the d-dim attention projections exact)')
     p.add_argument('--quality-leg', default=None,
                    choices=['sgd', 'expand', 'reduce', 'eager',
-                            'stale'],
-                   help='internal: which --approx-ab/--staleness-ab '
-                        'leg this subprocess runs')
+                            'stale', 'exact', 'lowrank'],
+                   help='internal: which --approx-ab/--staleness-ab/'
+                        '--lowrank-ab leg this subprocess runs')
     p.add_argument('--obs-baseline', default=None, metavar='PATH',
                    help='record a per-step metrics stream at this '
                         'config and reduce it to a committed '
@@ -764,12 +788,15 @@ def main(argv=None):
     if args.phase:
         return run_phase(args)
 
-    if args.approx_ab or args.staleness_ab:
+    if args.approx_ab or args.staleness_ab or args.lowrank_ab:
         import jax as _jax
         backend = _jax.default_backend()
-        legs = (('sgd', 'expand', 'reduce') if args.approx_ab
-                else ('eager', 'stale'))
-        ab_label = 'kfac_approx' if args.approx_ab else 'inv_staleness'
+        if args.approx_ab:
+            legs, ab_label = ('sgd', 'expand', 'reduce'), 'kfac_approx'
+        elif args.staleness_ab:
+            legs, ab_label = ('eager', 'stale'), 'inv_staleness'
+        else:
+            legs, ab_label = ('exact', 'lowrank'), 'inv_lowrank'
         for d in args.ladder:
             for leg in legs:
                 cmd = [sys.executable, os.path.abspath(__file__),
@@ -782,7 +809,10 @@ def main(argv=None):
                        '--ab-layers', str(args.ab_layers),
                        '--ab-lr', str(args.ab_lr),
                        '--ab-f', str(args.ab_f),
-                       '--ab-i', str(args.ab_i)]
+                       '--ab-i', str(args.ab_i),
+                       '--ab-lowrank-rank', str(args.ab_lowrank_rank),
+                       '--ab-lowrank-threshold',
+                       str(args.ab_lowrank_threshold)]
                 row = {'config': 4, 'ab': ab_label,
                        'd_model': d, 'leg': leg, 'backend': backend,
                        'seq': args.ab_seq, 'batch': args.ab_batch,
@@ -790,6 +820,10 @@ def main(argv=None):
                        'layers': args.ab_layers,
                        'steps': args.ab_steps, 'lr': args.ab_lr,
                        'cadence': f'f{args.ab_f}_i{args.ab_i}'}
+                if leg == 'lowrank':
+                    row['inv_lowrank_rank'] = args.ab_lowrank_rank
+                    row['inv_lowrank_dim_threshold'] = (
+                        args.ab_lowrank_threshold or 2 * d)
                 try:
                     out = subprocess.run(cmd, capture_output=True,
                                          text=True, timeout=7200,

@@ -367,6 +367,98 @@ def lm_approx_rows(args):
         emit(row)
 
 
+def lm_lowrank_rows(args):
+    """Per-firing decomposition cost of the ENGAGED (transformer-FFN)
+    factor bucket: exact eigh vs damped Cholesky vs r19 low-rank.
+
+    For each ``--lm-d`` rung: build the rung's engaged factor stack —
+    the ``(2, 4d, 4d)`` Wishart-class SPD bucket the config-4
+    transformer's two FFN G-factors form — and time one firing of it
+    under each backend:
+
+      ``eigh``      the exact eigendecomposition (the reference eigen
+                    path and the r19 parity oracle);
+      ``cholesky``  the damped Cholesky inverse (today's 'auto'
+                    large-dim dispatch);
+      ``lowrank``   ``batched_lowrank_eigh`` in the WARM steady state
+                    (the carried basis rides the chained carry, so
+                    every timed call is the subspace-refresh +
+                    projected-polish program a real firing runs).
+
+    ``eigh_over_lowrank`` is the "per-firing decomposition cost
+    reduced >= 3x vs exact eigh" acceptance number (PERF.md r19);
+    ``cholesky_over_lowrank`` is the win over the current large-dim
+    default. The whole-model firing (which dilutes both with the
+    unchanged small-dim eigen work) rides in ``flagship_lm.py`` /
+    ``firing_spread.py --lowrank``; quality in
+    ``flagship_lm.py --lowrank-ab``.
+    """
+    import jax.numpy as jnp
+
+    from distributed_kfac_pytorch_tpu.ops import (
+        linalg,
+        pallas_kernels,
+    )
+
+    for d in args.lm_d:
+        dim = 4 * d
+        rng = jax.random.PRNGKey(7)
+        xs = jax.random.normal(rng, (2, 2 * dim, dim), jnp.float32)
+        stack = (jnp.einsum('bni,bnj->bij', xs, xs) / (2 * dim)
+                 + 1e-3 * jnp.eye(dim))
+        row = {'phase': 'lm_lowrank_firing_cost', 'd_model': d,
+               'engaged_dim': dim, 'stack': 2,
+               'inv_lowrank_rank': args.lowrank_rank,
+               'backend': jax.default_backend()}
+
+        def timed(run, carry, leg):
+            return round(B.time_chained(run, carry, 1, repeats=3,
+                                        leg=f'lm{d}_lowrank_{leg}'),
+                         2)
+
+        def run_eigh(carry):
+            s, t = carry
+            qs, ds = jax.vmap(jnp.linalg.eigh)(s + t * 1e-6)
+            return (s, t + 1), jnp.sum(ds).astype(jnp.float32)
+
+        def run_chol(carry):
+            s, t = carry
+            inv = pallas_kernels.damped_inverse_stack(
+                s + t * 1e-6, 0.003, 'cholesky')
+            return (s, t + 1), jnp.sum(inv[:, 0, :]).astype(
+                jnp.float32)
+
+        def run_lowrank(carry):
+            s, t, q = carry
+            qs, ds = linalg.batched_lowrank_eigh(
+                s + t * 1e-6, args.lowrank_rank, q_prev=q)
+            return (s, t + 1, qs), jnp.sum(ds).astype(jnp.float32)
+
+        # t*1e-6 perturbs the input each chained call so no backend
+        # can cache a repeated decomposition out of the timed window.
+        # kfaclint: waive[retrace-jit-in-loop] per-rung bench harness: one program per (rung, backend) row
+        jit_eigh = jax.jit(run_eigh)
+        # kfaclint: waive[retrace-jit-in-loop] per-rung bench harness: one program per (rung, backend) row
+        jit_chol = jax.jit(run_chol)
+        # kfaclint: waive[retrace-jit-in-loop] per-rung bench harness: one program per (rung, backend) row
+        jit_lowrank = jax.jit(run_lowrank)
+        row['firing_eigh_ms'] = timed(
+            jit_eigh, (stack, jnp.float32(0)), 'eigh')
+        row['firing_cholesky_ms'] = timed(
+            jit_chol, (stack, jnp.float32(0)), 'cholesky')
+        q0 = jnp.broadcast_to(jnp.eye(dim, args.lowrank_rank),
+                              (2, dim, args.lowrank_rank))
+        row['firing_lowrank_ms'] = timed(
+            jit_lowrank, (stack, jnp.float32(0), q0), 'lowrank')
+        if row['firing_lowrank_ms'] > 0:
+            row['eigh_over_lowrank'] = round(
+                row['firing_eigh_ms'] / row['firing_lowrank_ms'], 2)
+            row['cholesky_over_lowrank'] = round(
+                row['firing_cholesky_ms'] / row['firing_lowrank_ms'],
+                2)
+        emit(row)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument('--iters', type=int, default=30)
@@ -379,9 +471,16 @@ def main(argv=None):
                    help='r13 per-approx factor-update cost rows on the '
                         'LM ladder (expand vs reduce; skips the CIFAR '
                         'phase decomposition)')
+    p.add_argument('--lm-lowrank', action='store_true',
+                   help='r19 per-firing decomposition-cost rows on '
+                        'the LM ladder (exact dispatch vs randomized '
+                        'low-rank on the FFN dims; skips the CIFAR '
+                        'phase decomposition)')
+    p.add_argument('--lowrank-rank', type=int, default=64,
+                   help='--lm-lowrank truncation rank')
     p.add_argument('--lm-d', type=int, nargs='+',
                    default=[512, 1024, 2048],
-                   help='--lm-approx d_model rungs')
+                   help='--lm-approx / --lm-lowrank d_model rungs')
     p.add_argument('--lm-seq', type=int, default=128)
     p.add_argument('--lm-batch', type=int, default=4)
     p.add_argument('--lm-vocab', type=int, default=512)
@@ -389,6 +488,9 @@ def main(argv=None):
 
     if args.lm_approx:
         return lm_approx_rows(args)
+
+    if args.lm_lowrank:
+        return lm_lowrank_rows(args)
 
     on_tpu = jax.default_backend() == 'tpu'
     if on_tpu:

@@ -29,8 +29,12 @@ family              rules
                     literals.
 ``dtype``           ``dtype-matmul-accum`` — a matmul whose operands
                     are syntactically bf16-flavored (``bfloat16`` /
-                    ``*compute_dtype*`` / ``*bf16*`` names) must pin
-                    fp32 accumulation via ``preferred_element_type``
+                    ``*compute_dtype*`` / ``*bf16*`` names) or part
+                    of the r19 randomized low-rank sketch pipeline
+                    (``*sketch*`` / ``*lowrank*`` names — the basis
+                    products that must not silently accumulate in a
+                    reduced-precision backend default) must pin fp32
+                    accumulation via ``preferred_element_type``
                     (the r6 bf16-pipeline contract).
 ==================  =====================================================
 
@@ -123,7 +127,7 @@ _STATIC_PREDICATES = frozenset({
 _MATMUL_FUNCS = frozenset({
     'matmul', 'dot', 'einsum', 'tensordot', 'dot_general'})
 
-_BF16_NAME = re.compile(r'bfloat16|bf16|compute_dtype')
+_BF16_NAME = re.compile(r'bfloat16|bf16|compute_dtype|sketch|lowrank')
 
 #: hot-path module patterns (package-relative posix paths) the
 #: host-sync and dtype families are scoped to.

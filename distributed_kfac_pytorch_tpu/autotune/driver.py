@@ -208,6 +208,10 @@ def kfac_overrides(knobs: dict) -> tuple[dict, int | None, list[str]]:
             kwargs['eigh_polish_iters'] = int(value)
         elif name == 'kfac_approx':
             kwargs['kfac_approx'] = str(value)
+        elif name == 'inv_lowrank_rank':
+            kwargs['inv_lowrank_rank'] = int(value)
+        elif name == 'inv_lowrank_dim_threshold':
+            kwargs['inv_lowrank_dim_threshold'] = int(value)
         elif name == 'kfac_inv_update_freq':
             inv_freq = int(value)
         elif name in ('deferred_factor_reduction', 'inv_staleness'):
@@ -266,6 +270,20 @@ def tune(workload_name: str, *, out: str | None = None,
                            'kfac_approx': ['expand']}
         log(f'autotune[{workload_name}]: kfac_approx knob dropped '
             '(workload has no weight-shared layers; reduce == expand)')
+    if (workload.max_factor_dim
+            and workload.max_factor_dim
+            < base_cfg.inv_lowrank_dim_threshold
+            and 'inv_lowrank_rank' not in (space_overrides or {})):
+        # No factor dim can reach the engagement threshold -> every
+        # rank value compiles the identical exact-dispatch program;
+        # probing them would pad the table with duplicates. An
+        # explicit override (e.g. probing a lowered threshold) wins.
+        space_overrides = {**(space_overrides or {}),
+                           'inv_lowrank_rank': [0]}
+        log(f'autotune[{workload_name}]: inv_lowrank_rank knob '
+            f'dropped (max factor dim {workload.max_factor_dim} < '
+            f'threshold {base_cfg.inv_lowrank_dim_threshold}; the '
+            'low-rank path cannot engage)')
     space = space_mod.default_space(space_overrides)
 
     if mesh is None:

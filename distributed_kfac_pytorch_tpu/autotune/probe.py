@@ -58,6 +58,12 @@ class Workload:
     # resolves to the identical program as 'expand', and probing both
     # would double the candidate table for zero information.
     weight_shared: bool = False
+    # Largest dense factor dim of the probe model. Lets the driver
+    # drop the r19 inv_lowrank_rank knob when no dim can reach the
+    # engagement threshold (the knob is then a literal no-op: every
+    # rank value compiles the identical exact-dispatch program).
+    # 0 = unknown, keep the knob.
+    max_factor_dim: int = 0
 
 
 def _lm_loss(out, batch):
@@ -86,7 +92,9 @@ def _make_flagship_lm() -> Workload:
                     batch_size=batch,
                     model_kwargs_fn=lambda b: {'train': False},
                     init_kwargs={'train': False},
-                    weight_shared=True)
+                    weight_shared=True,
+                    # tiny d32: FFN 128/129 are the largest dims.
+                    max_factor_dim=129)
 
 
 def _make_cifar_resnet20() -> Workload:
@@ -107,7 +115,9 @@ def _make_cifar_resnet20() -> Workload:
                     make_model=lambda: cifar_resnet.get_model(
                         'resnet20'),
                     make_batch=make_batch, loss_fn=loss,
-                    batch_size=batch, mutable_cols=('batch_stats',))
+                    batch_size=batch, mutable_cols=('batch_stats',),
+                    # resnet20: 3x3x64+1 = 577 is the largest dim.
+                    max_factor_dim=577)
 
 
 def _make_tiny_mlp() -> Workload:
@@ -133,7 +143,9 @@ def _make_tiny_mlp() -> Workload:
 
     return Workload(name='tiny_mlp', make_model=TinyMLP,
                     make_batch=make_batch, loss_fn=loss,
-                    batch_size=batch)
+                    batch_size=batch,
+                    # d0 A-side 8+1, G 16; head G 8: max is 17.
+                    max_factor_dim=17)
 
 
 WORKLOADS: dict[str, Callable[[], Workload]] = {

@@ -89,8 +89,25 @@ def _bf16_dispatch_supported(cfg: dict) -> bool:
         None, 'auto', 'eigen', 'cholesky', 'newton')
 
 
+def _lowrank_rank_valid(cfg: dict) -> bool:
+    # The runtime constraint is rank < every ENGAGED dim; engaged dims
+    # are >= inv_lowrank_dim_threshold, so rank < threshold is the
+    # config-level proxy that guarantees validity on any model —
+    # pruned here so a construction error is never probed. rank 0 =
+    # knob off, always valid.
+    rank = int(cfg.get('inv_lowrank_rank', 0) or 0)
+    if rank == 0:
+        return True
+    thr = int(cfg.get('inv_lowrank_dim_threshold', 2048) or 0)
+    return rank > 0 and thr >= 2 and rank < thr
+
+
 #: constraints every candidate must satisfy regardless of the space.
 BASE_CONSTRAINTS = (
+    Constraint('inv_lowrank_rank must be 0 (off) or positive and '
+               'below inv_lowrank_dim_threshold (>= 2), so the rank '
+               'is below every engaged factor dim',
+               _lowrank_rank_valid),
     Constraint('inv_pipeline_chunks must divide kfac_inv_update_freq',
                _divides_inv_freq),
     Constraint('bf16_precond requires a dispatch branch that supports '
@@ -173,6 +190,13 @@ def default_space(overrides: dict[str, Sequence] | None = None
              'chunk-fire decompositions of the frozen window-head '
              'snapshot across plain steps — convergence-gated like '
              'the r9 chunk knob'),
+        Knob('inv_lowrank_rank', (0, 128),
+             'randomized truncated-eigendecomposition rank for large '
+             'factor dims (r19, arXiv:2206.15397): rank-r sketch + '
+             'warm subspace polish at r*d^2 instead of the O(d^3) '
+             'exact firing; engages only on dims >= '
+             'inv_lowrank_dim_threshold, a no-op on workloads without '
+             'transformer-scale factors'),
     ]
     if overrides:
         unknown = set(overrides) - {k.name for k in stock}

@@ -113,10 +113,13 @@ def gather_canonical(inv_stacks: dict, assignment) -> dict:
 def _pad_stack(key: str, n_slots: int, shape: tuple, dtype) -> np.ndarray:
     """Padding slots seeded exactly like ``init_state``: identity
     eigenbases / unit eigenvalues (a valid warm start for the polish),
-    zero dense inverses."""
+    zero dense inverses. A truncated (dim, r) basis (r19 low-rank)
+    pads with the rectangular identity-column seed — assigned slots
+    carry their saved bases across the reshard unchanged."""
     if key == 'Q':
-        dim = shape[-1]
-        return np.broadcast_to(np.eye(dim, dtype=dtype),
+        # np.eye(N, M): rectangular for truncated bases, square else.
+        return np.broadcast_to(np.eye(shape[-2], shape[-1],
+                                      dtype=dtype),
                                (n_slots,) + shape).copy()
     if key == 'd':
         return np.ones((n_slots,) + shape, dtype)
@@ -194,16 +197,28 @@ def _stacks_match_config(inv_stacks: dict, dkfac) -> bool:
     sets are functions of (model, K-FAC config) — NOT of topology —
     so a mismatch here means the run configuration changed, which is
     rebuild-from-factors territory, not reshard territory."""
+    from distributed_kfac_pytorch_tpu.preconditioner import eigen_family
     kfac = dkfac.kfac
     expected = {}
     for dim in dkfac.assignment.buckets:
-        if kfac.method_for_dim(dim) == 'eigen':
+        if eigen_family(kfac.method_for_dim(dim)):
             keys = {'Q', 'd'}
             if dkfac._bucket_mixed.get(dim):
                 keys.add('inv')
         else:
             keys = {'inv'}
         expected[str(dim)] = keys
+    # r19: a low-rank basis saved at a DIFFERENT rank shares the Q/d
+    # key names; the per-slot column count must also line up or the
+    # repacked stacks feed wrong-shape operands to the firing —
+    # rebuild from factors instead (reseed, not carry).
+    for dim in dkfac.assignment.buckets:
+        entry = inv_stacks.get(str(dim))
+        if not entry or 'Q' not in entry:
+            continue
+        rank = kfac.lowrank_rank_for(dim) or dim
+        if tuple(np.shape(entry['Q']))[-2:] != (dim, rank):
+            return False
     return {k: set(v) for k, v in inv_stacks.items()} == expected
 
 

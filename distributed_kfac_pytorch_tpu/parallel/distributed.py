@@ -70,6 +70,7 @@ from distributed_kfac_pytorch_tpu.preconditioner import (
     KFAC,
     CommMethod,
     cadence_gate,
+    eigen_family,
     grouped_block_inverses,
     guard_nonfinite_factors,
     q_stack_degenerate,
@@ -358,15 +359,16 @@ class DistributedKFAC:
             name: L.factor_shapes(spec, _get(params, spec.path))
             for name, spec in kfac.specs.items()}
         self._precond_groups = self._plan_precond_groups()
-        # Eigen-type dim buckets that hold at least one *mixed* layer's
-        # eigen side additionally carry a firing-time-baked dense
-        # inverse stack (see _spmd_update_inverses / KFAC.
-        # update_inverses for the timing-semantics rationale).
+        # Eigen-family dim buckets (exact eigen AND r19 low-rank) that
+        # hold at least one *mixed* layer's side additionally carry a
+        # firing-time-baked dense inverse stack (see
+        # _spmd_update_inverses / KFAC.update_inverses for the
+        # timing-semantics rationale).
         self._bucket_mixed = {
             dim: any(self._layer_is_mixed(name)
                      for (name, _w) in plan.slot)
             for dim, plan in self.assignment.buckets.items()
-            if kfac.method_for_dim(dim) == 'eigen'}
+            if eigen_family(kfac.method_for_dim(dim))}
         # Pipelined inverse firing (inv_pipeline_chunks > 1): static
         # chunk plan over within-slice slot offsets; None at k == 1.
         self._chunk_plan = self._plan_firing_chunks()
@@ -414,8 +416,12 @@ class DistributedKFAC:
         items: list[tuple[tuple, float]] = []
         for dim in sorted(self.assignment.buckets):
             plan = self.assignment.buckets[dim]
+            # r19: low-rank buckets fire at r·dim^2, not dim^3 (same
+            # rank-aware model as the single-chip planner).
             unit = (float(measured[dim]) / plan.slots_per_col
-                    if dim in measured else decomposition_cost(dim))
+                    if dim in measured
+                    else decomposition_cost(
+                        dim, rank=kfac.lowrank_rank_for(dim)))
             for m in range(plan.slots_per_col):
                 items.append((('slot', dim, m), unit))
         for name in self.assignment.diag_layers:
@@ -454,13 +460,14 @@ class DistributedKFAC:
         return {'offsets': offsets, 'diag': diag, 'grouped': grouped}
 
     def _layer_is_mixed(self, name: str) -> bool:
-        """Dense layer with exactly one eigen side ('auto' straddle)."""
+        """Dense layer with exactly one eigen-family side (an 'auto'
+        straddle, or a low-rank side paired with a baked one)."""
         spec = self.kfac.specs[name]
         if spec.kind in (EMBEDDING, CONV2D_GROUPED):
             return False
         a_dim, g_dim = self._factor_dims[name]
-        return ((self.kfac.method_for_dim(a_dim) == 'eigen')
-                != (self.kfac.method_for_dim(g_dim) == 'eigen'))
+        return (eigen_family(self.kfac.method_for_dim(a_dim))
+                != eigen_family(self.kfac.method_for_dim(g_dim)))
 
     def _plan_precond_groups(self):
         """Static plan for the row-sharded precondition compute.
@@ -520,21 +527,29 @@ class DistributedKFAC:
         for dim, plan in self.assignment.buckets.items():
             n_slots = self.n_rows * plan.slots_per_row
             # Buckets are dim-homogeneous, so the per-dim dispatch
-            # ('auto': eigen below the cutoff, damped inverse above —
+            # ('auto': eigen below the cutoff, damped inverse above,
+            # r19 low-rank at/above the engaged threshold —
             # KFAC.method_for_dim) picks each bucket's representation
             # wholesale; global modes make every bucket the same.
-            if self.kfac.method_for_dim(dim) == 'eigen':
+            method = self.kfac.method_for_dim(dim)
+            if eigen_family(method):
                 # Identity bases / unit eigenvalues: the exact
                 # eigendecomposition of the identity-seeded factors, and
                 # a valid warm start for the eigh_method='auto' polish
-                # from step 0 (see KFAC.init_state).
+                # from step 0 (see KFAC.init_state). Low-rank buckets
+                # carry a RECTANGULAR (dim, r) identity-column basis —
+                # orthonormal columns, valid for the subspace-refresh
+                # + polish from step 0.
+                r = (self.kfac.inv_lowrank_rank if method == 'lowrank'
+                     else dim)
                 stacks[str(dim)] = {
-                    'Q': jnp.broadcast_to(jnp.eye(dim, dtype=idt),
-                                          (n_slots, dim, dim)),
-                    'd': jnp.ones((n_slots, dim), idt)}
+                    'Q': jnp.broadcast_to(jnp.eye(dim, r, dtype=idt),
+                                          (n_slots, dim, r)),
+                    'd': jnp.ones((n_slots, r), idt)}
                 if self._bucket_mixed.get(dim):
                     # Baked per-side damped inverses for mixed layers'
-                    # eigen sides (zero-seeded; step 0 fires first).
+                    # eigen-family sides (zero-seeded; step 0 fires
+                    # first).
                     stacks[str(dim)]['inv'] = jnp.zeros(
                         (n_slots, dim, dim), idt)
             else:
@@ -980,18 +995,29 @@ class DistributedKFAC:
                     cur[key] = cur[key].at[idx].set(g)
 
                 local = fired_factors()
-                if bucket_method == 'eigen':
+                if eigen_family(bucket_method):
                     q_prev = None
-                    if prev_entry is not None and eigh_method == 'auto':
+                    if prev_entry is not None and (
+                            bucket_method == 'lowrank'
+                            or eigh_method == 'auto'):
                         # Inside shard_map the stored stack is the
                         # *local* row shard (slots_per_row, dim, dim):
                         # index by the in-row column offset only
-                        # (local_slots does).
+                        # (local_slots does). Low-rank warm starts are
+                        # NOT gated on eigh_method — the carried
+                        # truncated basis IS the low-rank state.
                         q_prev = local_slots(
                             prev_entry['Q'].astype(jnp.float32))
-                    q, d = linalg.batched_eigh(
-                        local, eigh_method, clip=0.0, q_prev=q_prev,
-                        polish_iters=kfac.eigh_polish_iters)
+                    if bucket_method == 'lowrank':
+                        q, d = linalg.batched_lowrank_eigh(
+                            local, kfac.inv_lowrank_rank,
+                            q_prev=q_prev,
+                            polish_iters=kfac.eigh_polish_iters)
+                    else:
+                        q, d = linalg.batched_eigh(
+                            local, eigh_method, clip=0.0,
+                            q_prev=q_prev,
+                            polish_iters=kfac.eigh_polish_iters)
                     if self._bucket_mixed.get(dim):
                         # Bake this firing's damping into the mixed
                         # layers' eigen sides (whole group for vmap
@@ -1051,14 +1077,14 @@ class DistributedKFAC:
         if spec.kind != EMBEDDING:
             plan = self.assignment.buckets[a_dim]
             sl = plan.slot[(name, 'A')]
-            if kfac.method_for_dim(a_dim) == 'eigen' and not mixed:
+            if eigen_family(kfac.method_for_dim(a_dim)) and not mixed:
                 out['QA'] = inv_stacks[str(a_dim)]['Q'][sl]
                 out['dA'] = inv_stacks[str(a_dim)]['d'][sl]
             else:
                 out['A_inv'] = inv_stacks[str(a_dim)]['inv'][sl]
         plan = self.assignment.buckets[g_dim]
         sl = plan.slot[(name, 'G')]
-        if kfac.method_for_dim(g_dim) == 'eigen' and not mixed:
+        if eigen_family(kfac.method_for_dim(g_dim)) and not mixed:
             out['QG'] = inv_stacks[str(g_dim)]['Q'][sl]
             out['dG'] = inv_stacks[str(g_dim)]['d'][sl]
         else:
@@ -1117,8 +1143,11 @@ class DistributedKFAC:
                 row, [make_branch(r) for r in range(self.n_rows)])
             # Mixed-ness is uniform per group (a function of the dim
             # pair): split groups gather baked inverses for both sides.
-            a_eig = kfac.method_for_dim(a_dim) == 'eigen'
-            g_eig = kfac.method_for_dim(g_dim) == 'eigen'
+            # Eigen-family covers the r19 low-rank buckets too — their
+            # rectangular Q/d gather exactly the same way (the group's
+            # rank is uniform because its dims are).
+            a_eig = eigen_family(kfac.method_for_dim(a_dim))
+            g_eig = eigen_family(kfac.method_for_dim(g_dim))
             entry = {}
             if a_eig and g_eig:
                 entry['QA'] = inv_stacks[str(a_dim)]['Q'][my_a]

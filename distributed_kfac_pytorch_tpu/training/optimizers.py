@@ -56,6 +56,17 @@ class OptimConfig:
     # measured v5e crossover; see PERF.md round 4).
     auto_eigen_max_dim: int = 640
     auto_large_method: str = 'cholesky'
+    # Randomized low-rank inverse path (r19, arXiv:2206.15397): with
+    # rank > 0, dense factor dims >= inv_lowrank_dim_threshold
+    # decompose as a rank-r truncated eigenpair (Gaussian range-finder
+    # sketch, warm subspace-refresh + polish each firing — r·d^2
+    # matmul work instead of the O(d^3) eigh/cholesky wall) and
+    # precondition through the truncated basis plus the damping-only
+    # tail complement (full-rank correct). 0 (default) = off, the
+    # bit-identical exact path. rank must be < every engaged dim
+    # (hard error at registration, never a silent fallback).
+    inv_lowrank_rank: int = 0
+    inv_lowrank_dim_threshold: int = 2048
     # 'auto' (default): warm-start basis polish seeded from the state's
     # previous eigenbasis (the TPU fast path — see ops.linalg.eigh_polish);
     # 'xla' | 'jacobi' | 'warm' as in KFAC.
@@ -145,6 +156,8 @@ TUNABLE_FIELDS = (
     'kfac_inv_update_freq',
     'eigh_polish_iters',
     'kfac_approx',
+    'inv_lowrank_rank',
+    'inv_lowrank_dim_threshold',
 )
 
 
@@ -223,6 +236,8 @@ def get_optimizer(model, cfg: OptimConfig):
             inverse_method=cfg.inverse_method,
             auto_eigen_max_dim=cfg.auto_eigen_max_dim,
             auto_large_method=cfg.auto_large_method,
+            inv_lowrank_rank=cfg.inv_lowrank_rank,
+            inv_lowrank_dim_threshold=cfg.inv_lowrank_dim_threshold,
             eigh_method=cfg.eigh_method,
             eigh_polish_iters=cfg.eigh_polish_iters,
             factor_batch_fraction=cfg.factor_batch_fraction,

@@ -120,6 +120,22 @@ def parse_args(argv=None):
                         '(needs update-freq/chunks >= 2). '
                         'Convergence-gated like --inv-pipeline-chunks '
                         '(PERF.md r14)')
+    p.add_argument('--inv-lowrank-rank', type=int, default=0,
+                   help='rank of the randomized truncated '
+                        'eigendecomposition for large factor dims '
+                        '(r19, arXiv:2206.15397): dims >= '
+                        '--inv-lowrank-dim-threshold fire a rank-r '
+                        'sketch + warm subspace polish (r*d^2 work) '
+                        'instead of the O(d^3) exact decomposition; '
+                        'preconditioning adds the damping-only tail '
+                        'complement so it stays full-rank correct. '
+                        '0 (default) = off, the bit-identical exact '
+                        'path; rank >= an engaged dim is a hard error')
+    p.add_argument('--inv-lowrank-dim-threshold', type=int,
+                   default=2048,
+                   help='smallest dense factor dim the low-rank path '
+                        'engages (transformer-scale factors by '
+                        'default; ignored at --inv-lowrank-rank 0)')
     p.add_argument('--kfac-cov-update-freq', type=int, default=10)
     p.add_argument('--kfac-approx', default='expand',
                    choices=['expand', 'reduce'],
@@ -282,6 +298,8 @@ def main(argv=None):
         deferred_factor_reduction=args.deferred_factor_reduction,
         inv_staleness=args.inv_staleness,
         kfac_approx=args.kfac_approx,
+        inv_lowrank_rank=args.inv_lowrank_rank,
+        inv_lowrank_dim_threshold=args.inv_lowrank_dim_threshold,
         damping=args.damping, factor_decay=args.stat_decay,
         kl_clip=args.kl_clip, inverse_method=args.inverse_method,
         eigh_method=args.eigh_method,

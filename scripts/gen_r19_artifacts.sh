@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# r19 artifact generation (CPU provenance — see PERF.md r19): the
+# randomized low-rank inverse evidence set. Rerun on v5e before
+# promoting the knob (decision rule: PERF.md r19).
+#   BENCH_r19_LOWRANK.json          firing_spread exact-vs-lowrank
+#       legs on the CPU-scaled config-4 d512/L8 workload (window
+#       inverse cost + spike ratio)
+#   FLAGSHIP_LM_r19_LOWRANK.jsonl   per-rung loss curves, exact vs
+#       rank-64 engaged on the rung's FFN dims (threshold 2*d)
+#   step_breakdown --lm-lowrank     engaged-bucket per-firing cost
+#       rows (exact eigh vs Cholesky vs warm low-rank) — printed, the
+#       eigh_over_lowrank number is quoted in PERF.md r19
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# 1) Window-level firing-spread A/B (monolithic k=1 both legs).
+JAX_PLATFORMS=cpu python benchmarks/firing_spread.py --lowrank \
+    --windows 3 --inv-update-freq 8 \
+    --lowrank-rank 64 --lowrank-dim-threshold 1024 \
+    --out BENCH_r19_LOWRANK.json
+
+# 2) LM convergence ladder (identical hyperparameters per rung).
+JAX_PLATFORMS=cpu python benchmarks/flagship_lm.py --lowrank-ab \
+    --ladder 256 512 --ab-steps 60 --ab-lowrank-rank 64 \
+    > FLAGSHIP_LM_r19_LOWRANK.jsonl.tmp
+mv FLAGSHIP_LM_r19_LOWRANK.jsonl.tmp FLAGSHIP_LM_r19_LOWRANK.jsonl
+
+# 3) Engaged-bucket decomposition cost (quoted in PERF.md r19).
+JAX_PLATFORMS=cpu python benchmarks/step_breakdown.py --lm-lowrank \
+    --lm-d 512 1024 --lowrank-rank 64
+
+echo "r19 artifacts written: BENCH_r19_LOWRANK.json" \
+     "FLAGSHIP_LM_r19_LOWRANK.jsonl"

@@ -1,0 +1,96 @@
+#!/usr/bin/env bash
+# Fast-tier randomized low-rank inverse smoke (r19): the knob end to
+# end on CPU through the REAL LM entry point —
+#   1. one tiny synthetic-corpus epoch with --inv-lowrank-rank engaged
+#      on the model's FFN factor dims, under the full runtime
+#      sanitizer (KFAC_SANITIZE=transfer,nan,retrace), metrics sink
+#      on; assert the stream shows inverse firings, finite losses and
+#      ZERO retrace events with the truncated path live;
+#   2. observability-gate self-check over the stream (the CI plumbing
+#      path, like overlap_smoke.sh's leg 2);
+#   3. fail-closed leg: --inv-lowrank-rank at/above an engaged factor
+#      dim must exit nonzero with an error NAMING the rank knob —
+#      never a silent fallback to the exact path.
+# The same contracts are pinned in tests/test_lowrank.py; this wrapper
+# is the standalone/CI-pipeline form (see overlap_smoke.sh).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="$(mktemp -d)"
+trap 'rm -rf "$out"' EXIT
+
+run_lm() {  # $1 = leg name, extra args follow
+    local leg="$1"; shift
+    JAX_PLATFORMS=cpu KFAC_COMPILE_CACHE=0 KFAC_SYNTHETIC_LM=2048 \
+    python examples/train_language_model.py \
+        --arch transformer --emsize 64 --nlayers 1 --nheads 2 \
+        --bptt 16 --batch-size 4 --epochs 1 --no-resume \
+        --kfac-update-freq 4 \
+        --log-dir "$out/logs-$leg" --checkpoint-dir "$out/ckpt-$leg" \
+        "$@"
+}
+
+# Leg 1: low-rank engaged (FFN dims 256/257 >= threshold 128, rank 16)
+# under the full sanitizer, metrics at interval 1.
+KFAC_SANITIZE=transfer,nan,retrace \
+run_lm lowrank \
+    --inv-lowrank-rank 16 --inv-lowrank-dim-threshold 128 \
+    --kfac-metrics "$out/lowrank.jsonl" --metrics-interval 1
+
+python - "$out/lowrank.jsonl" <<'EOF'
+import math
+import sys
+
+from distributed_kfac_pytorch_tpu.observability import sink as obs_sink
+
+path = sys.argv[1]
+records, _ = obs_sink.read_jsonl_tolerant(path)
+steps = [r for r in records if r.get('kind') == 'step']
+assert steps, 'no step records in the metrics stream'
+fired = [r.get('fired') for r in steps]
+assert 'inverse' in fired, fired        # truncated firings actually ran
+assert all(math.isfinite(float(r['loss'])) for r in steps
+           if 'loss' in r), 'non-finite loss with low-rank engaged'
+retraces = [r for r in records if r.get('event') == 'retrace']
+assert not retraces, retraces           # zero retraces, knob live
+inv_firings = [r for r in steps
+               if r.get('fired') == 'inverse']
+assert inv_firings, fired
+print(f'low-rank firing stages OK ({len(inv_firings)} firings over '
+      f'{len(steps)} steps, zero retraces)')
+EOF
+
+# Leg 2: gate self-check (stream is gate-clean against itself).
+python -m distributed_kfac_pytorch_tpu.observability.gate \
+    "$out/lowrank.jsonl" --write-baseline "$out/B.json"
+python -m distributed_kfac_pytorch_tpu.observability.gate \
+    "$out/lowrank.jsonl" --baseline "$out/B.json" --allow-missing \
+    --json > "$out/gate.json"
+python - "$out/gate.json" <<'EOF'
+import json, sys
+v = json.load(open(sys.argv[1]))
+assert v['pass'] is True, v
+print('gate self-check OK')
+EOF
+
+# Leg 3: fail-closed — rank >= the engaged dim (FFN 256) must be a
+# loud registration error naming the knob, not a silent exact-path
+# fallback.
+set +e
+KFAC_SANITIZE=transfer,nan,retrace \
+run_lm badrank \
+    --inv-lowrank-rank 1024 --inv-lowrank-dim-threshold 128 \
+    > "$out/badrank.log" 2>&1
+rc=$?
+set -e
+if [ "$rc" -eq 0 ]; then
+    echo 'FAIL: rank >= engaged dim did not error' >&2
+    exit 1
+fi
+grep -q 'inv_lowrank_rank' "$out/badrank.log" || {
+    echo 'FAIL: error does not name inv_lowrank_rank' >&2
+    tail -5 "$out/badrank.log" >&2
+    exit 1
+}
+echo "fail-closed rank leg OK (rc=$rc, error names the knob)"
+echo 'lowrank_smoke: all legs OK'

@@ -1,0 +1,13 @@
+"""Seeded r19 dtype violations: randomized low-rank sketch matmuls
+without fp32 accumulation pinned (the range-finder products feed the
+carried eigenbasis — a reduced-precision backend default here degrades
+every subsequent firing's warm start)."""
+import jax.numpy as jnp
+
+
+def rangefinder(a, key_noise):
+    lowrank_sketch = key_noise
+    y = jnp.matmul(a, lowrank_sketch)              # dtype-matmul-accum
+    b = jnp.einsum('ir,ij,js->rs', y,
+                   a, lowrank_sketch)              # dtype-matmul-accum
+    return y, b

@@ -1,0 +1,14 @@
+"""The same sketch matmuls with the contract honored: every
+range-finder product pins fp32 accumulation (the shape of the real
+call sites in ops.linalg.lowrank_eigh)."""
+import jax.numpy as jnp
+
+
+def rangefinder(a, key_noise):
+    lowrank_sketch = key_noise
+    y = jnp.matmul(a, lowrank_sketch,
+                   preferred_element_type=jnp.float32)
+    b = jnp.einsum('ir,ij,js->rs', y, a, lowrank_sketch,
+                   preferred_element_type=jnp.float32)
+    plain = jnp.matmul(a, a.T)   # no sketch/bf16 flavor: exempt
+    return y, b, plain

@@ -117,6 +117,16 @@ class TestRuleMatrix:
     def test_dtype_negative(self):
         assert active_rules(run_rules('good_dtype.py')) == []
 
+    def test_dtype_lowrank_sketch_positive(self):
+        # r19: the randomized-sketch matmul call sites are covered by
+        # the same accumulation-pinning contract as the bf16 pipeline.
+        findings = run_rules('bad_dtype_lowrank.py')
+        assert active_rules(findings) == ['dtype-matmul-accum']
+        assert len(findings) == 2
+
+    def test_dtype_lowrank_sketch_negative(self):
+        assert active_rules(run_rules('good_dtype_lowrank.py')) == []
+
     def test_surface_positive(self):
         findings, skipped = surface.check_surface(
             FIXTURES / 'surface_pkg_bad',

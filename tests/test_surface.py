@@ -48,6 +48,8 @@ SAMPLE_VALUES = {
     'kfac_inv_update_freq': 4,
     'eigh_polish_iters': 4,
     'kfac_approx': 'reduce',
+    'inv_lowrank_rank': 64,
+    'inv_lowrank_dim_threshold': 256,
 }
 
 
