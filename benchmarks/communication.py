@@ -84,7 +84,7 @@ def run_multihost(out_path: str) -> None:
     out_path = os.path.abspath(out_path)
     env = {**os.environ, 'PYTHONPATH': repo}
     results = {}
-    for mode in ('comm', 'comm_flagship'):
+    for mode in ('comm', 'comm_flagship', 'comm_hier'):
         with socket.socket() as s:
             s.bind(('localhost', 0))
             port = s.getsockname()[1]
@@ -114,6 +114,16 @@ def run_multihost(out_path: str) -> None:
                  'ICI/DCN asymmetry'),
         'gw_intra_process': results['comm_flagship']['gw_intra_process'],
         'gw_cross_process': results['comm_flagship']['gw_cross_process'],
+    }
+    merged['hierarchical'] = {
+        'note': ('r20 two-level factor reduction on a 2-slice nested '
+                 'mesh whose slice boundary is the process boundary '
+                 '(gloo = DCN stand-in): flat = one global pmean per '
+                 'factor step; hierarchical = on-slice pmean per step '
+                 '+ one cross-slice pmean per r14 window. Decision '
+                 'rule (PERF.md r20): hierarchical wins a W-step '
+                 'window when W*intra + dcn < W*flat'),
+        'slice_per_process': results['comm_hier']['slice_per_process'],
     }
     with open(out_path, 'w') as f:
         json.dump(merged, f, indent=1)

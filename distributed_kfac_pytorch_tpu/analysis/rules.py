@@ -513,7 +513,8 @@ class _RuleVisitor(ast.NodeVisitor):
                         'string literal — use the canonical axis '
                         'constants (parallel.distributed.'
                         'INV_GROUP_AXIS / GRAD_WORKER_AXIS / '
-                        'KFAC_AXES, parallel.sequence.SEQ_AXIS) so '
+                        'KFAC_AXES / SLICE_AXIS, '
+                        'parallel.sequence.SEQ_AXIS) so '
                         'a mesh rename cannot split the collective '
                         'surface')
 

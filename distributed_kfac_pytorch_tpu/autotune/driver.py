@@ -214,10 +214,12 @@ def kfac_overrides(knobs: dict) -> tuple[dict, int | None, list[str]]:
             kwargs['inv_lowrank_dim_threshold'] = int(value)
         elif name == 'kfac_inv_update_freq':
             inv_freq = int(value)
-        elif name in ('deferred_factor_reduction', 'inv_staleness'):
+        elif name in ('deferred_factor_reduction', 'inv_staleness',
+                      'hierarchical_reduce'):
             # Engine-scheduled knobs (window-boundary reduce /
-            # frozen-snapshot chunk phases): a bare-KFAC scan harness
-            # fires monolithically with no factor_reduce/
+            # frozen-snapshot chunk phases / the r20 two-level reduce,
+            # which additionally needs a multi-slice mesh): a bare-KFAC
+            # scan harness fires monolithically with no factor_reduce/
             # factor_snapshot schedule, so constructing with them on
             # would leave the accumulator un-reduced forever. Surfaced
             # as ignored, never silently dropped.

@@ -66,12 +66,15 @@ def saved_assignment(kfac, params, topo: TopologySpec):
     # Validate the recorded grid as a legal KAISA partition first: the
     # allocator is the golden topology spec (reference kfac/utils.py),
     # and a bundle whose rows x cols cannot form one must fail here,
-    # not deep inside the slot math.
+    # not deep inside the slot math. On a multi-slice world the
+    # per-slice grid is the allocator unit; placement then runs over
+    # the GLOBAL row space (slices * rows — each slice owns a
+    # contiguous run of rows, exactly like the live DistributedKFAC).
     alloc = WorkerAllocator.from_grid(topo.rows, topo.cols)
     assert (alloc.inv_groups, alloc.grad_workers) == (topo.rows,
                                                       topo.cols)
     return assign_work(
-        kfac, params, topo.rows, topo.cols,
+        kfac, params, topo.slices * topo.rows, topo.cols,
         distribute_layer_factors=topo.distribute_layer_factors)
 
 

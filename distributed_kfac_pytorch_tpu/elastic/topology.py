@@ -36,7 +36,8 @@ TOPOLOGY_FORMAT = 1
 
 #: scalar keys this module owns inside a bundle's ``scalars`` subtree.
 SCALAR_KEYS = ('topo_format', 'topo_processes', 'topo_devices',
-               'topo_rows', 'topo_cols', 'topo_seq', 'topo_dist_factors')
+               'topo_rows', 'topo_cols', 'topo_seq', 'topo_slices',
+               'topo_dist_factors')
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,23 +45,32 @@ class TopologySpec:
     """The world a checkpoint was saved on (or a live mesh's world).
 
     ``rows``/``cols`` are the KAISA grid — inverse-broadcast groups and
-    grad workers per group (``placement.WorkerAllocator``);
-    ``distribute_layer_factors`` is the *effective* A/G-on-different-
-    columns flag (the ``assign_work`` default resolves ``None`` to
-    ``cols > 1``, so the recorded value is always a concrete bool).
+    grad workers per group (``placement.WorkerAllocator``) — and, on a
+    multi-slice mesh (r20), ``rows`` is the PER-SLICE group count:
+    ``slices`` counts the outer ``kfac_slice`` dimension and the global
+    row space is ``slices * rows``. ``distribute_layer_factors`` is the
+    *effective* A/G-on-different-columns flag (the ``assign_work``
+    default resolves ``None`` to ``cols > 1``, so the recorded value
+    is always a concrete bool). Bundles saved before r20 lack the
+    ``topo_slices`` scalar and default to 1 slice (MIGRATION.md).
     """
     processes: int
     devices: int
     rows: int
     cols: int
     seq: int = 1
+    slices: int = 1
     distribute_layer_factors: bool = True
 
     def __post_init__(self):
-        if self.rows * self.cols * self.seq != self.devices:
+        if self.slices < 1:
+            raise ValueError(f'inconsistent topology: slices '
+                             f'{self.slices} must be >= 1')
+        if self.rows * self.cols * self.seq * self.slices != self.devices:
             raise ValueError(
                 f'inconsistent topology: rows {self.rows} x cols '
-                f'{self.cols} x seq {self.seq} != devices {self.devices}')
+                f'{self.cols} x seq {self.seq} x slices {self.slices} '
+                f'!= devices {self.devices}')
 
     @property
     def layout_key(self) -> tuple:
@@ -70,8 +80,13 @@ class TopologySpec:
         trees (same bucket slot maps, same stack shapes) even when the
         process count or sequence-parallel factor differs — restore
         then needs only the existing sharding re-commit, no reshard.
+        ``assign_work`` places over the GLOBAL row count
+        ``slices * rows``, so slice-count changes that preserve the
+        global row total (e.g. 2 slices x 2 rows -> 1 slice x 4 rows)
+        are layout-preserving too.
         """
-        return (self.rows, self.cols, self.distribute_layer_factors)
+        return (self.slices * self.rows, self.cols,
+                self.distribute_layer_factors)
 
     def needs_reshard(self, other: 'TopologySpec') -> bool:
         return self.layout_key != other.layout_key
@@ -84,6 +99,7 @@ class TopologySpec:
                 'topo_rows': int(self.rows),
                 'topo_cols': int(self.cols),
                 'topo_seq': int(self.seq),
+                'topo_slices': int(self.slices),
                 'topo_dist_factors': int(self.distribute_layer_factors)}
 
     @classmethod
@@ -100,6 +116,8 @@ class TopologySpec:
                    rows=int(scalars['topo_rows']),
                    cols=int(scalars['topo_cols']),
                    seq=int(scalars.get('topo_seq', 1)),
+                   # Pre-r20 bundles predate multi-slice: 1 slice.
+                   slices=int(scalars.get('topo_slices', 1)),
                    distribute_layer_factors=bool(
                        int(scalars['topo_dist_factors'])))
 
@@ -120,6 +138,7 @@ class TopologySpec:
         from distributed_kfac_pytorch_tpu.parallel.distributed import (
             GRAD_WORKER_AXIS,
             INV_GROUP_AXIS,
+            SLICE_AXIS,
         )
         from distributed_kfac_pytorch_tpu.parallel.sequence import (
             SEQ_AXIS,
@@ -128,15 +147,20 @@ class TopologySpec:
         cols = mesh.shape[GRAD_WORKER_AXIS]
         seq = (mesh.shape[SEQ_AXIS]
                if SEQ_AXIS in mesh.axis_names else 1)
+        slices = (mesh.shape[SLICE_AXIS]
+                  if SLICE_AXIS in mesh.axis_names else 1)
         if distribute_layer_factors is None:
             distribute_layer_factors = cols > 1
         return cls(processes=jax.process_count(),
                    devices=int(mesh.devices.size),
                    rows=int(rows), cols=int(cols), seq=int(seq),
+                   slices=int(slices),
                    distribute_layer_factors=bool(
                        distribute_layer_factors))
 
     def describe(self) -> str:
         return (f'{self.devices} device(s) / {self.processes} '
                 f'process(es), KAISA grid {self.rows}x{self.cols}'
-                + (f' x seq {self.seq}' if self.seq > 1 else ''))
+                + (f' x seq {self.seq}' if self.seq > 1 else '')
+                + (f', {self.slices} slice(s)'
+                   if self.slices > 1 else ''))

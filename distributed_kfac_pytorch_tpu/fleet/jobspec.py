@@ -39,6 +39,11 @@ FIELD_MENU = (
     'priority (int, default 0; higher = more urgent), '
     'min_devices (int >= 1, default 1), '
     'max_devices (int >= min_devices, default min_devices), '
+    'min_slices (int >= 1, optional — gang placement: the job only '
+    'runs on whole slices of the pool, never split across a partial '
+    'slice; mutually exclusive with min/max_devices), '
+    'max_slices (int >= min_slices, default min_slices — requires '
+    'min_slices), '
     'tuned_config (str path, optional — appended as --tuned-config '
     'on placement, fail-closed in the child per the r12 contract), '
     'gate_baseline (str path, optional — BASELINE_OBS.json gated '
@@ -52,9 +57,9 @@ FIELD_MENU = (
 )
 
 _REQUIRED = ('name', 'argv')
-_OPTIONAL = ('priority', 'min_devices', 'max_devices', 'tuned_config',
-             'gate_baseline', 'max_restarts', 'keep_faults', 'env',
-             'after_s')
+_OPTIONAL = ('priority', 'min_devices', 'max_devices', 'min_slices',
+             'max_slices', 'tuned_config', 'gate_baseline',
+             'max_restarts', 'keep_faults', 'env', 'after_s')
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,6 +71,8 @@ class JobSpec:
     priority: int = 0
     min_devices: int = 1
     max_devices: int = 1
+    min_slices: int | None = None
+    max_slices: int | None = None
     tuned_config: str | None = None
     gate_baseline: str | None = None
     max_restarts: int = 5
@@ -122,6 +129,23 @@ def parse_job(obj, *, index: int = 0) -> JobSpec:
     if isinstance(priority, bool) or not isinstance(priority, int):
         raise _bad(f'{label}: priority must be an integer, '
                    f'got {priority!r}')
+    min_slices = max_slices = None
+    if 'min_slices' in obj or 'max_slices' in obj:
+        # Gang placement (r20): the job counts in whole slices — the
+        # scheduler translates to devices via its --slice-devices
+        # knob, so a slice job may not ALSO pin device counts (the
+        # two units would silently disagree).
+        if 'min_devices' in obj or 'max_devices' in obj:
+            raise _bad(f'{label}: min/max_slices are mutually '
+                       'exclusive with min/max_devices (a gang job is '
+                       'sized in whole slices only)')
+        if 'min_slices' not in obj:
+            raise _bad(f'{label}: max_slices requires min_slices')
+        min_slices = _int('min_slices', 1, 1)
+        max_slices = _int('max_slices', min_slices, 1)
+        if max_slices < min_slices:
+            raise _bad(f'{label}: max_slices {max_slices} is below '
+                       f'min_slices {min_slices}')
     min_devices = _int('min_devices', 1, 1)
     max_devices = _int('max_devices', min_devices, 1)
     if max_devices < min_devices:
@@ -153,6 +177,7 @@ def parse_job(obj, *, index: int = 0) -> JobSpec:
     return JobSpec(
         name=name, argv=tuple(argv), priority=priority,
         min_devices=min_devices, max_devices=max_devices,
+        min_slices=min_slices, max_slices=max_slices,
         tuned_config=obj.get('tuned_config'),
         gate_baseline=obj.get('gate_baseline'),
         max_restarts=max_restarts, keep_faults=keep_faults,

@@ -32,6 +32,13 @@ Scheduling policy (one **tick** = one ``--poll`` pass):
     admission can shrink a running job, never evict it — full
     preempt-back-to-queue is reserved for pool capacity loss (the
     alternative livelocks; see ``_allocate``).
+  - *Gang placement* (r20): a job declaring ``min_slices`` /
+    ``max_slices`` is sized in whole pool slices of
+    ``--slice-devices`` each — the waterfill grants its minimum and
+    any extras in whole-slice quanta only, never splitting it across
+    a partial slice, and its supervisor learns the slice count so
+    whole-slice failures classify as ``slice_failure`` (r20
+    survivor-slice failover) rather than generic dead ranks.
   - *Starvation-freedom*: a queued job's effective priority is
     ``priority + wait_seconds / aging_secs`` — a sustained flood of
     high-priority arrivals can delay a low-priority job, never
@@ -139,12 +146,16 @@ class FleetScheduler:
                  capacity_file: str | None = None,
                  plan: fleet_chaos.FleetFaultPlan | None = None,
                  sup_options: dict | None = None,
+                 slice_devices: int | None = None,
                  backoff_base: float = 1.0, backoff_cap: float = 60.0,
                  backoff_jitter: float = 0.5,
                  clock=time.time, sleep=time.sleep):
         if pool_devices < 1:
             raise ValueError(f'pool must have >= 1 device, '
                              f'got {pool_devices}')
+        if slice_devices is not None and slice_devices < 1:
+            raise ValueError(f'{slice_devices=} must be >= 1 (devices '
+                             'per pool slice for gang-placed jobs)')
         if aging_secs < 0:
             raise ValueError(f'{aging_secs=} must be >= 0 (0 = no '
                              'priority aging)')
@@ -153,6 +164,8 @@ class FleetScheduler:
             raise ValueError(f'unknown sup_options {bad} '
                              f'(one of {SUP_OPTION_KEYS})')
         self.pool_devices = int(pool_devices)
+        self.slice_devices = (int(slice_devices)
+                              if slice_devices is not None else None)
         self.workdir = os.path.abspath(workdir)
         self.poll_secs = float(poll_secs)
         self.aging_secs = float(aging_secs)
@@ -265,6 +278,8 @@ class FleetScheduler:
                     priority=template.priority + 1,
                     min_devices=template.min_devices,
                     max_devices=template.max_devices,
+                    min_slices=template.min_slices,
+                    max_slices=template.max_slices,
                     max_restarts=template.max_restarts,
                     env=template.env,
                     # Sustained arrival stream (see fleet.chaos:
@@ -344,13 +359,19 @@ class FleetScheduler:
             argv += ['--tuned-config', spec.tuned_config]
         self._write_capacity(job, world)
         opts = dict(self.sup_options)
+        if spec.min_slices is not None:
+            # Gang job: its supervisor classifies whole-slice failures
+            # (all ranks of one slice stale -> survivor-slice
+            # failover) and exports KFAC_NUM_SLICES so the child's
+            # --num-slices default follows the placement.
+            opts['slices'] = world // self.slice_devices
         job.sup = sup_lib.Supervisor(
             argv, workdir=job.jobdir, instance=spec.name,
             heartbeat_dir=os.path.join(job.jobdir, 'heartbeats'),
             metrics_path=job.metrics,
             extra_env=spec.env_dict(),
-            devices=spec.max_devices, start_devices=world,
-            min_devices=spec.min_devices,
+            devices=self._job_max(spec), start_devices=world,
+            min_devices=self._job_min(spec),
             capacity_file=job.capacity_path,
             max_restarts=spec.max_restarts,
             keep_faults=spec.keep_faults,
@@ -492,6 +513,27 @@ class FleetScheduler:
 
     # -- allocation -----------------------------------------------------
 
+    def _job_min(self, spec: JobSpec) -> int:
+        """The spec's device-unit minimum. Gang jobs (``min_slices``,
+        r20) count in whole pool slices: the minimum is
+        ``min_slices * slice_devices``. With no ``--slice-devices``
+        configured a gang job has NO device quantum — fail closed by
+        returning more than the pool can ever hold (the startup check
+        quarantines it with the real reason; this guard only covers
+        jobs that arrive mid-run, e.g. chaos flood clones)."""
+        if spec.min_slices is None:
+            return spec.min_devices
+        if self.slice_devices is None:
+            return self.pool_devices + 1
+        return spec.min_slices * self.slice_devices
+
+    def _job_max(self, spec: JobSpec) -> int:
+        if spec.min_slices is None:
+            return spec.max_devices
+        if self.slice_devices is None:
+            return 0
+        return spec.max_slices * self.slice_devices
+
     def _effective_priority(self, job: _Job, now: float) -> float:
         eff = float(job.spec.priority)
         if job.state == 'queued' and self.aging_secs > 0:
@@ -527,13 +569,20 @@ class FleetScheduler:
             for j in order:
                 if j.state != tier_state:
                     continue
-                take = (j.spec.min_devices
-                        if rem >= j.spec.min_devices else 0)
+                need = self._job_min(j.spec)
+                take = need if rem >= need else 0
                 assign[j] = take
                 rem -= take
         for j in order:
             if assign[j]:
-                extra = min(j.spec.max_devices - assign[j], rem)
+                extra = min(self._job_max(j.spec) - assign[j], rem)
+                if j.spec.min_slices is not None:
+                    # Gang placement: extras land in WHOLE-slice
+                    # quanta only — a job never straddles a partial
+                    # slice (its nested mesh could not use the
+                    # remainder, and the stranded devices would read
+                    # as allocated in every capacity diff).
+                    extra -= extra % self.slice_devices
                 assign[j] += extra
                 rem -= extra
         pool_shrank = pool < self._last_pool
@@ -566,7 +615,7 @@ class FleetScheduler:
                 j.assigned = a
         for j in order:
             if j.state == 'queued' and assign.get(j, 0) \
-                    >= j.spec.min_devices:
+                    >= self._job_min(j.spec):
                 self._start(j, assign[j], now)
 
     # -- the loop -------------------------------------------------------
@@ -619,12 +668,33 @@ class FleetScheduler:
                         queue_wait_s=0.0, run_s=0.0, restarts=0,
                         preemptions=0, gate=None, diagnostic=None)
         for job in list(self.jobs):
-            if job.spec.min_devices > self.pool_devices:
+            spec = job.spec
+            if spec.min_slices is not None \
+                    and self.slice_devices is None:
+                # Gang job with no --slice-devices: there is no
+                # device quantum to translate slices into — fail
+                # closed (running it at a guessed size would defeat
+                # the whole-slice placement the spec asked for).
                 job.state = 'quarantined'
                 self._event(
-                    'fleet_quarantine', job=job.spec.name,
-                    reason=f'unsatisfiable: min_devices '
-                           f'{job.spec.min_devices} exceeds the pool '
+                    'fleet_quarantine', job=spec.name,
+                    reason=f'gang job (min_slices {spec.min_slices}) '
+                           'needs --slice-devices to size its slices '
+                           '(fail-closed)',
+                    rc=None, devices=0, queue_wait_s=0.0, run_s=0.0,
+                    restarts=0, preemptions=0, gate=None,
+                    diagnostic=None)
+                continue
+            need = self._job_min(spec)
+            if need > self.pool_devices:
+                unit = (f'{spec.min_slices} slice(s) x '
+                        f'{self.slice_devices} devices'
+                        if spec.min_slices is not None
+                        else f'min_devices {need}')
+                job.state = 'quarantined'
+                self._event(
+                    'fleet_quarantine', job=spec.name,
+                    reason=f'unsatisfiable: {unit} exceeds the pool '
                            f'({self.pool_devices})',
                     rc=None, devices=0, queue_wait_s=0.0, run_s=0.0,
                     restarts=0, preemptions=0, gate=None,
@@ -682,6 +752,14 @@ def main(argv=None) -> int:
     p.add_argument('--workdir', default='./fleet',
                    help='fleet state dir: fleet.jsonl event stream + '
                         'per-job artifact trees under jobs/<name>/')
+    p.add_argument('--slice-devices', type=int, default=None,
+                   metavar='D',
+                   help='devices per pool slice (r20 gang placement): '
+                        'jobs with min_slices/max_slices are sized in '
+                        'whole multiples of D and never straddle a '
+                        'partial slice; required whenever the jobs '
+                        'file names a gang job (fail-closed '
+                        'quarantine otherwise)')
     p.add_argument('--capacity-file', default=None, metavar='PATH',
                    help='file holding the pool\'s live device count '
                         '(capped at --pool-devices); torn reads keep '
@@ -733,7 +811,7 @@ def main(argv=None) -> int:
         specs, rejects=rejects, pool_devices=args.pool_devices,
         workdir=args.workdir, poll_secs=args.poll,
         aging_secs=args.aging_secs, capacity_file=args.capacity_file,
-        plan=plan,
+        plan=plan, slice_devices=args.slice_devices,
         sup_options=dict(hang_timeout=args.hang_timeout,
                          startup_grace=args.startup_grace,
                          failover_grace=args.failover_grace,

@@ -226,10 +226,16 @@ def global_batches(mesh, batches, batch_spec=None, *,
         return
     from distributed_kfac_pytorch_tpu.parallel.distributed import (
         KFAC_AXES,
+        SLICE_AXIS,
         normalize_batch_specs,
     )
     if batch_spec is None:
-        batch_spec = P(KFAC_AXES)
+        # Default: leading dim over the K-FAC data axes — including
+        # the outer slice axis on a multi-slice mesh (r20), mirroring
+        # DistributedKFAC.batch_axes.
+        axes = (((SLICE_AXIS,) if SLICE_AXIS in mesh.axis_names else ())
+                + KFAC_AXES)
+        batch_spec = P(axes)
     nproc = jax.process_count()
 
     def axis_spans_processes(name) -> bool:

@@ -1,0 +1,40 @@
+"""Multi-slice scale-out: two-level collective topology (r20).
+
+Production TPU pods are many ICI-connected slices joined by slow DCN.
+This package makes the K-FAC collective topology hierarchy-aware:
+
+  - :func:`make_multislice_mesh` builds a nested mesh with an OUTER
+    ``SLICE_AXIS`` (``parallel.distributed.SLICE_AXIS``) whose index
+    is the slice id; within a slice the KAISA
+    ``(inv_groups, grad_workers[, seq])`` grid is unchanged, so every
+    latency-critical collective (the in-group inverse ``all_gather``,
+    the intra-slice factor ``pmean``) rides ICI only.
+  - Inverse groups are slice-confined: ``DistributedKFAC`` places work
+    over the GLOBAL row space (``num_slices * rows_per_slice``), each
+    slice holding a contiguous run of rows — decompositions and
+    inverse state never cross the DCN; only preconditioned gradients
+    do (the delivery ``psum`` over both row axes), following the
+    comm/compute placement analysis of arXiv:2206.15143 /
+    arXiv:2107.06533.
+  - Factor reduction can go hierarchical (``KFAC(hierarchical_reduce=
+    True)``): intra-slice ``pmean`` on ICI every factor step, ONE
+    bucketed inter-slice DCN reduce per r14 cadence window — exact by
+    the same EMA-linearity argument as the r14 deferred reduction,
+    parity-pinned against the flat reduce.
+
+``num_slices=1`` degenerates to the flat ``make_kfac_mesh`` mesh and
+is bit-identical to the single-slice path. Everything is CPU-testable
+with ``--xla_force_host_platform_device_count`` nested meshes, like
+every SPMD feature so far.
+"""
+
+from distributed_kfac_pytorch_tpu.multislice.mesh import (  # noqa: F401
+    batch_axes,
+    make_multislice_mesh,
+    slice_count,
+    slice_of_rank,
+    slice_rank_groups,
+)
+from distributed_kfac_pytorch_tpu.parallel.distributed import (  # noqa: F401
+    SLICE_AXIS,
+)

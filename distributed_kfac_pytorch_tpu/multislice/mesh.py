@@ -1,0 +1,127 @@
+"""Nested multi-slice mesh construction + slice/rank arithmetic.
+
+The device order contract: slices are CONTIGUOUS runs of the global
+device (and rank) list — on a real multi-slice pod the runtime
+enumerates each slice's devices together, and on the CPU test backend
+(``--xla_force_host_platform_device_count=N``) contiguity is what the
+supervisor's slice-failure classifier and the fleet's gang placement
+key off. :func:`slice_rank_groups` is the single source of that
+arithmetic, shared by the r17 supervisor (all-ranks-of-one-slice-stale
+classification) and the observability report's per-slice rows.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from distributed_kfac_pytorch_tpu.parallel.distributed import (
+    KFAC_AXES,
+    SLICE_AXIS,
+    make_kfac_mesh,
+    resolve_grad_workers,
+)
+from distributed_kfac_pytorch_tpu.parallel.sequence import SEQ_AXIS
+from distributed_kfac_pytorch_tpu.preconditioner import CommMethod
+
+
+def make_multislice_mesh(devices: Sequence[jax.Device] | None = None, *,
+                         num_slices: int = 1,
+                         comm_method: CommMethod = CommMethod.COMM_OPT,
+                         grad_worker_fraction: float = 0.25,
+                         seq_parallel: int = 1) -> Mesh:
+    """Build the ``(slices, inv_groups, grad_workers[, seq])`` mesh.
+
+    ``num_slices == 1`` returns the flat ``make_kfac_mesh`` mesh (no
+    slice axis) — the bit-identity guarantee of ``--num-slices 1``.
+    Otherwise each contiguous ``world/num_slices`` run of devices is
+    one slice (one ICI domain); within a slice the KAISA grid is built
+    exactly like the flat mesh's (``placement.WorkerAllocator`` per
+    slice), so the in-slice topology — and therefore every ICI
+    collective's participant set — is unchanged from a
+    ``world/num_slices``-device flat run.
+    """
+    if num_slices < 1:
+        raise ValueError(f'{num_slices=} must be >= 1')
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    if num_slices == 1:
+        return make_kfac_mesh(devices, comm_method=comm_method,
+                              grad_worker_fraction=grad_worker_fraction,
+                              seq_parallel=seq_parallel)
+    if devices.size % num_slices:
+        raise ValueError(f'{num_slices=} does not divide '
+                         f'{devices.size} devices')
+    per_slice = devices.size // num_slices
+    if per_slice % seq_parallel:
+        raise ValueError(f'{seq_parallel=} does not divide the '
+                         f'{per_slice} devices of each slice')
+    from distributed_kfac_pytorch_tpu.parallel.placement import (
+        WorkerAllocator,
+    )
+    dp = per_slice // seq_parallel
+    gw = resolve_grad_workers(dp, comm_method, grad_worker_fraction)
+    alloc = WorkerAllocator(dp, gw / dp)
+    assert alloc.grad_workers == gw
+    grid = alloc.grid
+    slabs = devices.reshape(num_slices, per_slice)
+    if seq_parallel > 1:
+        devs = np.stack([slab.reshape(dp, seq_parallel)[grid]
+                         for slab in slabs])
+        return Mesh(devs, (SLICE_AXIS,) + KFAC_AXES + (SEQ_AXIS,))
+    devs = np.stack([slab[grid] for slab in slabs])
+    return Mesh(devs, (SLICE_AXIS,) + KFAC_AXES)
+
+
+def slice_count(mesh: Mesh) -> int:
+    """Number of slices of a mesh (1 for a flat mesh)."""
+    return (int(mesh.shape[SLICE_AXIS])
+            if SLICE_AXIS in mesh.axis_names else 1)
+
+
+def batch_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Batch-dim sharding axes for a (possibly sliced) K-FAC mesh.
+
+    The slice axis (when present) plus both K-FAC axes — NOT the
+    sequence axis, which shards the sequence dim. Mirrors
+    ``DistributedKFAC.batch_axes`` for callers that build batch
+    PartitionSpecs before (or without) a ``DistributedKFAC``.
+    """
+    return (((SLICE_AXIS,) if SLICE_AXIS in mesh.axis_names else ())
+            + KFAC_AXES)
+
+
+def slice_rank_groups(world: int, num_slices: int
+                      ) -> tuple[tuple[int, ...], ...]:
+    """Per-slice contiguous rank groups: slice ``s`` owns ranks
+    ``[s * world/num_slices, (s+1) * world/num_slices)``.
+
+    The single source of the slice<->rank arithmetic (module
+    docstring); raises when ``num_slices`` does not divide ``world``
+    so a drifted world size fails loudly instead of misattributing
+    ranks.
+    """
+    if num_slices < 1:
+        raise ValueError(f'{num_slices=} must be >= 1')
+    if world % num_slices:
+        raise ValueError(f'{num_slices=} does not divide world size '
+                         f'{world}')
+    per = world // num_slices
+    return tuple(tuple(range(s * per, (s + 1) * per))
+                 for s in range(num_slices))
+
+
+def slice_of_rank(rank: int, world: int, num_slices: int) -> int:
+    """The slice id owning ``rank`` (contiguous-run arithmetic)."""
+    if not 0 <= rank < world:
+        raise ValueError(f'{rank=} out of range for world {world}')
+    if num_slices <= 1:
+        return 0
+    if world % num_slices:
+        raise ValueError(f'{num_slices=} does not divide world size '
+                         f'{world}')
+    return rank // (world // num_slices)

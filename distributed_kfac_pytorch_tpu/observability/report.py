@@ -519,6 +519,19 @@ def print_report(s: dict, out=None, torn: int = 0,
             w(f"  rank {rank}: {pr['n_steps']} steps  "
               f"p50 {_fmt(pr['p50_ms'], ' ms')}  "
               f"p95 {_fmt(pr['p95_ms'], ' ms')}{wait}")
+        ps = stragglers.get('per_slice')
+        if ps:
+            # Per-slice skew rows (r20): pooled per-slice dispatch
+            # percentiles + slowest-rank share, so a slow DCN domain
+            # or sick slice reads in S rows instead of N rank rows.
+            for sl in sorted(ps):
+                row = ps[sl]
+                ranks = ','.join(str(r) for r in row['ranks'])
+                w(f"  slice {sl} (ranks {ranks}): "
+                  f"{row['n_steps']} steps  "
+                  f"p50 {_fmt(row['p50_ms'], ' ms')}  "
+                  f"p95 {_fmt(row['p95_ms'], ' ms')}  "
+                  f"slowest x{row['slowest_count']}")
         wbs = stragglers.get('wait_by_stage')
         if wbs:
             # Comm-wait attribution (r14): the factor-step vs plain-
@@ -718,7 +731,8 @@ def main(argv=None) -> int:
         if stragglers is None:
             stragglers = {'n_ranks': 0, 'per_rank': {},
                           'n_common_steps': 0, 'slowest_counts': {},
-                          'mean_skew_ms': None, 'max_skew_ms': None}
+                          'mean_skew_ms': None, 'max_skew_ms': None,
+                          'wait_by_stage': None, 'per_slice': None}
         stragglers['unreadable'] = shard_errors
     s = summarize(records, supervisor_records=supervisor_records)
     if args.json:

@@ -122,16 +122,24 @@ def merge_shards(path: str, validate: bool = True
 def stage_class(fired) -> str:
     """Comm-wait attribution class of a step's ``fired`` label.
 
-    'factor' = steps that pay a factor-statistics collective (the
-    eager per-step pmean, the r14 deferred window-boundary 'reduce',
-    and compound firing+reduce labels); 'firing' = collective-free
-    inverse/chunk decomposition steps; 'compile'
-    = first-call compile steps (their timing is compile wall, not
-    steady state); 'plain' = everything else. The factor-vs-plain wait
-    split is how an overlap win (r14 deferred reduce / staleness)
-    reads directly from the JSONL, without a profile timeline
-    (PERF.md r7 rule).
+    'dcn' = steps that pay the r20 inter-slice DCN factor reduce
+    (hierarchical runs relabel the window-boundary 'reduce' to
+    'dcn_reduce' — its wait is slow-interconnect wait, the number the
+    r20 flat-vs-hierarchical decision rule reads, so it gets its own
+    bucket rather than folding into 'factor'); 'factor' = steps that
+    pay an ICI factor-statistics collective (the eager per-step pmean,
+    the r14 deferred window-boundary 'reduce', and compound
+    firing+reduce labels); 'firing' = collective-free inverse/chunk
+    decomposition steps; 'compile' = first-call compile steps (their
+    timing is compile wall, not steady state); 'plain' = everything
+    else. The factor-vs-plain wait split is how an overlap win (r14
+    deferred reduce / staleness) reads directly from the JSONL,
+    without a profile timeline (PERF.md r7 rule).
     """
+    if isinstance(fired, str) and 'dcn' in fired:
+        # Must precede the generic 'reduce' match: 'dcn_reduce' (and
+        # compound 'inverse+dcn_reduce') contain 'reduce' too.
+        return 'dcn'
     if isinstance(fired, str) and 'reduce' in fired:
         # 'reduce' alone, or a compound 'inverse+reduce'/'chunkJ+reduce'
         # firing step: the step pays the per-window factor collective,
@@ -184,12 +192,26 @@ def straggler_summary(shards: dict[int, list[dict]]) -> dict | None:
     sick host), and the mean/max per-step skew (slowest minus fastest
     dispatch). Wait-time inverts the picture — the rank that waits
     LEAST at the barrier is the one everyone else waits FOR.
+
+    Multi-slice runs (r20): shards whose meta record carries a
+    ``slice`` id (the CLIs stamp ``slice_of_rank(...)`` into the shard
+    meta) additionally aggregate into ``per_slice`` rows — per-slice
+    rank list, p50/p95 over the slice's pooled dispatch times and
+    slowest-rank share, so inter-slice skew (a slow DCN domain, a sick
+    slice) reads directly from the report without eyeballing N rank
+    rows.
     """
     per_rank: dict[int, dict] = {}
     step_times: dict[int, dict[int, float]] = {}
+    rank_slice: dict[int, int] = {}
+    rank_times: dict[int, list[float]] = {}
     for rank, records in shards.items():
         times, waits = [], []
         for r in records:
+            if (r.get('kind') == 'meta'
+                    and isinstance(r.get('meta'), dict)
+                    and r['meta'].get('slice') is not None):
+                rank_slice[rank] = int(r['meta']['slice'])
             if r.get('kind') != 'step':
                 continue
             ms = r.get('host_step_ms')
@@ -202,6 +224,7 @@ def straggler_summary(shards: dict[int, list[dict]]) -> dict | None:
                 waits.append(w)
         if not times:
             continue
+        rank_times[rank] = times
         svals = sorted(times)
         per_rank[rank] = {
             'n_steps': len(times),
@@ -221,6 +244,22 @@ def straggler_summary(shards: dict[int, list[dict]]) -> dict | None:
         worst = max(by_rank, key=by_rank.get)
         slowest[worst] += 1
         skews.append(max(by_rank.values()) - min(by_rank.values()))
+    per_slice = None
+    if rank_slice and any(rank in per_rank for rank in rank_slice):
+        groups: dict[int, list[int]] = {}
+        for rank in per_rank:
+            if rank in rank_slice:
+                groups.setdefault(rank_slice[rank], []).append(rank)
+        per_slice = {}
+        for sl, ranks in sorted(groups.items()):
+            pooled = sorted(t for r in ranks for t in rank_times[r])
+            per_slice[sl] = {
+                'ranks': sorted(ranks),
+                'n_steps': len(pooled),
+                'p50_ms': _percentile(pooled, 50),
+                'p95_ms': _percentile(pooled, 95),
+                'slowest_count': sum(slowest[r] for r in ranks),
+            }
     return {
         'n_ranks': len(per_rank),
         'per_rank': per_rank,
@@ -232,6 +271,10 @@ def straggler_summary(shards: dict[int, list[dict]]) -> dict | None:
         # of the barrier wait sits on factor-collective steps vs plain
         # steps — the number the deferred-reduce overlap moves.
         'wait_by_stage': wait_attribution(shards),
+        # Per-slice skew rows (r20) — None on flat runs (no slice ids
+        # in the shard meta), so pre-r20 report JSON consumers see the
+        # key but not new structure unless multi-slice is on.
+        'per_slice': per_slice,
     }
 
 

@@ -79,7 +79,12 @@ from distributed_kfac_pytorch_tpu.preconditioner import (
 
 # Mesh axis names. Batch/data parallelism shards over both axes jointly;
 # an optional third SEQ_AXIS ('kfac_sp') shards the sequence dimension for
-# ring-attention context parallelism (parallel.sequence).
+# ring-attention context parallelism (parallel.sequence). Multi-slice
+# pods (r20) prepend an OUTER slice axis: devices within a slice share
+# fast ICI, slices are joined by slow DCN, and the collective topology
+# is two-level — inverse groups never span slices
+# (multislice.make_multislice_mesh builds the nested mesh).
+SLICE_AXIS = 'kfac_slice'
 INV_GROUP_AXIS = 'kfac_ig'
 GRAD_WORKER_AXIS = 'kfac_gw'
 KFAC_AXES = (INV_GROUP_AXIS, GRAD_WORKER_AXIS)
@@ -338,6 +343,26 @@ class DistributedKFAC:
         self.shard_precond_compute = shard_precond_compute
         self.n_rows = mesh.shape[INV_GROUP_AXIS]
         self.n_cols = mesh.shape[GRAD_WORKER_AXIS]
+        # Multi-slice (r20): an outer SLICE_AXIS makes the inverse-row
+        # space two-level — each slice holds ``n_rows`` contiguous
+        # global rows, so inverse state and decompositions stay
+        # slice-confined (the in-group all_gather rides ICI only);
+        # only preconditioned gradients cross the DCN (the delivery
+        # psum widens to both row axes).
+        self.sliced = SLICE_AXIS in mesh.axis_names
+        self.n_slices = (mesh.shape[SLICE_AXIS] if self.sliced else 1)
+        self.total_rows = self.n_slices * self.n_rows
+        # Axis spec of the global inverse-row dimension: stacks are
+        # sharded (and row-space collectives reduce) over the slice
+        # axis jointly with the inverse-group axis when sliced.
+        self._row_axes = ((SLICE_AXIS, INV_GROUP_AXIS) if self.sliced
+                          else INV_GROUP_AXIS)
+        if kfac.hierarchical_reduce and not self.sliced:
+            raise ValueError(
+                'hierarchical_reduce=True requires a multi-slice mesh '
+                f'(an outer {SLICE_AXIS!r} axis — '
+                'multislice.make_multislice_mesh with num_slices > 1); '
+                'on a flat mesh there is no DCN boundary to defer over')
         # The EFFECTIVE A/G-across-columns flag (assign_work resolves
         # None to n_cols > 1). Recorded in every checkpoint's topology
         # scalars (elastic.topology) so the elastic resume path can
@@ -347,13 +372,26 @@ class DistributedKFAC:
             else bool(distribute_layer_factors))
         # Gradient/factor averaging spans every data-bearing axis: the two
         # K-FAC axes plus the sequence axis when context parallelism is on
-        # (each device then holds a (batch shard, sequence block) tile).
-        self.data_axes = KFAC_AXES + (
-            (SEQ_AXIS,) if SEQ_AXIS in mesh.axis_names else ())
+        # (each device then holds a (batch shard, sequence block) tile),
+        # plus the outer slice axis on a multi-slice mesh.
+        self.data_axes = (
+            ((SLICE_AXIS,) if self.sliced else ())
+            + KFAC_AXES
+            + ((SEQ_AXIS,) if SEQ_AXIS in mesh.axis_names else ()))
+        # Batch-dim sharding axes (data_axes minus SEQ_AXIS, which
+        # shards the sequence dim, not the batch dim).
+        self.batch_axes = (((SLICE_AXIS,) if self.sliced else ())
+                           + KFAC_AXES)
         self.data_size = int(np.prod([mesh.shape[a]
                                       for a in self.data_axes]))
+        # Work placement spans the GLOBAL row space (slices x in-slice
+        # inverse groups): assign_work is a pure function of
+        # (specs/shapes, total rows, cols, flag), so a flat
+        # ``total_rows``-row mesh and a sliced one produce the same
+        # layer/bucket layout — the property the elastic reshard path's
+        # slice-count changes rely on (elastic.topology.layout_key).
         self.assignment = assign_work(
-            kfac, params, self.n_rows, self.n_cols,
+            kfac, params, self.total_rows, self.n_cols,
             distribute_layer_factors=self.distribute_layer_factors)
         self._factor_dims = {
             name: L.factor_shapes(spec, _get(params, spec.path))
@@ -496,8 +534,8 @@ class DistributedKFAC:
         for (g_dim, a_dim), rows in by_shape.items():
             s = max(len(v) for v in rows.values())
             slot_of = {}
-            a_idx = np.zeros(self.n_rows * s, np.int32)
-            g_idx = np.zeros(self.n_rows * s, np.int32)
+            a_idx = np.zeros(self.total_rows * s, np.int32)
+            g_idx = np.zeros(self.total_rows * s, np.int32)
             for r, names in rows.items():
                 for k, name in enumerate(names):
                     gslot = r * s + k
@@ -525,7 +563,7 @@ class DistributedKFAC:
         idt = self.kfac.inv_dtype
         stacks = {}
         for dim, plan in self.assignment.buckets.items():
-            n_slots = self.n_rows * plan.slots_per_row
+            n_slots = self.total_rows * plan.slots_per_row
             # Buckets are dim-homogeneous, so the per-dim dispatch
             # ('auto': eigen below the cutoff, damped inverse above,
             # r19 low-rank at/above the engaged threshold —
@@ -569,16 +607,22 @@ class DistributedKFAC:
                  # Pipelined-firing position (next chunk due; constant 0
                  # under inv_pipeline_chunks=1) — see KFAC.init_state.
                  'inv_chunk_phase': base['inv_chunk_phase']}
-        if self.kfac.deferred_factor_reduction:
+        if self.kfac.deferred_factor_reduction \
+                or self.kfac.hierarchical_reduce:
             # Per-DEVICE local accumulators (deferred reduce, r14):
             # each device folds its own un-reduced contributions, so
             # the leaves carry a leading device dim sharded over the
             # data axes (state_pspecs) — a replicated spec would
             # silently collapse device-varying values. The decay
             # product is identical on every device (replicated).
+            # Hierarchical reduce (r20) accumulates SLICE-mean
+            # contributions (post intra-slice pmean), identical within
+            # a slice: the leading dim is the slice count, sharded
+            # over the slice axis only.
+            lead = (self.n_slices if self.kfac.hierarchical_reduce
+                    else self.data_size)
             state['factor_accum'] = jax.tree.map(
-                lambda x: jnp.zeros((self.data_size,) + x.shape,
-                                    x.dtype),
+                lambda x: jnp.zeros((lead,) + x.shape, x.dtype),
                 base['factors'])
             state['accum_decay'] = jnp.ones((), jnp.float32)
         if self.kfac.inv_staleness:
@@ -598,12 +642,17 @@ class DistributedKFAC:
         replicated."""
         specs = jax.tree.map(lambda _: P(), state)
         specs['inv_stacks'] = jax.tree.map(
-            lambda _: P(INV_GROUP_AXIS), state['inv_stacks'])
+            lambda _: P(self._row_axes), state['inv_stacks'])
         if 'factor_accum' in state:
             # Leading device dim sharded over every data-bearing axis:
             # each device owns exactly its own accumulator slice.
+            # Hierarchical reduce: slice-mean accumulators, sharded
+            # over the slice axis only (replicated within a slice).
+            acc_axes = ((SLICE_AXIS,)
+                        if self.kfac.hierarchical_reduce
+                        else self.data_axes)
             specs['factor_accum'] = jax.tree.map(
-                lambda _: P(self.data_axes), state['factor_accum'])
+                lambda _: P(acc_axes), state['factor_accum'])
         return specs
 
     def shard_state(self, state: dict) -> dict:
@@ -747,6 +796,30 @@ class DistributedKFAC:
         kfac = self.kfac
         alpha = kfac.factor_decay if factor_decay is None else factor_decay
         combined = self._local_combined_contribs(contribs)
+        if kfac.hierarchical_reduce:
+            # Hierarchical reduce (r20): the intra-slice half of the
+            # factor reduction runs EVERY factor step on ICI — after
+            # this pmean every device in a slice holds the slice-mean
+            # contribution; the inter-slice half (slow DCN) is the
+            # deferred window-boundary pmean over SLICE_AXIS only
+            # (_spmd_reduce_factors). pmean_slices(pmean_intra(c)) ==
+            # pmean_all(c) for uniform shard counts, so the boundary
+            # value matches the flat reduce by the same EMA linearity.
+            intra = tuple(a for a in self.data_axes if a != SLICE_AXIS)
+            with profiling.annotate(
+                    'kfac/comm/factor_allreduce_intra'):
+                if kfac.symmetry_aware_comm:
+                    combined = {
+                        name: {k: (F.unpack_symmetric(
+                                       jax.lax.pmean(
+                                           F.pack_symmetric(v), intra),
+                                       v.shape[-1])
+                                   if v.ndim == 2
+                                   else jax.lax.pmean(v, intra))
+                               for k, v in entry.items()}
+                        for name, entry in combined.items()}
+                else:
+                    combined = jax.lax.pmean(combined, intra)
         acc = state['factor_accum']
         new_acc = {}
         for name in kfac.specs:
@@ -781,8 +854,17 @@ class DistributedKFAC:
 
         packed = {name: {k: pack(v) for k, v in entry.items()}
                   for name, entry in acc.items()}
-        with profiling.annotate('kfac/comm/factor_reduce'):
-            reduced = jax.lax.pmean(packed, self.data_axes)
+        if kfac.hierarchical_reduce:
+            # r20: the accumulators already hold slice means (the
+            # intra-slice ICI pmean ran per factor step), so the
+            # boundary collective crosses ONLY the slice axis — this
+            # is the one DCN transfer of the whole factor pipeline,
+            # attributed separately for the straggler wait buckets.
+            with profiling.annotate('kfac/comm/factor_reduce_dcn'):
+                reduced = jax.lax.pmean(packed, (SLICE_AXIS,))
+        else:
+            with profiling.annotate('kfac/comm/factor_reduce'):
+                reduced = jax.lax.pmean(packed, self.data_axes)
         new_factors = {}
         for name in kfac.specs:
             old = state['factors'][name]
@@ -803,7 +885,7 @@ class DistributedKFAC:
         decomposition stays well-conditioned.
         """
         S = plan.slots_per_row
-        mats: list[Any] = [None] * (self.n_rows * S)
+        mats: list[Any] = [None] * (self.total_rows * S)
         for (name, which), slot_idx in plan.slot.items():
             g = self.assignment.layer_row[name] * S + slot_idx
             mats[g] = factors[name][which].astype(jnp.float32)
@@ -834,7 +916,7 @@ class DistributedKFAC:
             by_global[g] = factors[name][which]
         eye = jnp.eye(plan.dim, dtype=jnp.float32)
         mats = []
-        for r in range(self.n_rows):
+        for r in range(self.total_rows):
             for c in range(self.n_cols):
                 for m in offs:
                     mat = by_global.get(r * S + c * s + int(m))
@@ -889,7 +971,7 @@ class DistributedKFAC:
         """
         kfac = self.kfac
         chunk_plan = self._chunk_plan
-        row = jax.lax.axis_index(INV_GROUP_AXIS)
+        row = self._global_row()
         col = jax.lax.axis_index(GRAD_WORKER_AXIS)
         eigh_method = resolve_eigh_method(kfac.eigh_method)
         stacks = {}
@@ -1059,6 +1141,21 @@ class DistributedKFAC:
             for name in self.assignment.grouped_layers}
         return stacks, diag_inv, grouped_inv
 
+    def _global_row(self):
+        """This device's GLOBAL inverse-row index (traced scalar).
+
+        Flat mesh: the inverse-group axis index. Multi-slice: slices
+        hold contiguous runs of ``n_rows`` rows, matching the
+        ``P((SLICE_AXIS, INV_GROUP_AXIS))`` sharding of the stacks —
+        no inverse-bearing collective ever crosses the slice axis, so
+        the index arithmetic is the only place slices appear in the
+        inverse pipeline.
+        """
+        row = jax.lax.axis_index(INV_GROUP_AXIS)
+        if self.sliced:
+            row = jax.lax.axis_index(SLICE_AXIS) * self.n_rows + row
+        return row
+
     def _layer_inverses(self, inv_stacks, name: str) -> dict:
         """This device's (row-local) inverse views for one layer.
 
@@ -1140,7 +1237,7 @@ class DistributedKFAC:
                 return branch
 
             local, my_a, my_g = jax.lax.switch(
-                row, [make_branch(r) for r in range(self.n_rows)])
+                row, [make_branch(r) for r in range(self.total_rows)])
             # Mixed-ness is uniform per group (a function of the dim
             # pair): split groups gather baked inverses for both sides.
             # Eigen-family covers the r19 low-rank buckets too — their
@@ -1194,7 +1291,7 @@ class DistributedKFAC:
         historical path (see ``KFAC.precondition``).
         """
         kfac = self.kfac
-        row = jax.lax.axis_index(INV_GROUP_AXIS)
+        row = self._global_row()
         grad_mats = {
             name: L.grads_to_matrix(spec, _get(grads, spec.path))
             for name, spec in kfac.specs.items()}
@@ -1254,14 +1351,19 @@ class DistributedKFAC:
                                   grad_mats[name].astype(jnp.float32)
                                   * lr ** 2)
             with profiling.annotate('kfac/comm/klclip_psum'):
-                vg_sum = jax.lax.psum(vg_sum, INV_GROUP_AXIS)
+                vg_sum = jax.lax.psum(vg_sum, self._row_axes)
             nu = jnp.minimum(
                 1.0, jnp.sqrt(kfac.kl_clip / (jnp.abs(vg_sum) + 1e-30)))
         else:
             nu = jnp.ones((), jnp.float32)
 
         with profiling.annotate('kfac/comm/grad_psum'):
-            precond_mats = jax.lax.psum(precond_mats, INV_GROUP_AXIS)
+            # The delivery broadcast spans the whole row space — on a
+            # multi-slice mesh this is the ONE collective of the
+            # inverse/precondition pipeline that crosses the DCN
+            # (gradients, not factors or inverses, ride the slow
+            # interconnect — arXiv:2206.15143's placement rule).
+            precond_mats = jax.lax.psum(precond_mats, self._row_axes)
 
         # Stats AFTER the delivery psum: every device sees the full
         # preconditioned matrices, so the norms are replicated scalars.
@@ -1346,14 +1448,18 @@ class DistributedKFAC:
 
         track = kfac.collect_metrics or kfac.nonfinite_guard
         overlap_state = {}
-        if kfac.deferred_factor_reduction:
+        if kfac.deferred_factor_reduction or kfac.hierarchical_reduce:
             # Deferred reduce (r14): factor steps fold into this
             # device's local accumulator slice — no collective; the
             # window-boundary reduce step pays ONE bucketed pmean.
+            # Hierarchical reduce (r20) shares the window machinery:
+            # factor steps additionally pmean intra-slice on ICI, and
+            # the boundary pmean crosses only the slice axis (DCN).
             # Static cadence only (the reduce is program structure).
             if factor_update is None:
                 raise ValueError(
-                    'deferred_factor_reduction requires static cadence '
+                    'deferred_factor_reduction / hierarchical_reduce '
+                    'require static cadence '
                     'flags (Python-bool factor_update/factor_reduce) — '
                     'the window-boundary reduce is static program '
                     'structure, like inv_chunk')
@@ -1382,7 +1488,8 @@ class DistributedKFAC:
             if factor_reduce:
                 raise ValueError(
                     'factor_reduce requires '
-                    'deferred_factor_reduction=True')
+                    'deferred_factor_reduction=True or '
+                    'hierarchical_reduce=True')
             if track:
                 # Tracked form: finiteness of the candidate factors
                 # rides out of the gate (guard skip + metrics count);
@@ -1477,7 +1584,7 @@ class DistributedKFAC:
         # the in-group all_gather), so one psum yields the global count.
         eig_clipped = jax.lax.psum(
             obs_metrics.count_clipped_eigvals_stacks(inv_stacks),
-            INV_GROUP_AXIS)
+            self._row_axes)
         new_state = {'step': step + 1, 'factors': factors,
                      'inv_stacks': inv_stacks, 'diag_inv': diag_inv,
                      'grouped_inv': grouped_inv,
@@ -1739,7 +1846,7 @@ class DistributedKFAC:
         if model_args_fn is None:
             model_args_fn = lambda batch: (batch[0],)
         if batch_spec is None:
-            batch_spec = P(KFAC_AXES)
+            batch_spec = P(self.batch_axes)
         if grad_accum_steps < 1:
             raise ValueError(f'{grad_accum_steps=} must be >= 1')
         capture = self.kfac.capture
@@ -2060,7 +2167,12 @@ class DistributedKFAC:
         trace_counts: dict[tuple, int] = {}
         compile_events: list[dict] = []
 
-        deferred = self.kfac.deferred_factor_reduction
+        # hierarchical_reduce (r20) reuses the r14 window machinery:
+        # the engine schedules its boundary reduce off the same
+        # `deferred_factor_reduction` step attribute, and the variant
+        # key gains the reduce flag identically.
+        deferred = (self.kfac.deferred_factor_reduction
+                    or self.kfac.hierarchical_reduce)
         staleness = self.kfac.inv_staleness
 
         def _variant_key(f, i, c, r=False, s=False):
@@ -2133,6 +2245,7 @@ class DistributedKFAC:
         # telemetry (drained by engine.train_epoch).
         step.inv_pipeline_chunks = self.kfac.inv_pipeline_chunks
         step.deferred_factor_reduction = deferred
+        step.hierarchical_reduce = self.kfac.hierarchical_reduce
         step.inv_staleness = staleness
         step.trace_counts = trace_counts
         step.compile_events = compile_events

@@ -358,6 +358,17 @@ class KFAC:
         finiteness check moves to the reduce point's post-average
         candidate (collective-safe, unchanged), so a poisoned window
         is skipped WHOLE — the accumulator resets either way.
+      hierarchical_reduce: two-level factor reduction for multi-slice
+        meshes (r20, SPMD-only; mutually exclusive with
+        ``deferred_factor_reduction``). Factor contributions are
+        ``pmean``-ed WITHIN each slice (over ICI) on every factor step
+        and folded into a per-slice accumulator; the inter-slice
+        (DCN) half of the mean is deferred to ONE bucketed reduce per
+        cadence window (``kfac/comm/factor_reduce_dcn``) — exact by
+        the same EMA-linearity argument as the deferred form, since
+        ``pmean_slices(pmean_intra(c)) = pmean_all(c)``. Requires a
+        ``multislice.make_multislice_mesh`` mesh with > 1 slice;
+        :class:`KFAC` itself (single-chip, no mesh) raises on step.
       inv_staleness: 0 (default) or 1. At 1, the decompositions
         consumed during cadence window ``w+1`` are computed from
         factors FROZEN at the end of window ``w`` (a snapshot carried
@@ -420,6 +431,7 @@ class KFAC:
                  inv_pipeline_chunks: int = 1,
                  inv_pipeline_costs: dict | None = None,
                  deferred_factor_reduction: bool = False,
+                 hierarchical_reduce: bool = False,
                  inv_staleness: int = 0,
                  kfac_approx: Any = 'expand',
                  tied_embeddings: bool | None = None,
@@ -585,7 +597,15 @@ class KFAC:
         self.inv_pipeline_chunks = inv_pipeline_chunks
         self.inv_pipeline_costs = (dict(inv_pipeline_costs)
                                    if inv_pipeline_costs else None)
+        if hierarchical_reduce and deferred_factor_reduction:
+            raise ValueError(
+                'hierarchical_reduce and deferred_factor_reduction are '
+                'mutually exclusive: hierarchical reduce already '
+                'defers the (inter-slice DCN) half of the factor '
+                'reduction to the window boundary, and its intra-slice '
+                'ICI pmean must fire every factor step')
         self.deferred_factor_reduction = bool(deferred_factor_reduction)
+        self.hierarchical_reduce = bool(hierarchical_reduce)
         self.inv_staleness = int(inv_staleness)
         self.symmetry_aware_comm = symmetry_aware_comm
         self.assignment_strategy = assignment_strategy
@@ -608,7 +628,8 @@ class KFAC:
                   'factor_compute_dtype', 'inv_dtype',
                   'precond_compute_dtype', 'precond_bucketing',
                   'inv_pipeline_chunks',
-                  'deferred_factor_reduction', 'inv_staleness',
+                  'deferred_factor_reduction', 'hierarchical_reduce',
+                  'inv_staleness',
                   'kfac_approx', 'tied_embeddings',
                   'symmetry_aware_comm',
                   'assignment_strategy', 'comm_method',
@@ -1519,6 +1540,12 @@ class KFAC:
         step = state['step']
 
         track = self.collect_metrics or self.nonfinite_guard
+        if self.hierarchical_reduce:
+            raise ValueError(
+                'hierarchical_reduce is SPMD-only (it reduces over '
+                "mesh slice axes) — use DistributedKFAC on a "
+                'multislice.make_multislice_mesh mesh with '
+                'num_slices > 1')
         if self.deferred_factor_reduction:
             # Deferred reduce: the EWMA (and, under SPMD, the factor
             # collective) advances only on factor_reduce steps; factor

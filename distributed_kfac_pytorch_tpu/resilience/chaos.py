@@ -27,6 +27,13 @@ re-provisioning supervisor owns the device count and this harness only
 models its relaunch step). The resumed run then reshards its K-FAC
 state through the elastic path instead of cold restarting.
 
+A ``slice-loss@K->S`` fault (r20 multi-slice) drains the same way but
+the relaunch lands on the S SURVIVOR slices: the new world is
+``S * per_slice`` devices (per-slice size derived from the prior
+launch's forced device count and ``KFAC_NUM_SLICES`` — fail-closed
+when either is missing), and ``KFAC_NUM_SLICES=S`` is exported so the
+CLI's ``--num-slices`` default follows the shrink.
+
 Exit status: the final child's exit code (so CI can gate on it).
 """
 
@@ -56,6 +63,9 @@ def main(argv=None) -> int:
                         'factor), corrupt-ckpt (bit-flip a saved '
                         'bundle), diverge (loss-spike injection), '
                         "resize@K->N (relaunch with an N-device world), "
+                        'slice-loss@K->S (drop whole slices: relaunch '
+                        'on the S survivor slices with '
+                        'KFAC_NUM_SLICES=S), '
                         'hang (wedge without exit — needs the real '
                         'supervisor to detect), slowrank (persistent '
                         'per-step delay) '
@@ -98,6 +108,32 @@ def main(argv=None) -> int:
             env['XLA_FLAGS'] = faults.xla_flags_with_device_count(
                 env.get('XLA_FLAGS', ''), plan.resize_to)
             note = f' with {plan.resize_to} devices'
+        if plan is not None and plan.slice_loss_to is not None:
+            # Relaunch onto the survivor slices: per-slice device
+            # count recovered from the prior launch's forced device
+            # count + KFAC_NUM_SLICES — both must be present and
+            # consistent (fail closed; guessing a world would hide a
+            # mis-set harness rather than test failover).
+            prev = int(env.get('KFAC_NUM_SLICES', '0') or 0)
+            world = faults.forced_device_count(env.get('XLA_FLAGS', ''))
+            if prev < 1 or world is None or world % prev:
+                raise SystemExit(
+                    'chaos: slice-loss relaunch needs KFAC_NUM_SLICES '
+                    'and --xla_force_host_platform_device_count (a '
+                    'multiple of it) in the environment to derive the '
+                    f'per-slice device count (got slices={prev}, '
+                    f'forced world={world})')
+            if plan.slice_loss_to >= prev:
+                raise SystemExit(
+                    f'chaos: slice-loss@K->{plan.slice_loss_to} must '
+                    f'name FEWER than the {prev} launched slices '
+                    '(it drops slices, not grows them)')
+            new_world = (world // prev) * plan.slice_loss_to
+            env['XLA_FLAGS'] = faults.xla_flags_with_device_count(
+                env.get('XLA_FLAGS', ''), new_world)
+            env['KFAC_NUM_SLICES'] = str(plan.slice_loss_to)
+            note = (f' on {plan.slice_loss_to} survivor slice(s) '
+                    f'({new_world} devices)')
         print(f'chaos: launch {launches} exited {rc} (preempted) — '
               f'relaunching{note} ({launches}/{args.relaunch})',
               file=sys.stderr)

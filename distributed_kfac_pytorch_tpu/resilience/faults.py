@@ -45,6 +45,18 @@ tests set it directly). Spec grammar — comma-separated ``kind@step``::
                       resumes through the elastic reshard path
                       (``resilience.cli.resume(elastic=...)``) instead
                       of cold restarting
+    slice-loss@K->S   whole-slice failure after step K completes
+                      (r20 multi-slice): drain like a preemption
+                      (forced blocking save, exit
+                      RELAUNCH_EXIT_CODE), and the chaos harness
+                      relaunches onto the S SURVIVOR slices — world
+                      shrinks to S * per_slice devices (per-slice
+                      size read from the prior launch's forced
+                      device count and ``KFAC_NUM_SLICES``), with
+                      ``KFAC_NUM_SLICES=S`` exported so the CLI's
+                      ``--num-slices`` default follows; the relaunch
+                      resumes through the same elastic reshard path
+                      as resize
     hang@K            after step K completes, stop making progress AND
                       stop heartbeating WITHOUT exiting (block forever
                       in the step hook) — the wedged-collective /
@@ -74,14 +86,14 @@ import numpy as np
 ENV_VAR = 'KFAC_CHAOS'
 _KINDS = ('preempt', 'crash', 'nan-batch', 'crash-in-save',
           'corrupt-factor', 'corrupt-ckpt', 'diverge', 'resize',
-          'hang', 'slowrank')
+          'slice-loss', 'hang', 'slowrank')
 #: One line of grammar per fault kind — error messages cite the WHOLE
 #: menu, not just the token that failed to parse, so a typo'd spec is
 #: fixable from the traceback alone (r16 satellite: the old messages
 #: only echoed the bad token plus a bare kind tuple).
 _GRAMMAR = ('preempt@K, crash@K, nan-batch@K, crash-in-save@K, '
             'corrupt-factor@K, corrupt-ckpt@K, diverge@K, '
-            'resize@K->N, hang@K, slowrank@K')
+            'resize@K->N, slice-loss@K->S, hang@K, slowrank@K')
 # How hard `diverge` kicks the parameters (see poison_params).
 DIVERGE_SCALE = 8.0
 # Per-step delay the `slowrank` fault injects (see slow_step). Chosen
@@ -102,6 +114,8 @@ class FaultPlan:
     diverge_at: int | None = None
     resize_at: int | None = None
     resize_to: int | None = None  # new world size for resize_at
+    slice_loss_at: int | None = None
+    slice_loss_to: int | None = None  # SURVIVOR slice count
     hang_at: int | None = None
     slowrank_at: int | None = None
 
@@ -138,6 +152,18 @@ def parse_spec(spec: str | None) -> FaultPlan | None:
             _set_once(fields, 'resize_at', int(step_s), part, spec)
             fields['resize_to'] = int(to_s)
             continue
+        if sep and kind == 'slice-loss':
+            step_s, arrow, to_s = at.partition('->')
+            if not (arrow and step_s.lstrip('-').isdigit()
+                    and to_s.isdigit() and int(to_s) > 0):
+                raise ValueError(
+                    f'bad {ENV_VAR} fault spec {part!r}: expected '
+                    "'slice-loss@<step>-><survivor_slices>' (e.g. "
+                    f"'slice-loss@2->1'); valid fault kinds: "
+                    f'{_GRAMMAR}')
+            _set_once(fields, 'slice_loss_at', int(step_s), part, spec)
+            fields['slice_loss_to'] = int(to_s)
+            continue
         if not sep or kind not in _KINDS:
             raise ValueError(
                 f'bad {ENV_VAR} fault spec {part!r}: unknown fault '
@@ -148,16 +174,18 @@ def parse_spec(spec: str | None) -> FaultPlan | None:
                 f'integer step; valid fault kinds: {_GRAMMAR}')
         _set_once(fields, kind.replace('-', '_') + '_at', int(at),
                   part, spec)
-    if 'resize_at' in fields and 'preempt_at' in fields:
-        # Both drain via the SAME relaunch exit code, so a supervisor
+    drains = [k for k in ('preempt_at', 'resize_at', 'slice_loss_at')
+              if k in fields]
+    if len(drains) > 1:
+        # All drain via the SAME relaunch exit code, so a supervisor
         # (resilience.chaos) could not tell which one caused a given
         # exit — and would change the world size on the wrong drain.
         # One drain fault per launch; chain launches for sequences.
         raise ValueError(
-            f'bad {ENV_VAR} spec {spec!r}: preempt and resize cannot '
-            'be combined in one launch (both exit with the relaunch '
-            'code, so the supervisor cannot attribute the drain); '
-            'inject them on separate launches instead')
+            f'bad {ENV_VAR} spec {spec!r}: preempt/resize/slice-loss '
+            'cannot be combined in one launch (all exit with the '
+            'relaunch code, so the supervisor cannot attribute the '
+            'drain); inject them on separate launches instead')
     return FaultPlan(**fields) if fields else None
 
 
@@ -226,6 +254,20 @@ def xla_flags_with_device_count(xla_flags: str, n: int) -> str:
             if not f.startswith('--xla_force_host_platform_device_count')]
     kept.append(f'--xla_force_host_platform_device_count={int(n)}')
     return ' '.join(kept)
+
+
+def forced_device_count(xla_flags: str) -> int | None:
+    """The ``--xla_force_host_platform_device_count`` value in an
+    ``XLA_FLAGS`` string, or None when unset — how the chaos harness's
+    ``slice-loss`` relaunch leg recovers the prior world size to
+    compute the per-slice device count (it fails closed when the flag
+    is absent rather than guessing a world)."""
+    val = None
+    for f in xla_flags.split():
+        name, sep, v = f.partition('=')
+        if sep and name == '--xla_force_host_platform_device_count':
+            val = int(v)
+    return val
 
 
 # ---------------------------------------------------------------------------

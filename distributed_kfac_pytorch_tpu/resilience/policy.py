@@ -160,6 +160,16 @@ class StepCheckpointer:
                 # the resumed run reshards through the elastic path.
                 self.preemption.trigger(
                     f'injected resize -> {self.plan.resize_to} devices')
+            if self.plan.slice_loss_at == gstep and \
+                    self.preemption is not None:
+                # Whole-slice loss (r20) drains exactly like a
+                # preemption too; the chaos harness relaunches onto
+                # the surviving slices (shrunken world +
+                # KFAC_NUM_SLICES) and the resumed run reshards
+                # through the same elastic path as resize.
+                self.preemption.trigger(
+                    'injected slice loss -> '
+                    f'{self.plan.slice_loss_to} survivor slice(s)')
         preempted = (self.preemption is not None
                      and self.preemption.triggered())
         due = self.policy is not None and self.policy.should_save(gstep)

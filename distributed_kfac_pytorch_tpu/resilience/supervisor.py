@@ -328,6 +328,7 @@ class Supervisor:
                  devices: int | None = None,
                  start_devices: int | None = None,
                  min_devices: int = 1,
+                 slices: int = 1,
                  capacity_file: str | None = None,
                  hang_timeout: float = 300.0,
                  startup_grace: float = 900.0,
@@ -354,6 +355,8 @@ class Supervisor:
                 'the exit statuses would be ambiguous')
         if devices is not None and not min_devices <= devices:
             raise ValueError(f'{devices=} below {min_devices=}')
+        if slices < 1:
+            raise ValueError(f'{slices=} must be >= 1')
         self.cmd = list(cmd)
         self.workdir = os.path.abspath(workdir)
         # Per-launch artifact namespace (r18 satellite): two concurrent
@@ -391,6 +394,11 @@ class Supervisor:
         self.world = (start_devices if start_devices is not None
                       else devices)
         self.min_devices = int(min_devices)
+        # Live slice count (r20 multi-slice): the slice-failure
+        # classifier keys rank groups off it, the child env exports it
+        # (KFAC_NUM_SLICES -> the CLIs' --num-slices default), and a
+        # committed slice failover decrements it.
+        self.slices = int(slices)
         self.capacity_file = capacity_file
         self.hang_timeout = float(hang_timeout)
         self.startup_grace = float(startup_grace)
@@ -449,6 +457,11 @@ class Supervisor:
         if self.world is not None:
             env['XLA_FLAGS'] = faults_lib.xla_flags_with_device_count(
                 env.get('XLA_FLAGS', ''), self.world)
+        if self.slices > 1:
+            # The CLIs' --num-slices defaults from this, so a slice
+            # failover's shrunken slice count propagates to the
+            # relaunched child without editing its argv.
+            env['KFAC_NUM_SLICES'] = str(self.slices)
         if self.launches > 0 and not self.keep_faults:
             # Faults are one-shot, exactly like the chaos harness: a
             # relaunch must not re-trip the injected failure (pass
@@ -783,9 +796,26 @@ class Supervisor:
                 self._kill(proc)
                 self._note('dead_rank', proc.returncode, last_step,
                            launch_time)
+                n = len(dead) + len(live)
+                # Slice-failure classification (r20): ALL ranks of
+                # exactly one slice stale while every other slice's
+                # ranks are live = that slice's ICI domain died (power
+                # / DCN partition), not a sick host — fail over to the
+                # survivor slices and shrink the slice count so the
+                # relaunched child builds an (S-1)-slice mesh.
+                slice_idx = None
+                if self.slices > 1 and n % self.slices == 0:
+                    from distributed_kfac_pytorch_tpu.multislice.mesh \
+                        import slice_rank_groups
+                    for i, group in enumerate(
+                            slice_rank_groups(n, self.slices)):
+                        if list(group) == dead:
+                            slice_idx = i
+                            break
+                reason = ('slice_failure' if slice_idx is not None
+                          else 'dead_rank')
                 target = self.world
                 if self.world is not None:
-                    n = len(dead) + len(live)
                     target = max(self.min_devices,
                                  self.world * len(live) // n)
                 if target == self.world:
@@ -797,17 +827,24 @@ class Supervisor:
                     # kill/relaunch loop outside the budget and the
                     # crash-loop detector.
                     stop = self._budgeted_restart(
-                        'dead_rank', rc=proc.returncode,
+                        reason, rc=proc.returncode,
                         last_step=last_step,
                         dead_ranks=','.join(map(str, dead)))
                     if stop is not None:
                         return stop
                     continue
-                self._event('supervisor_failover', reason='dead_rank',
+                extra = ({'slice': slice_idx}
+                         if slice_idx is not None else {})
+                self._event('supervisor_failover', reason=reason,
                             dead_ranks=','.join(map(str, dead)),
                             live_ranks=','.join(map(str, live)),
-                            from_devices=self.world, to_devices=target)
+                            from_devices=self.world, to_devices=target,
+                            **extra)
                 self.world = target
+                if slice_idx is not None:
+                    # Commit the shrink AFTER the event so the trail
+                    # records the pre-failover slice count.
+                    self.slices -= 1
                 self._straggler_handled.clear()  # ranks renumber
                 self.crash_loop.reset()
                 continue
@@ -939,6 +976,13 @@ def main(argv=None) -> int:
                         'capacity at N the first relaunch grows back')
     p.add_argument('--min-devices', type=int, default=1, metavar='M',
                    help='never shrink below this world size')
+    p.add_argument('--slices', type=int, default=1, metavar='S',
+                   help='multi-slice job (r20): the child trains an '
+                        'S-slice mesh (KFAC_NUM_SLICES is exported so '
+                        '--num-slices follows). With --failover-grace, '
+                        'all-ranks-of-one-slice-stale classifies as a '
+                        'slice failure: fail over to the survivor '
+                        'slices and relaunch with S-1')
     p.add_argument('--capacity-file', default=None, metavar='PATH',
                    help='file holding the currently-available device '
                         'count (the resource manager\'s live view); '
@@ -964,7 +1008,7 @@ def main(argv=None) -> int:
         heartbeat_dir=args.heartbeat_dir,
         events_path=args.events, metrics_path=args.metrics,
         devices=args.devices, start_devices=args.start_devices,
-        min_devices=args.min_devices,
+        min_devices=args.min_devices, slices=args.slices,
         capacity_file=args.capacity_file,
         hang_timeout=args.hang_timeout,
         startup_grace=args.startup_grace,

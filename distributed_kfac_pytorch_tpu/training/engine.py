@@ -405,6 +405,14 @@ def train_epoch(step_fn, state: TrainState, batches: Iterable,
         # whole run. Steps that also fired a K-FAC stage keep that
         # label (fired steps are excluded from spike stats anyway).
         fired = fired_stage(flags)
+        if (fired and 'reduce' in fired
+                and getattr(step_fn, 'hierarchical_reduce', False)):
+            # r20: the window-boundary collective of a hierarchical
+            # run crosses slices over DCN — relabel so the straggler
+            # merger's wait_by_stage attributes DCN wait as its own
+            # bucket (stragglers.stage_class routes 'dcn_reduce' to
+            # 'dcn' before the generic 'reduce' match).
+            fired = fired.replace('reduce', 'dcn_reduce')
         pending = getattr(step_fn, 'compile_events', None)
         if pending and fired is None:
             fired = 'compile'
@@ -573,14 +581,17 @@ def build_sgd_train_step(model, loss_fn, tx, mesh=None, *,
     import optax
     from jax.sharding import PartitionSpec as P
 
-    from distributed_kfac_pytorch_tpu.parallel.distributed import KFAC_AXES
+    from distributed_kfac_pytorch_tpu.parallel.distributed import (
+        KFAC_AXES,
+        SLICE_AXIS,
+    )
 
     if model_args_fn is None:
         model_args_fn = lambda batch: (batch[0],)
     mutable_cols = tuple(mutable_cols)
     data_axes = tuple(mesh.axis_names) if mesh is not None else ()
     if batch_spec is None and mesh is not None:
-        batch_spec = P(tuple(a for a in KFAC_AXES
+        batch_spec = P(tuple(a for a in (SLICE_AXIS,) + KFAC_AXES
                              if a in mesh.axis_names) or data_axes)
     if grad_accum_steps < 1:
         raise ValueError(f'{grad_accum_steps=} must be >= 1')

@@ -105,6 +105,12 @@ class OptimConfig:
     # pays a per-factor-step pmean). Mathematically exact by EMA
     # linearity; off (default) = bit-identical eager path.
     deferred_factor_reduction: bool = False
+    # Hierarchical two-level factor reduction (r20, multi-slice
+    # meshes only; mutually exclusive with deferred_factor_reduction):
+    # intra-slice pmean on ICI every factor step, one bucketed
+    # inter-slice DCN reduce per cadence window. Exact by the same
+    # EMA-linearity argument; off (default) = flat reduce.
+    hierarchical_reduce: bool = False
     # One-window-stale off-critical-path inverses (r14): 0 (default,
     # bit-identical) or 1 — decompositions for window w+1 are computed
     # from factors frozen at the end of window w and chunk-fired
@@ -150,6 +156,7 @@ TUNABLE_FIELDS = (
     'bf16_inverses',
     'inv_pipeline_chunks',
     'deferred_factor_reduction',
+    'hierarchical_reduce',
     'inv_staleness',
     'factor_batch_fraction',
     'kfac_cov_update_freq',
@@ -250,6 +257,7 @@ def get_optimizer(model, cfg: OptimConfig):
                                    else None),
             inv_pipeline_chunks=cfg.inv_pipeline_chunks,
             deferred_factor_reduction=cfg.deferred_factor_reduction,
+            hierarchical_reduce=cfg.hierarchical_reduce,
             inv_staleness=cfg.inv_staleness,
             kfac_approx=cfg.kfac_approx,
             skip_layers=list(cfg.skip_layers) or None,

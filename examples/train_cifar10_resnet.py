@@ -32,6 +32,7 @@ from distributed_kfac_pytorch_tpu import fp16 as fp16_lib
 from distributed_kfac_pytorch_tpu import launch
 from distributed_kfac_pytorch_tpu import observability as obs
 from distributed_kfac_pytorch_tpu import resilience as resil
+from distributed_kfac_pytorch_tpu import multislice
 from distributed_kfac_pytorch_tpu.models import cifar_resnet, vit
 from distributed_kfac_pytorch_tpu.parallel import distributed as D
 from distributed_kfac_pytorch_tpu.training import (
@@ -102,6 +103,19 @@ def parse_args(argv=None):
                         'compute/communication overlap; exact by EMA '
                         'linearity — off (default) keeps the '
                         'bit-identical eager per-step reduction)')
+    p.add_argument('--hierarchical-reduce', action='store_true',
+                   help='two-level factor reduction (r20; requires '
+                        '--num-slices > 1, mutually exclusive with '
+                        '--deferred-factor-reduction): intra-slice '
+                        'pmean on ICI every factor step, one bucketed '
+                        'inter-slice DCN reduce per cadence window')
+    p.add_argument('--num-slices', type=int,
+                   default=int(os.environ.get('KFAC_NUM_SLICES', 1)),
+                   help='multi-slice mesh: outer kfac_slice axis over '
+                        'N contiguous device slabs (r20). 1 (default) '
+                        '= the flat mesh, bit-identical to pre-r20 '
+                        'runs. Defaults from KFAC_NUM_SLICES (set by '
+                        'the supervisor on slice-failure failover)')
     p.add_argument('--inv-staleness', type=int, default=0,
                    choices=[0, 1],
                    help='1 = one-window-stale off-critical-path '
@@ -239,6 +253,7 @@ def main(argv=None):
         kfac_cov_update_freq=args.kfac_cov_update_freq,
         inv_pipeline_chunks=args.inv_pipeline_chunks,
         deferred_factor_reduction=args.deferred_factor_reduction,
+        hierarchical_reduce=args.hierarchical_reduce,
         inv_staleness=args.inv_staleness,
         kfac_approx=args.kfac_approx,
         inv_lowrank_rank=args.inv_lowrank_rank,
@@ -284,8 +299,15 @@ def main(argv=None):
                           'devices': n_dev,
                           'metrics_interval': args.metrics_interval})
     autotune.emit_events(metrics_sink, tune_events)
-    rank_sink = obs.cli.make_rank_shard_sink(
-        args, info, meta={'cli': 'train_cifar10_resnet'})
+    shard_meta = {'cli': 'train_cifar10_resnet'}
+    if (args.num_slices > 1
+            and info['process_count'] % args.num_slices == 0):
+        # Slice id into the shard meta -> per-slice skew rows in the
+        # report's straggler section (r20).
+        shard_meta['slice'] = multislice.slice_of_rank(
+            info['process_index'], info['process_count'],
+            args.num_slices)
+    rank_sink = obs.cli.make_rank_shard_sink(args, info, meta=shard_meta)
     # r17 liveness lease (per rank; armed by --heartbeat-dir or the
     # supervisor's KFAC_HEARTBEAT_DIR — None otherwise, and the engine
     # path is byte-identical without it).
@@ -315,7 +337,10 @@ def main(argv=None):
                              'path does not wire the loss scaler.')
         extra['loss_scale'] = fp16_lib.init_loss_scale()
 
-    mesh = D.make_kfac_mesh(
+    # num_slices == 1 returns the flat make_kfac_mesh mesh (the
+    # --num-slices 1 bit-identity guarantee).
+    mesh = multislice.make_multislice_mesh(
+        num_slices=args.num_slices,
         comm_method=optimizers.COMM_METHODS[args.comm_method],
         grad_worker_fraction=args.grad_worker_fraction)
     # Commit params/extra replicated on the mesh up front: the resume

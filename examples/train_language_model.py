@@ -42,6 +42,7 @@ from distributed_kfac_pytorch_tpu import fp16 as fp16_lib
 from distributed_kfac_pytorch_tpu import launch
 from distributed_kfac_pytorch_tpu import observability as obs
 from distributed_kfac_pytorch_tpu import resilience as resil
+from distributed_kfac_pytorch_tpu import multislice
 from distributed_kfac_pytorch_tpu.models import lstm_lm, transformer_lm
 from distributed_kfac_pytorch_tpu.parallel import distributed as D
 from distributed_kfac_pytorch_tpu.parallel import sequence as seq
@@ -91,6 +92,13 @@ def parse_args(argv=None):
     p.add_argument('--no-resume', action='store_true')
     p.add_argument('--seq-parallel', type=int, default=1,
                    help='sequence-parallel degree (transformer only)')
+    p.add_argument('--num-slices', type=int,
+                   default=int(os.environ.get('KFAC_NUM_SLICES', 1)),
+                   help='multi-slice mesh: outer kfac_slice axis over '
+                        'N contiguous device slabs (r20). 1 (default) '
+                        '= the flat mesh, bit-identical to pre-r20 '
+                        'runs. Defaults from KFAC_NUM_SLICES (set by '
+                        'the supervisor on slice-failure failover)')
     p.add_argument('--attn-block-size', type=int, default=None,
                    help='single-device memory-efficient attention: fold '
                         'K/V in blocks of this many tokens (O(seq*block) '
@@ -113,6 +121,12 @@ def parse_args(argv=None):
                         'compute/communication overlap; exact by EMA '
                         'linearity — off (default) keeps the '
                         'bit-identical eager per-step reduction)')
+    p.add_argument('--hierarchical-reduce', action='store_true',
+                   help='two-level factor reduction (r20; requires '
+                        '--num-slices > 1, mutually exclusive with '
+                        '--deferred-factor-reduction): intra-slice '
+                        'pmean on ICI every factor step, one bucketed '
+                        'inter-slice DCN reduce per cadence window')
     p.add_argument('--inv-staleness', type=int, default=0,
                    choices=[0, 1],
                    help='1 = one-window-stale off-critical-path '
@@ -279,6 +293,7 @@ def main(argv=None):
         kfac_cov_update_freq=args.kfac_cov_update_freq,
         inv_pipeline_chunks=args.inv_pipeline_chunks,
         deferred_factor_reduction=args.deferred_factor_reduction,
+        hierarchical_reduce=args.hierarchical_reduce,
         inv_staleness=args.inv_staleness,
         kfac_approx=args.kfac_approx,
         damping=args.damping, factor_decay=args.stat_decay,
@@ -329,8 +344,15 @@ def main(argv=None):
                           'devices': n_dev,
                           'metrics_interval': args.metrics_interval})
     autotune.emit_events(metrics_sink, tune_events)
-    rank_sink = obs.cli.make_rank_shard_sink(
-        args, info, meta={'cli': 'train_language_model'})
+    shard_meta = {'cli': 'train_language_model'}
+    if (args.num_slices > 1
+            and info['process_count'] % args.num_slices == 0):
+        # Stamp the slice id into the shard meta so the report's
+        # straggler section can aggregate per-slice skew rows (r20).
+        shard_meta['slice'] = multislice.slice_of_rank(
+            info['process_index'], info['process_count'],
+            args.num_slices)
+    rank_sink = obs.cli.make_rank_shard_sink(args, info, meta=shard_meta)
     # r17 liveness lease (per rank; armed by --heartbeat-dir or the
     # supervisor's KFAC_HEARTBEAT_DIR — None otherwise, and the engine
     # path is byte-identical without it).
@@ -353,7 +375,11 @@ def main(argv=None):
                                train=False)
     params = variables['params']
 
-    mesh = D.make_kfac_mesh(
+    # num_slices == 1 returns the flat make_kfac_mesh mesh (the
+    # --num-slices 1 bit-identity guarantee); > 1 adds the outer
+    # kfac_slice axis over contiguous device slabs.
+    mesh = multislice.make_multislice_mesh(
+        num_slices=args.num_slices,
         comm_method=optimizers.COMM_METHODS[args.comm_method],
         grad_worker_fraction=args.grad_worker_fraction, seq_parallel=sp)
     # Commit params replicated on the mesh up front: the resume path
@@ -393,8 +419,9 @@ def main(argv=None):
                 jax.lax.axis_index(seq.SEQ_AXIS) * t_local)
         return kwargs
 
-    data_spec = (P(D.KFAC_AXES, seq.SEQ_AXIS) if seq_axis
-                 else P(D.KFAC_AXES))
+    batch_axes = multislice.batch_axes(mesh)
+    data_spec = (P(batch_axes, seq.SEQ_AXIS) if seq_axis
+                 else P(batch_axes))
     if dkfac is not None:
         step_fn = dkfac.build_train_step(
             loss_fn, tx, model_kwargs_fn=model_kwargs_fn,

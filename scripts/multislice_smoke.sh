@@ -1,0 +1,113 @@
+#!/usr/bin/env bash
+# Fast-tier multi-slice smoke (r20): the two-level collective topology
+# end to end on CPU through the REAL LM entry point —
+#   1. a 2-slice x 4-device nested-mesh run (--num-slices 2
+#      --hierarchical-reduce) under the full runtime sanitizer
+#      (KFAC_SANITIZE=transfer,nan,retrace): factors pmean on-slice
+#      every factor step, the cross-slice (DCN) reduce fires only on
+#      r14 window heads — assert the stream shows the hierarchical
+#      schedule (fired stages carrying 'dcn_reduce', ZERO retrace
+#      events);
+#   2. slice-loss failover (chaos slice-loss@1->1): drain a 2-slice
+#      8-device run, relaunch on the single survivor slice (4 devices,
+#      KFAC_NUM_SLICES exported by the harness so the CLI's
+#      --num-slices default follows), resume through the elastic
+#      reshard path — assert topology_change 8->4 with resharded=true
+#      and global steps continuing, not restarting;
+#   3. observability-gate self-check over the hierarchical stream (the
+#      CI plumbing path, like overlap_smoke.sh's leg 2).
+# The same contracts are pinned in tests/test_multislice.py; this
+# wrapper is the standalone/CI-pipeline form.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="$(mktemp -d)"
+trap 'rm -rf "$out"' EXIT
+
+echo "== leg 1: 2-slice x 4-device hierarchical-reduce run =="
+# Compile cache OFF: multi-device CPU warm reads are the known-
+# segfaulting combination (see tests/conftest.py).
+env JAX_PLATFORMS=cpu KFAC_COMPILE_CACHE=0 KFAC_SYNTHETIC_LM=2048 \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    KFAC_SANITIZE=transfer,nan,retrace \
+python examples/train_language_model.py \
+    --arch transformer --emsize 64 --nlayers 1 --nheads 2 \
+    --bptt 16 --batch-size 8 --epochs 1 --no-resume \
+    --num-slices 2 --hierarchical-reduce --kfac-update-freq 8 \
+    --log-dir "$out/logs-hier" --checkpoint-dir "$out/ckpt-hier" \
+    --kfac-metrics "$out/hier.jsonl" --metrics-interval 1
+
+python - "$out/hier.jsonl" <<'EOF'
+import sys
+from distributed_kfac_pytorch_tpu.observability import sink as obs_sink
+
+records, _ = obs_sink.read_jsonl_tolerant(sys.argv[1])
+fired = [r.get('fired') for r in records if r.get('kind') == 'step']
+dcn = [f for f in fired if f and 'dcn_reduce' in f]
+assert dcn, fired        # cross-slice reduce fired on window heads
+# No window head may carry a PLAIN 'reduce': every deferred boundary
+# of a hierarchical run is the DCN one.
+assert not any(f and 'reduce' in f and 'dcn_reduce' not in f
+               for f in fired), fired
+retraces = [r for r in records if r.get('event') == 'retrace']
+assert not retraces, retraces   # zero retraces on the nested mesh
+print(f'hierarchical schedule OK ({len(dcn)} DCN window(s), '
+      'zero retraces)')
+EOF
+
+echo "== leg 2: slice-loss failover (2 slices -> 1 survivor) =="
+# KFAC_NUM_SLICES (not --num-slices) carries the slice count so the
+# chaos harness can rewrite it for the relaunch: slice-loss@1->1
+# drains at step 1, halves the forced world to the survivor slice and
+# exports KFAC_NUM_SLICES=1 — the resumed run reshards elastically.
+env JAX_PLATFORMS=cpu KFAC_COMPILE_CACHE=0 KFAC_SYNTHETIC_LM=2048 \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    KFAC_NUM_SLICES=2 \
+python -m distributed_kfac_pytorch_tpu.resilience.chaos \
+    'slice-loss@1->1' --relaunch 1 -- \
+    python examples/train_language_model.py \
+    --arch transformer --emsize 64 --nlayers 1 --nheads 2 \
+    --bptt 16 --batch-size 8 --epochs 1 \
+    --checkpoint-freq 1 --checkpoint-steps 1 \
+    --log-dir "$out/logs-loss" --checkpoint-dir "$out/ckpt-loss" \
+    --kfac-metrics "$out/loss.jsonl" --metrics-interval 1
+
+python - "$out" <<'EOF'
+import sys
+from distributed_kfac_pytorch_tpu.observability import sink
+
+out = sys.argv[1]
+live = sink.read_jsonl(f'{out}/loss.jsonl')
+steps = [r['step'] for r in live if r['kind'] == 'step']
+events = [r['event'] for r in live if r['kind'] == 'event']
+assert 'topology_change' in events and 'restore' in events, events
+tc = next(r for r in live if r.get('event') == 'topology_change')
+assert tc['data']['from_devices'] == 8, tc
+assert tc['data']['to_devices'] == 4, tc
+assert tc['data']['resharded'], tc
+assert steps and steps[0] > 0, steps   # continued, not cold-restarted
+prev = sink.read_incarnation(f'{out}/loss.jsonl.prev.1')
+prev_events = [r.get('event') for r in prev if r['kind'] == 'event']
+assert 'preemption' in prev_events, prev_events
+print('slice-loss failover OK (8->4 devices, elastic resume, steps '
+      f'continued at {steps[0]})')
+EOF
+# The report schema-validates both incarnations (non-zero exit fails
+# the smoke).
+python -m distributed_kfac_pytorch_tpu.observability.report \
+    "$out/loss.jsonl"
+
+echo "== leg 3: gate self-check over the hierarchical stream =="
+python -m distributed_kfac_pytorch_tpu.observability.gate \
+    "$out/hier.jsonl" --write-baseline "$out/B.json"
+python -m distributed_kfac_pytorch_tpu.observability.gate \
+    "$out/hier.jsonl" --baseline "$out/B.json" --allow-missing \
+    --json > "$out/gate.json"
+python - "$out/gate.json" <<'EOF'
+import json, sys
+v = json.load(open(sys.argv[1]))
+assert v['pass'] is True, v
+print('gate self-check OK')
+EOF
+
+echo "multislice smoke OK"

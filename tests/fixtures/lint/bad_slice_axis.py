@@ -1,0 +1,10 @@
+"""Seeded multi-slice violations: string-literal slice axis names."""
+import jax
+
+
+def hierarchical_reduce(c):
+    c = jax.lax.pmean(c, 'kfac_ig')                         # axis-literal
+    c = jax.lax.pmean(c, axis_name=('kfac_slice',))         # axis-literal
+    s = jax.lax.axis_index('kfac_slice')                    # axis-literal
+    g = jax.lax.psum(c, ('kfac_slice', 'kfac_ig'))          # axis-literal
+    return c, s, g

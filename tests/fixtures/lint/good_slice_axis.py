@@ -1,0 +1,15 @@
+"""Multi-slice collectives named via the canonical axis constants."""
+import jax
+
+from distributed_kfac_pytorch_tpu.parallel.distributed import (
+    INV_GROUP_AXIS,
+    SLICE_AXIS,
+)
+
+
+def hierarchical_reduce(c):
+    c = jax.lax.pmean(c, INV_GROUP_AXIS)
+    c = jax.lax.pmean(c, axis_name=(SLICE_AXIS,))
+    s = jax.lax.axis_index(SLICE_AXIS)
+    g = jax.lax.psum(c, (SLICE_AXIS, INV_GROUP_AXIS))
+    return c, s, g

@@ -395,6 +395,65 @@ def run_comm_bench_flagship(iters: int = 3) -> dict:
     return _time_grouped_collectives(cases, iters)
 
 
+def run_comm_bench_hier(iters: int = 10, size: int = 256) -> dict:
+    """Flat vs hierarchical factor-reduction collectives on a 2-slice
+    nested mesh whose slice boundary IS the process boundary (r20):
+    slice 0 = process 0's devices, slice 1 = process 1's — the
+    cross-slice leg is the gloo/DCN stand-in, the on-slice leg stays
+    shared-memory/ICI.
+
+    Three rows, one per collective the r20 reduce modes issue:
+    ``factor_pmean_flat`` (one global pmean over slice+kfac axes —
+    what every factor step pays without hierarchy), ``factor_pmean
+    _intra_slice`` (kfac axes only — the hierarchical per-step cost)
+    and ``factor_pmean_dcn_boundary`` (slice axis only — the
+    hierarchical once-per-window cost). PERF.md's r20 decision rule
+    combines them: hierarchical wins a window of W factor steps when
+    ``W*intra + dcn < W*flat``.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_kfac_pytorch_tpu.parallel.distributed import (
+        KFAC_AXES,
+        SLICE_AXIS,
+    )
+
+    devs = jax.devices()
+    half = len(devs) // 2
+    # (slice, ig, gw): each slice is one process's devices, laid out
+    # as a (half//2, 2) KAISA grid within the slice.
+    arr = np.stack([np.asarray(devs[:half]).reshape(half // 2, 2),
+                    np.asarray(devs[half:]).reshape(half // 2, 2)])
+    mesh = Mesh(arr, (SLICE_AXIS,) + KFAC_AXES)
+    x = jnp.ones((size, size), jnp.float32)
+    cases = {
+        'factor_pmean_flat':
+            lambda v: jax.lax.pmean(v, (SLICE_AXIS,) + KFAC_AXES),
+        'factor_pmean_intra_slice':
+            lambda v: jax.lax.pmean(v, KFAC_AXES),
+        'factor_pmean_dcn_boundary':
+            lambda v: jax.lax.pmean(v, (SLICE_AXIS,)),
+    }
+    out = {'slice_per_process': {}}
+    for op_name, op in cases.items():
+        # kfaclint: waive[retrace-jit-in-loop] per-op comm microbench: one program each, compile excluded by the warm call
+        fn = jax.jit(jax.shard_map(op, mesh=mesh, in_specs=P(),
+                                   out_specs=P(), check_vma=False))
+        jax.block_until_ready(fn(x))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            jax.block_until_ready(fn(x))
+        out['slice_per_process'][op_name] = round(
+            (time.perf_counter() - t0) / iters * 1000.0, 3)
+    return out
+
+
 def main():
     port, pid, nproc, out_path = sys.argv[1:5]
     mode = sys.argv[5] if len(sys.argv) > 5 else 'train'
@@ -448,8 +507,9 @@ def main():
                      **{k: v for k, v in flat.items()})
         print(f'worker {pid} done', flush=True)
         return
-    if mode in ('comm', 'comm_flagship'):
+    if mode in ('comm', 'comm_flagship', 'comm_hier'):
         result = (run_comm_bench_flagship() if mode == 'comm_flagship'
+                  else run_comm_bench_hier() if mode == 'comm_hier'
                   else run_comm_bench())
         if info['process_index'] == 0:
             import json

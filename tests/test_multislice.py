@@ -1,0 +1,834 @@
+"""Tests for the r20 multi-slice subsystem (hierarchy-aware
+collectives and slice-confined inverse groups).
+
+The acceptance pins (ISSUE 18):
+
+  - **Nested mesh** — ``multislice.make_multislice_mesh`` builds the
+    ``(slices, inv_groups, grad_workers)`` mesh from contiguous device
+    runs; ``num_slices=1`` IS the flat ``make_kfac_mesh`` mesh (the
+    ``--num-slices 1`` bit-identity guarantee holds at the mesh level:
+    same device array, same axis names, same program).
+  - **Hierarchical parity** — two-level factor reduction (on-slice
+    pmean every factor step, one cross-slice reduce per r14 window) is
+    exact by EMA linearity: a hierarchical run matches a flat-reduce
+    run ON THE SAME 2-slice mesh to fp-reduction tolerance, including
+    the r13 tied/reduce LM layers, over multiple deferred windows.
+  - **Slice-confined inverses** — the decomposition/inverse program
+    never reduces over the slice (DCN) axis: pinned by jaxpr
+    inspection of ``recompute_inverses`` on a 2-slice mesh.
+  - **Zero retraces** — the hierarchical schedule compiles one program
+    per cadence-flag variant (the r9/r14 ``trace_counts`` guard).
+  - **N→M→N slice-change elastic resume** — save on a 2-slice 8-device
+    mesh, resume on the 1-slice 4-device survivor mesh (the slice-loss
+    world), re-save, resume back: bit-identical continuation (the
+    global-row reshard is a lossless permutation).
+
+Plus the satellites: ``slice-loss@K->S`` fault parsing and the 3-way
+drain mutual exclusion; supervisor slice-failure classification
+(all-ranks-of-one-slice-stale → survivor-slice failover, spanning
+dead sets stay ``dead_rank``); fleet gang placement (whole-slice
+sizing, fail-closed without ``--slice-devices``); the kfaclint
+SLICE_AXIS fixtures; and the per-slice straggler skew rows.
+"""
+
+import argparse
+import os
+import pathlib
+import sys
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_kfac_pytorch_tpu import KFAC, launch
+from distributed_kfac_pytorch_tpu import elastic as elastic_lib
+from distributed_kfac_pytorch_tpu.analysis.rules import lint_source
+from distributed_kfac_pytorch_tpu.elastic import topology as topo_lib
+from distributed_kfac_pytorch_tpu.fleet import jobspec as js
+from distributed_kfac_pytorch_tpu.fleet import (
+    scheduler as fleet_sched,
+)
+from distributed_kfac_pytorch_tpu.models import transformer_lm
+from distributed_kfac_pytorch_tpu.multislice import mesh as ms_mesh
+from distributed_kfac_pytorch_tpu.observability import sink as obs_sink
+from distributed_kfac_pytorch_tpu.observability import (
+    stragglers as straggler_lib,
+)
+from distributed_kfac_pytorch_tpu.parallel import distributed as D
+from distributed_kfac_pytorch_tpu.parallel.distributed import (
+    GRAD_WORKER_AXIS,
+    INV_GROUP_AXIS,
+    SLICE_AXIS,
+)
+from distributed_kfac_pytorch_tpu.preconditioner import CommMethod
+from distributed_kfac_pytorch_tpu.resilience import (
+    cli as resil_cli,
+    faults,
+    supervisor as sup_lib,
+)
+from distributed_kfac_pytorch_tpu.training import (
+    checkpoint as ckpt_lib,
+    engine,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESIL = os.path.join(REPO, 'distributed_kfac_pytorch_tpu',
+                     'resilience')
+FIXTURES = pathlib.Path(__file__).parent / 'fixtures' / 'lint'
+
+
+# ---------------------------------------------------------------------------
+# Mesh construction + slice/rank arithmetic
+# ---------------------------------------------------------------------------
+
+class TestMesh:
+    def test_nested_axes_and_contiguous_slices(self):
+        mesh = ms_mesh.make_multislice_mesh(
+            jax.devices()[:8], num_slices=2,
+            comm_method=CommMethod.HYBRID_OPT,
+            grad_worker_fraction=0.5)
+        assert mesh.axis_names == (SLICE_AXIS, INV_GROUP_AXIS,
+                                   GRAD_WORKER_AXIS)
+        assert dict(mesh.shape) == {SLICE_AXIS: 2, INV_GROUP_AXIS: 2,
+                                    GRAD_WORKER_AXIS: 2}
+        # Slices are CONTIGUOUS runs of the global device list: slice
+        # s owns devices [s*4, (s+1)*4) regardless of the in-slice
+        # KAISA grid permutation.
+        devs = np.asarray(mesh.devices)
+        ids = np.vectorize(lambda d: d.id)(devs)
+        assert sorted(ids[0].ravel()) == [0, 1, 2, 3]
+        assert sorted(ids[1].ravel()) == [4, 5, 6, 7]
+        assert ms_mesh.slice_count(mesh) == 2
+        assert ms_mesh.batch_axes(mesh) == (
+            SLICE_AXIS, INV_GROUP_AXIS, GRAD_WORKER_AXIS)
+
+    def test_one_slice_is_the_flat_mesh(self):
+        # The --num-slices 1 bit-identity guarantee at the mesh level:
+        # identical device array and axis names -> identical programs.
+        m1 = ms_mesh.make_multislice_mesh(
+            jax.devices()[:8], num_slices=1,
+            comm_method=CommMethod.HYBRID_OPT,
+            grad_worker_fraction=0.5)
+        flat = D.make_kfac_mesh(jax.devices()[:8],
+                                comm_method=CommMethod.HYBRID_OPT,
+                                grad_worker_fraction=0.5)
+        assert m1 == flat
+        assert SLICE_AXIS not in m1.axis_names
+        assert ms_mesh.slice_count(m1) == 1
+        assert ms_mesh.batch_axes(m1) == (INV_GROUP_AXIS,
+                                          GRAD_WORKER_AXIS)
+
+    def test_in_slice_grid_matches_flat_small_world(self):
+        # Each slice's KAISA grid is the WorkerAllocator grid a flat
+        # world/num_slices-device run would build: ICI participant
+        # sets are unchanged from a 4-device flat run.
+        sliced = ms_mesh.make_multislice_mesh(
+            jax.devices()[:8], num_slices=2,
+            comm_method=CommMethod.HYBRID_OPT,
+            grad_worker_fraction=0.5)
+        flat4 = D.make_kfac_mesh(jax.devices()[:4],
+                                 comm_method=CommMethod.HYBRID_OPT,
+                                 grad_worker_fraction=0.5)
+        ids = np.vectorize(lambda d: d.id)
+        np.testing.assert_array_equal(
+            ids(np.asarray(sliced.devices))[0],
+            ids(np.asarray(flat4.devices)))
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match='does not divide'):
+            ms_mesh.make_multislice_mesh(jax.devices()[:8],
+                                         num_slices=3)
+        with pytest.raises(ValueError, match='num_slices=0'):
+            ms_mesh.make_multislice_mesh(jax.devices()[:8],
+                                         num_slices=0)
+
+    def test_slice_rank_arithmetic(self):
+        assert ms_mesh.slice_rank_groups(8, 2) == (
+            (0, 1, 2, 3), (4, 5, 6, 7))
+        assert ms_mesh.slice_rank_groups(4, 1) == ((0, 1, 2, 3),)
+        assert [ms_mesh.slice_of_rank(r, 8, 2) for r in range(8)] \
+            == [0, 0, 0, 0, 1, 1, 1, 1]
+        assert ms_mesh.slice_of_rank(3, 4, 1) == 0
+        with pytest.raises(ValueError, match='does not divide'):
+            ms_mesh.slice_rank_groups(8, 3)
+        with pytest.raises(ValueError, match='out of range'):
+            ms_mesh.slice_of_rank(4, 4, 2)
+
+
+# ---------------------------------------------------------------------------
+# TopologySpec: the eighth scalar
+# ---------------------------------------------------------------------------
+
+class TestTopologySlices:
+    def test_scalars_roundtrip(self):
+        t = topo_lib.TopologySpec(processes=1, devices=8, rows=2,
+                                  cols=2, slices=2,
+                                  distribute_layer_factors=True)
+        s = t.scalars()
+        assert s['topo_slices'] == 2
+        assert topo_lib.TopologySpec.from_scalars(s) == t
+
+    def test_pre_r20_bundles_default_to_one_slice(self):
+        t = topo_lib.TopologySpec(1, 8, 2, 4)
+        s = t.scalars()
+        del s['topo_slices']  # a bundle written before r20
+        assert topo_lib.TopologySpec.from_scalars(s).slices == 1
+
+    def test_layout_key_folds_slices_into_global_rows(self):
+        # assign_work places over the GLOBAL row space slices*rows: a
+        # slice-count change that preserves it is layout-preserving
+        # (restore takes the fast re-commit path, no reshard).
+        a = topo_lib.TopologySpec(1, 8, rows=2, cols=2, slices=2)
+        b = topo_lib.TopologySpec(1, 8, rows=4, cols=2, slices=1)
+        assert a.layout_key == b.layout_key
+        assert not a.needs_reshard(b)
+        c = topo_lib.TopologySpec(1, 8, rows=2, cols=4, slices=1)
+        assert a.needs_reshard(c)
+
+    def test_inconsistent_slices_raise(self):
+        with pytest.raises(ValueError, match='inconsistent'):
+            topo_lib.TopologySpec(1, 8, rows=2, cols=2, slices=4)
+        with pytest.raises(ValueError, match='slices'):
+            topo_lib.TopologySpec(1, 8, rows=2, cols=4, slices=0)
+
+    def test_of_mesh_records_slice_dim(self):
+        mesh = ms_mesh.make_multislice_mesh(
+            jax.devices()[:8], num_slices=2,
+            comm_method=CommMethod.HYBRID_OPT,
+            grad_worker_fraction=0.5)
+        t = topo_lib.TopologySpec.of_mesh(
+            mesh, distribute_layer_factors=True)
+        assert (t.slices, t.rows, t.cols, t.devices) == (2, 2, 2, 8)
+
+
+# ---------------------------------------------------------------------------
+# Knob validation (fail-closed surfaces)
+# ---------------------------------------------------------------------------
+
+class _Net(nn.Module):
+    """Same shape discipline as test_elastic's net: repeated + odd
+    dims leave padding slots in the bucket stacks on every grid the
+    tests use — the partial-bucket case the global-row placement and
+    the reshard must handle."""
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Dense(16)(x))
+        x = nn.relu(nn.Dense(16)(x))
+        x = nn.relu(nn.Dense(12)(x))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(4)(x)
+
+
+class TestHierarchicalKnob:
+    def test_mutually_exclusive_with_deferred(self):
+        with pytest.raises(ValueError, match='mutually exclusive'):
+            KFAC(_Net(), hierarchical_reduce=True,
+                 deferred_factor_reduction=True)
+
+    def test_single_chip_step_refuses(self):
+        kfac = KFAC(_Net(), factor_update_freq=1, inv_update_freq=2,
+                    hierarchical_reduce=True)
+        variables, state = kfac.init(jax.random.PRNGKey(0),
+                                     jnp.zeros((2, 8)))
+
+        def loss_fn(out):
+            return jnp.mean(out ** 2)
+
+        _, _, grads, captures, _ = kfac.capture.loss_and_grads(
+            loss_fn, variables['params'], jnp.zeros((2, 8)))
+        with pytest.raises(ValueError, match='SPMD-only'):
+            kfac.step(state, grads, captures)
+
+    def test_flat_mesh_refuses(self):
+        kfac = KFAC(_Net(), hierarchical_reduce=True,
+                    comm_method=CommMethod.HYBRID_OPT,
+                    grad_worker_fraction=0.5)
+        variables, _ = kfac.init(jax.random.PRNGKey(0),
+                                 jnp.zeros((2, 8)))
+        mesh = D.make_kfac_mesh(jax.devices()[:8],
+                                comm_method=CommMethod.HYBRID_OPT,
+                                grad_worker_fraction=0.5)
+        params = launch.replicate_on_mesh(mesh, variables['params'])
+        with pytest.raises(ValueError, match='multi-slice mesh'):
+            D.DistributedKFAC(kfac, mesh, params)
+
+
+# ---------------------------------------------------------------------------
+# SPMD harness (cached compiles, shared across the parity/elastic
+# classes — the test_elastic discipline)
+# ---------------------------------------------------------------------------
+
+_HYPER = {'lr': 0.05, 'damping': 0.003,
+          'factor_update_freq': 1, 'inv_update_freq': 4}
+
+
+def _setup(n_devices, num_slices=1, hier=False):
+    """Mesh/dkfac/jitted-step, cached per configuration. One r14
+    window = inv_update_freq = 4 steps (the hierarchical DCN-reduce
+    cadence)."""
+    key = (n_devices, num_slices, hier)
+    if key not in _setup.cache:
+        kfac = KFAC(_Net(), factor_update_freq=1, inv_update_freq=4,
+                    damping=0.003, lr=0.1,
+                    comm_method=CommMethod.HYBRID_OPT,
+                    grad_worker_fraction=0.5,
+                    hierarchical_reduce=hier)
+        variables, _ = kfac.init(jax.random.PRNGKey(0),
+                                 jnp.zeros((2, 8)))
+        mesh = ms_mesh.make_multislice_mesh(
+            jax.devices()[:n_devices], num_slices=num_slices,
+            comm_method=CommMethod.HYBRID_OPT,
+            grad_worker_fraction=0.5)
+        params = launch.replicate_on_mesh(mesh, variables['params'])
+        dkfac = D.DistributedKFAC(kfac, mesh, params)
+        tx = optax.sgd(0.05, momentum=0.9)
+
+        def loss_fn(out, b):
+            return jnp.mean((out - b[1]) ** 2)
+
+        step_fn = dkfac.build_train_step(loss_fn, tx, donate=False)
+        _setup.cache[key] = dict(mesh=mesh, dkfac=dkfac, tx=tx,
+                                 step_fn=step_fn, params=params,
+                                 hier=hier)
+    return _setup.cache[key]
+
+
+_setup.cache = {}
+
+
+def _batches(n=8):
+    rng = np.random.default_rng(0)
+    return [(rng.normal(size=(32, 8)).astype(np.float32),
+             rng.normal(size=(32, 4)).astype(np.float32))
+            for _ in range(n)]
+
+
+def _fresh(s):
+    return dict(params=s['params'], opt=s['tx'].init(s['params']),
+                kstate=s['dkfac'].init_state(s['params']), extra={})
+
+
+def _run(s, state, batches, start):
+    losses = []
+    for i, b in enumerate(batches, start=start):
+        flags = engine.cadence_flags(i, 1, 4,
+                                     deferred_reduce=s['hier'])
+        (state['params'], state['opt'], state['kstate'],
+         state['extra'], m) = s['step_fn'](
+            state['params'], state['opt'], state['kstate'],
+            state['extra'], b, _HYPER, **flags)
+        losses.append(float(jax.device_get(m['loss'])))
+    return losses
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical reduce: parity, confinement, zero retraces
+# ---------------------------------------------------------------------------
+
+class TestHierarchicalParity:
+    def test_slice_attrs_and_global_rows(self):
+        s = _setup(8, num_slices=2, hier=True)
+        dk = s['dkfac']
+        assert dk.sliced and dk.n_slices == 2
+        assert (dk.n_rows, dk.n_cols) == (2, 2)
+        assert dk.total_rows == 4
+        assert dk.batch_axes[0] == SLICE_AXIS
+
+    def test_hier_matches_flat_reduce_on_same_sliced_mesh(self):
+        """The EMA-linearity exactness pin: deferring the cross-slice
+        reduce to window boundaries (while reducing on-slice every
+        factor step) reproduces the every-step global reduce to fp
+        reduction-order tolerance — per-step losses AND final params,
+        over two full deferred windows."""
+        s_flat = _setup(8, num_slices=2, hier=False)
+        s_hier = _setup(8, num_slices=2, hier=True)
+        batches = _batches(8)
+        st_f, st_h = _fresh(s_flat), _fresh(s_hier)
+        lf = _run(s_flat, st_f, batches, 0)
+        lh = _run(s_hier, st_h, batches, 0)
+        np.testing.assert_allclose(lh, lf, rtol=1e-5, atol=1e-7)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(jax.device_get(a)),
+                np.asarray(jax.device_get(b)),
+                rtol=1e-3, atol=1e-5),
+            st_h['params'], st_f['params'])
+
+    def test_sliced_matches_flat_mesh_trajectory(self):
+        # The nested mesh itself changes only the collective LAYOUT:
+        # a 2-slice 8-device run tracks the flat 8-device run within
+        # cross-layout fp tolerance.
+        s_flat8 = _setup(8, num_slices=1)
+        s_sliced = _setup(8, num_slices=2)
+        batches = _batches(6)
+        lf = _run(s_flat8, _fresh(s_flat8), batches, 0)
+        ls = _run(s_sliced, _fresh(s_sliced), batches, 0)
+        np.testing.assert_allclose(ls, lf, rtol=2e-4, atol=1e-6)
+
+    def test_hier_parity_tied_reduce_lm(self):
+        """r13 coverage: the tied-embedding + reduce-approximation LM
+        under hierarchical reduce matches flat reduce on the same
+        2-slice mesh (the sharing layers' factor contributions ride
+        the same two-level reduction)."""
+        ids_np = np.random.RandomState(0).randint(0, 37, (8, 8))
+        tgt_np = np.random.RandomState(1).randint(0, 37, (8, 8))
+        batch = (jnp.asarray(ids_np), jnp.asarray(tgt_np))
+
+        def make(hier):
+            model = transformer_lm.TransformerLM(
+                vocab_size=37, d_model=16, num_layers=1, num_heads=2,
+                max_len=8, dropout=0.0, tie_weights=True)
+            kfac = KFAC(model, factor_update_freq=1,
+                        inv_update_freq=2, damping=0.01, lr=0.1,
+                        kfac_approx='reduce',
+                        comm_method=CommMethod.HYBRID_OPT,
+                        grad_worker_fraction=0.5,
+                        hierarchical_reduce=hier)
+            variables, _ = kfac.init(jax.random.PRNGKey(0), batch[0],
+                                     train=False)
+            mesh = ms_mesh.make_multislice_mesh(
+                jax.devices()[:8], num_slices=2,
+                comm_method=CommMethod.HYBRID_OPT,
+                grad_worker_fraction=0.5)
+            params = launch.replicate_on_mesh(mesh,
+                                              variables['params'])
+            dkfac = D.DistributedKFAC(kfac, mesh, params)
+            tx = optax.sgd(0.05)
+
+            def loss_fn(out, b):
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    out, b[1]).mean()
+
+            step = dkfac.build_train_step(
+                loss_fn, tx, donate=False,
+                model_kwargs_fn=lambda b: {'train': False})
+            hyper = {'lr': 0.05, 'damping': 0.01,
+                     'factor_update_freq': 1, 'inv_update_freq': 2}
+            state = dict(params=params, opt=tx.init(params),
+                         kstate=dkfac.init_state(params), extra={})
+            losses = []
+            for i in range(4):
+                flags = engine.cadence_flags(i, 1, 2,
+                                             deferred_reduce=hier)
+                (state['params'], state['opt'], state['kstate'],
+                 state['extra'], m) = step(
+                    state['params'], state['opt'], state['kstate'],
+                    state['extra'], batch, hyper, **flags)
+                losses.append(float(jax.device_get(m['loss'])))
+            return losses
+
+        np.testing.assert_allclose(make(True), make(False),
+                                   rtol=1e-5, atol=1e-7)
+
+
+class TestSliceConfinement:
+    def test_inverse_program_never_reduces_over_dcn(self):
+        """The jaxpr pin: decompositions/inverses are slice-confined.
+        The recompute program's collectives reduce over the K-FAC
+        axes only — no psum/all_gather/etc. names the slice axis, so
+        no factor or inverse bytes ever cross the DCN boundary (only
+        preconditioned gradients do, in the train step)."""
+        s = _setup(8, num_slices=2, hier=True)
+        state = s['dkfac'].init_state(s['params'])
+        import re
+        text = str(jax.make_jaxpr(
+            lambda st: s['dkfac'].recompute_inverses(st))(state))
+        # One match per collective application WITH its params (the
+        # pretty-printer wraps params across lines, so normalize
+        # whitespace first).
+        norm = ' '.join(text.split())
+        collectives = re.findall(
+            r'(?:psum\w*|pmean|all_gather|reduce_scatter|all_to_all'
+            r'|ppermute)\[[^\]]*\]', norm)
+        assert collectives, 'expected collectives in the program'
+        crossing = [app for app in collectives if SLICE_AXIS in app]
+        assert not crossing, crossing
+        # Sanity: the inverse broadcast over the grad-worker axis is
+        # present (the program is the real one, not a stub).
+        assert any(GRAD_WORKER_AXIS in app for app in collectives)
+
+
+class TestZeroRetraces:
+    def test_hier_schedule_compiles_once_per_variant(self):
+        s = _setup(8, num_slices=2, hier=True)
+        _run(s, _fresh(s), _batches(8), 0)
+        counts = s['step_fn'].trace_counts
+        assert counts and all(n == 1 for n in counts.values()), counts
+
+
+# ---------------------------------------------------------------------------
+# Elastic: slice-count changes (N -> M -> N)
+# ---------------------------------------------------------------------------
+
+def _topo(s):
+    return topo_lib.TopologySpec.of_mesh(
+        s['mesh'],
+        distribute_layer_factors=s['dkfac'].distribute_layer_factors)
+
+
+def _bundle(s, state, step):
+    return ckpt_lib.bundle_state(
+        state['params'], state['opt'],
+        s['dkfac'].state_dict(state['kstate']), state['extra'],
+        topology=_topo(s), step=step, epoch=0, step_in_epoch=step,
+        data_seed=0)
+
+
+class _EventSink:
+    def __init__(self):
+        self.events = []
+
+    def event_record(self, name, **data):
+        self.events.append((name, data))
+
+
+def _elastic_resume(s, ckdir):
+    args = argparse.Namespace(no_resume=False, resume_step=None,
+                              checkpoint_dir=str(ckdir))
+    em = ckpt_lib.CheckpointManager(os.path.join(str(ckdir), 'epochs'))
+    sm = ckpt_lib.CheckpointManager(os.path.join(str(ckdir), 'steps'))
+    state = _fresh(s)
+    sink = _EventSink()
+    tree, _e0, _off, _src = resil_cli.resume(
+        args, em, sm, _bundle(s, state, 0), sink=sink,
+        elastic=elastic_lib.ElasticResume(
+            mesh=s['mesh'], dkfac=s['dkfac'], params=s['params']))
+    state['params'] = tree['params']
+    state['opt'] = tree['opt_state']
+    state['kstate'] = s['dkfac'].load_state_dict(tree['kfac'],
+                                                 state['params'])
+    state['extra'] = tree['extra_vars']
+    em.close(), sm.close()
+    return state, int(tree['scalars']['step']), sink.events
+
+
+def _save_step(ckdir, bundle, step):
+    mgr = ckpt_lib.CheckpointManager(os.path.join(str(ckdir), 'steps'))
+    mgr.save(step, bundle, blocking=True)
+    mgr.close()
+
+
+class TestElasticSliceChange:
+    def test_slice_loss_roundtrip_bit_identity_2x4_to_4_back(
+            self, tmp_path):
+        """The N→M→N slice-change pin: save on the 2-slice 8-device
+        mesh at step 3, resume on the 1-slice 4-device survivor mesh
+        (the slice-loss world — global rows 4 -> 2, a real reshard),
+        immediately re-save, resume back on 2 slices and finish. The
+        combined loss sequence equals an uninterrupted 2-slice run's
+        bit-for-bit, and training ON the survivor mesh tracks the
+        sliced trajectory within cross-layout fp tolerance."""
+        s2, s1 = _setup(8, num_slices=2), _setup(4, num_slices=1)
+        assert _topo(s2).layout_key != _topo(s1).layout_key
+        batches = _batches(8)
+
+        full = _run(s2, _fresh(s2), batches, 0)
+
+        st = _fresh(s2)
+        head = _run(s2, st, batches[:3], 0)
+        np.testing.assert_array_equal(head, full[:3])
+        _save_step(tmp_path / 'a', _bundle(s2, st, 3), 3)
+
+        # Shrink onto the survivor slice: 2x4 devices -> 1x4.
+        st1, start, events = _elastic_resume(s1, tmp_path / 'a')
+        assert start == 3
+        assert [e[0] for e in events] == ['topology_change', 'restore']
+        ev = dict(events)['topology_change']
+        assert ev['resharded'] and ev['from_devices'] == 8 \
+            and ev['to_devices'] == 4
+        _save_step(tmp_path / 'b', _bundle(s1, st1, 3), 3)
+
+        # Trajectory equivalence on the survivor mesh.
+        survivor = _run(s1, st1, batches[3:], 3)
+        np.testing.assert_allclose(survivor, full[3:], rtol=2e-4,
+                                   atol=1e-6)
+
+        # Grow back to 2 slices; the round trip is lossless.
+        st2, start, events = _elastic_resume(s2, tmp_path / 'b')
+        assert start == 3
+        assert dict(events)['topology_change']['to_devices'] == 8
+        tail = _run(s2, st2, batches[3:], 3)
+        np.testing.assert_array_equal(np.asarray(head + tail),
+                                      np.asarray(full))
+
+
+# ---------------------------------------------------------------------------
+# slice-loss@K->S fault grammar + 3-way drain exclusion
+# ---------------------------------------------------------------------------
+
+class TestSliceLossFault:
+    def test_parse(self):
+        plan = faults.parse_spec('slice-loss@2->1')
+        assert plan.slice_loss_at == 2 and plan.slice_loss_to == 1
+
+    def test_bad_specs(self):
+        with pytest.raises(ValueError, match='slice-loss'):
+            faults.parse_spec('slice-loss@2')
+        with pytest.raises(ValueError):
+            faults.parse_spec('slice-loss@x->1')
+
+    def test_three_way_drain_mutual_exclusion(self):
+        for spec in ('preempt@1,slice-loss@2->1',
+                     'resize@1->4,slice-loss@2->1',
+                     'preempt@1,resize@2->4'):
+            with pytest.raises(ValueError,
+                               match='cannot be combined'):
+                faults.parse_spec(spec)
+
+    def test_forced_device_count(self):
+        assert faults.forced_device_count(
+            '--xla_force_host_platform_device_count=8 --other=1') == 8
+        assert faults.forced_device_count('--other=1') is None
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: slice-failure classification (jax-free children)
+# ---------------------------------------------------------------------------
+
+_CHILD_PRELUDE = """\
+import os, sys, time
+sys.path.insert(0, {resil!r})
+import heartbeat as hb
+inc = int(os.environ[hb.ENV_INCARNATION])
+d = os.environ[hb.ENV_DIR]
+def beat(step, rank=0):
+    hb.write_lease(hb.lease_path(d, rank), rank=rank, step=step,
+                   incarnation=inc)
+"""
+
+
+def _supervise(tmp_path, child_body, **kw):
+    script = _CHILD_PRELUDE.format(resil=RESIL) + child_body
+    defaults = dict(
+        workdir=str(tmp_path / 'sup'),
+        hang_timeout=30.0, startup_grace=10.0, poll_secs=0.05,
+        drain_grace=5.0, term_grace=1.0, max_restarts=5,
+        backoff=sup_lib.RestartBackoff(base=0.0, cap=0.0))
+    defaults.update(kw)
+    sup = sup_lib.Supervisor([sys.executable, '-c', script],
+                             **defaults)
+    rc = sup.run()
+    events = [(r['event'], r.get('data', {}))
+              for r in obs_sink.read_jsonl(sup.events_path)
+              if r['kind'] == 'event']
+    return rc, events, sup
+
+
+class TestSupervisorSliceFailure:
+    def test_whole_slice_dead_classifies_and_fails_over(
+            self, tmp_path):
+        # 8 devices over 4 ranks in 2 slices: ranks (2, 3) — exactly
+        # slice 1 — beat once then go silent while slice 0 stays
+        # live. The classifier must call it a slice failure and fail
+        # over to the survivor slice's world.
+        rc, events, sup = _supervise(tmp_path, """\
+if inc == 0:
+    beat(0, rank=2); beat(0, rank=3)
+    for i in range(600):
+        beat(i, rank=0); beat(i, rank=1)
+        time.sleep(0.02)
+    sys.exit(1)
+sys.exit(0)
+""", devices=8, slices=2, failover_grace=0.5)
+        assert rc == 0
+        assert [k for k, _ in events] == ['supervisor_failover']
+        data = dict(events[0][1])
+        assert data['reason'] == 'slice_failure'
+        assert data['slice'] == 1
+        assert data['from_devices'] == 8 and data['to_devices'] == 4
+        assert sup.slices == 1  # survivor-slice count committed
+
+    def test_spanning_dead_set_stays_dead_rank(self, tmp_path):
+        # Dead ranks (1, 2) span both slices: NOT a slice failure —
+        # the classification falls back to the r17 dead_rank path.
+        rc, events, _sup = _supervise(tmp_path, """\
+if inc == 0:
+    beat(0, rank=1); beat(0, rank=2)
+    for i in range(600):
+        beat(i, rank=0); beat(i, rank=3)
+        time.sleep(0.02)
+    sys.exit(1)
+sys.exit(0)
+""", devices=8, slices=2, failover_grace=0.5)
+        assert rc == 0
+        assert [k for k, _ in events] == ['supervisor_failover']
+        data = dict(events[0][1])
+        assert data['reason'] == 'dead_rank'
+        assert 'slice' not in data
+
+    def test_child_env_exports_slice_count(self, tmp_path,
+                                           monkeypatch):
+        monkeypatch.delenv('KFAC_NUM_SLICES', raising=False)
+        sup = sup_lib.Supervisor(['x'], workdir=str(tmp_path / 's2'),
+                                 devices=8, slices=2)
+        assert sup._child_env()['KFAC_NUM_SLICES'] == '2'
+        sup1 = sup_lib.Supervisor(['x'], workdir=str(tmp_path / 's1'))
+        assert 'KFAC_NUM_SLICES' not in sup1._child_env()
+
+    def test_validation(self, tmp_path):
+        with pytest.raises(ValueError, match='slices'):
+            sup_lib.Supervisor(['x'], workdir=str(tmp_path),
+                               slices=0)
+
+
+# ---------------------------------------------------------------------------
+# Fleet gang placement
+# ---------------------------------------------------------------------------
+
+def _job(name='j', **extra):
+    return {'name': name, 'argv': ['python', 'train.py'], **extra}
+
+
+class TestFleetGangSpecs:
+    def test_roundtrip_and_max_defaults_to_min(self):
+        spec = js.parse_job(_job('g', min_slices=2))
+        assert spec.min_slices == 2 and spec.max_slices == 2
+        spec = js.parse_job(_job('g', min_slices=1, max_slices=3))
+        assert spec.min_slices == 1 and spec.max_slices == 3
+        assert js.parse_job(_job('d', min_devices=2)).min_slices \
+            is None
+
+    def test_fail_closed_parsing(self):
+        with pytest.raises(ValueError,
+                           match='mutually exclusive'):
+            js.parse_job(_job(min_slices=2, min_devices=2))
+        with pytest.raises(ValueError,
+                           match='requires min_slices'):
+            js.parse_job(_job(max_slices=2))
+        with pytest.raises(ValueError, match='below'):
+            js.parse_job(_job(min_slices=3, max_slices=2))
+        with pytest.raises(ValueError):
+            js.parse_job(_job(min_slices=0))
+
+    def test_slice_sizing_and_fail_closed_translation(self, tmp_path):
+        gang = js.parse_job(_job('g', min_slices=2, max_slices=3))
+        fleet = fleet_sched.FleetScheduler(
+            [gang], pool_devices=16, workdir=str(tmp_path / 'a'),
+            slice_devices=4)
+        assert fleet._job_min(gang) == 8
+        assert fleet._job_max(gang) == 12
+        # Without --slice-devices the gang job is unsatisfiable BY
+        # CONSTRUCTION (min > pool, max 0): fail-closed, never sized
+        # by guesswork.
+        bare = fleet_sched.FleetScheduler(
+            [gang], pool_devices=16, workdir=str(tmp_path / 'b'))
+        assert bare._job_min(gang) == 17
+        assert bare._job_max(gang) == 0
+        with pytest.raises(ValueError, match='slice_devices'):
+            fleet_sched.FleetScheduler(
+                [gang], pool_devices=16, workdir=str(tmp_path / 'c'),
+                slice_devices=0)
+
+
+def _gang_spec(name, body, **kw):
+    script = _CHILD_PRELUDE.format(resil=RESIL) + body
+    return js.parse_job({'name': name,
+                         'argv': [sys.executable, '-c', script], **kw})
+
+
+_GANG_CHILD = """\
+for i in range(6):
+    beat(i)
+    time.sleep(0.02)
+sys.exit(0)
+"""
+
+
+class TestFleetGangPlacement:
+    def test_waterfill_never_splits_a_slice(self, tmp_path):
+        # Pool 10, slices of 4: a min 2 / max 3 gang job admits at
+        # EXACTLY 8 devices — the 2 leftover devices are a partial
+        # slice and must not be handed out.
+        spec = _gang_spec('gang', _GANG_CHILD, min_slices=2,
+                          max_slices=3)
+        fleet = fleet_sched.FleetScheduler(
+            [spec], pool_devices=10, workdir=str(tmp_path / 'fleet'),
+            slice_devices=4, poll_secs=0.05,
+            sup_options=dict(hang_timeout=30.0, startup_grace=60.0,
+                             poll_secs=0.05, drain_grace=15.0,
+                             term_grace=2.0),
+            backoff_base=0.0, backoff_cap=0.0)
+        rc = fleet.run(install_signals=False, deadline_s=120)
+        events = [(r['event'], r.get('data', {}))
+                  for r in obs_sink.read_jsonl(fleet.events_path)
+                  if r['kind'] == 'event']
+        assert rc == 0
+        kinds = [k for k, _ in events]
+        assert kinds == ['fleet_admit', 'fleet_complete']
+        assert events[0][1]['devices'] == 8
+
+    def test_gang_without_slice_devices_quarantined(self, tmp_path):
+        spec = _gang_spec('gang', _GANG_CHILD, min_slices=1)
+        fleet = fleet_sched.FleetScheduler(
+            [spec], pool_devices=8, workdir=str(tmp_path / 'fleet'),
+            poll_secs=0.05)
+        rc = fleet.run(install_signals=False, deadline_s=60)
+        events = [(r['event'], r.get('data', {}))
+                  for r in obs_sink.read_jsonl(fleet.events_path)
+                  if r['kind'] == 'event']
+        assert rc == 1
+        assert [k for k, _ in events] == ['fleet_quarantine']
+        assert '--slice-devices' in events[0][1]['reason']
+
+
+# ---------------------------------------------------------------------------
+# kfaclint: SLICE_AXIS in the collective-axis rule
+# ---------------------------------------------------------------------------
+
+class TestLintSliceAxis:
+    def _run(self, name):
+        path = FIXTURES / name
+        return lint_source(str(path), path.read_text(), hot=True)
+
+    def test_symbolic_slice_axis_is_clean(self):
+        findings = [f for f in self._run('good_slice_axis.py')
+                    if not f.waived]
+        assert findings == []
+
+    def test_literal_slice_axis_flagged(self):
+        findings = [f for f in self._run('bad_slice_axis.py')
+                    if not f.waived]
+        assert len(findings) == 4
+        assert {f.rule for f in findings} == {'axis-literal'}
+
+
+# ---------------------------------------------------------------------------
+# Per-slice straggler skew rows
+# ---------------------------------------------------------------------------
+
+def _shard(slice_id, mss, start=0):
+    recs = [{'kind': 'meta', 'meta': {'slice': slice_id}}]
+    recs += [{'kind': 'step', 'step': start + i, 'host_step_ms': ms}
+             for i, ms in enumerate(mss)]
+    return recs
+
+
+class TestPerSliceSkew:
+    def test_rows_aggregate_by_slice(self):
+        shards = {
+            0: _shard(0, [10.0] * 6),
+            1: _shard(0, [11.0] * 6),
+            2: _shard(1, [30.0] * 6),
+            3: _shard(1, [31.0] * 6),
+        }
+        summary = straggler_lib.straggler_summary(shards)
+        ps = summary['per_slice']
+        assert sorted(ps) == [0, 1]
+        assert ps[0]['ranks'] == [0, 1]
+        assert ps[1]['ranks'] == [2, 3]
+        assert ps[0]['n_steps'] == 12
+        assert ps[1]['p50_ms'] > ps[0]['p50_ms']
+        # The sick slice owns every slowest-rank attribution.
+        assert ps[1]['slowest_count'] == 6
+        assert ps[0]['slowest_count'] == 0
+
+    def test_flat_runs_keep_key_but_no_rows(self):
+        shards = {0: _shard(0, [10.0] * 4)[1:],  # no meta record
+                  1: _shard(0, [11.0] * 4)[1:]}
+        summary = straggler_lib.straggler_summary(shards)
+        assert 'per_slice' in summary
+        assert summary['per_slice'] is None

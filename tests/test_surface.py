@@ -50,6 +50,7 @@ SAMPLE_VALUES = {
     'kfac_approx': 'reduce',
     'inv_lowrank_rank': 64,
     'inv_lowrank_dim_threshold': 256,
+    'hierarchical_reduce': True,
 }
 
 
@@ -148,6 +149,7 @@ class TestAutotuneSurface:
         assert set(ignored) <= set(TUNABLE_FIELDS)
         assert set(ignored) == {'deferred_factor_reduction',
                                 'inv_staleness',
+                                'hierarchical_reduce',
                                 'kfac_cov_update_freq',
                                 'inv_pipeline_chunks'}
 
