@@ -36,6 +36,17 @@ family              rules
                     reduced-precision backend default) must pin fp32
                     accumulation via ``preferred_element_type``
                     (the r6 bf16-pipeline contract).
+                    ``dtype-pallas-matmul-accum`` — EVERY matmul
+                    inside a Pallas kernel body (a function passed to
+                    ``pl.pallas_call``, directly or through
+                    ``functools.partial``, or whose signature takes
+                    two or more ``*_ref`` parameters) must pin
+                    ``preferred_element_type=jnp.float32``: Mosaic
+                    lowers an unpinned MXU matmul at the operand
+                    dtype, so a bf16 block accumulates in bf16 with
+                    no backend-default safety net (r21; the fused
+                    factor/precondition kernels are the production
+                    call sites).
 ==================  =====================================================
 
 Waiver syntax (for the documented blocking points — the barrier
@@ -92,6 +103,9 @@ RULES = {
         'instead of the canonical axis constants'),
     'dtype-matmul-accum': (
         'dtype', 'bf16-flavored matmul without fp32 '
+        'preferred_element_type accumulation'),
+    'dtype-pallas-matmul-accum': (
+        'dtype', 'matmul inside a Pallas kernel body without fp32 '
         'preferred_element_type accumulation'),
     'surface-drift': (
         'surface', 'cross-file knob/event surface drift '
@@ -321,14 +335,17 @@ def _has_string_literal(node: ast.AST) -> bool:
 
 class _RuleVisitor(ast.NodeVisitor):
     def __init__(self, path: str, aliases: _Aliases, *, hot: bool,
-                 jit_wrapped_names: frozenset):
+                 jit_wrapped_names: frozenset,
+                 pallas_kernel_names: frozenset = frozenset()):
         self.path = path
         self.aliases = aliases
         self.hot = hot
         self.jit_wrapped_names = jit_wrapped_names
+        self.pallas_kernel_names = pallas_kernel_names
         self.findings: list[Finding] = []
         self._loop_depth = 0
         self._jitted_depth = 0
+        self._pallas_depth = 0
 
     def _emit(self, node, rule: str, message: str):
         family = RULES[rule][0]
@@ -380,17 +397,33 @@ class _RuleVisitor(ast.NodeVisitor):
                 return True
         return False
 
+    def _is_pallas_kernel(self, node) -> bool:
+        """A def is a Pallas kernel body when it is passed to
+        ``pallas_call`` somewhere in the module, or (structural
+        fallback for kernels handed over through wrappers the name
+        scan cannot see) when two or more of its parameters follow
+        the ``*_ref`` Ref-argument naming convention."""
+        if node.name in self.pallas_kernel_names:
+            return True
+        params = node.args.posonlyargs + node.args.args
+        return sum(p.arg.endswith('_ref') for p in params) >= 2
+
     def _function(self, node):
         jitted = (any(self._is_jit_decorator(d)
                       for d in node.decorator_list)
                   or node.name in self.jit_wrapped_names)
+        in_pallas = self._is_pallas_kernel(node)
         if jitted:
             self._jitted_depth += 1
+        if in_pallas:
+            self._pallas_depth += 1
         # a nested def is a fresh loop scope: jit built once inside a
         # helper that a loop merely CALLS is not a per-iteration build
         saved_loops, self._loop_depth = self._loop_depth, 0
         self.generic_visit(node)
         self._loop_depth = saved_loops
+        if in_pallas:
+            self._pallas_depth -= 1
         if jitted:
             self._jitted_depth -= 1
 
@@ -560,23 +593,39 @@ class _RuleVisitor(ast.NodeVisitor):
                 f'np.{tail}() of a jnp/lax expression pulls it to '
                 'host — keep the computation in jnp or waive the '
                 'documented blocking point')
-        # dtype-matmul-accum
+        # dtype-matmul-accum / dtype-pallas-matmul-accum
         if (tail in _MATMUL_FUNCS
                 and aliases.is_device_chain(chain)
                 and not any(kw.arg == 'preferred_element_type'
                             for kw in node.keywords)):
-            flavored = any(
-                isinstance(sub, (ast.Name, ast.Attribute))
-                and _BF16_NAME.search(
-                    sub.id if isinstance(sub, ast.Name) else sub.attr)
-                for a in node.args for sub in ast.walk(a))
-            if flavored:
+            if self._pallas_depth > 0:
+                # Inside a Pallas kernel body the requirement is
+                # unconditional — Mosaic accumulates an unpinned MXU
+                # matmul at the operand dtype, so even an fp32-looking
+                # Ref load can be a bf16 block under a compute_dtype
+                # knob. The generic bf16-flavor rule is subsumed.
                 self._emit(
-                    node, 'dtype-matmul-accum',
-                    f'{tail} with bf16-flavored operands must pin '
+                    node, 'dtype-pallas-matmul-accum',
+                    f'{tail} inside a Pallas kernel body must pin '
                     'fp32 accumulation: pass preferred_element_type='
-                    'jnp.float32 (the r6 bf16-pipeline contract — '
-                    'bf16 operands, fp32 accumulate)')
+                    'jnp.float32 (Mosaic lowers the MXU accumulate '
+                    'at the operand dtype with no backend-default '
+                    'safety net)')
+            else:
+                flavored = any(
+                    isinstance(sub, (ast.Name, ast.Attribute))
+                    and _BF16_NAME.search(
+                        sub.id if isinstance(sub, ast.Name)
+                        else sub.attr)
+                    for a in node.args for sub in ast.walk(a))
+                if flavored:
+                    self._emit(
+                        node, 'dtype-matmul-accum',
+                        f'{tail} with bf16-flavored operands must '
+                        'pin fp32 accumulation: pass '
+                        'preferred_element_type=jnp.float32 (the r6 '
+                        'bf16-pipeline contract — bf16 operands, '
+                        'fp32 accumulate)')
 
 
 def _jit_wrapped_names(tree: ast.AST) -> frozenset:
@@ -590,6 +639,32 @@ def _jit_wrapped_names(tree: ast.AST) -> frozenset:
                 inner = node.args[0]
                 if isinstance(inner, ast.Name):
                     names.add(inner.id)
+    return frozenset(names)
+
+
+def _pallas_kernel_names(tree: ast.AST) -> frozenset:
+    """Names of functions handed to ``pallas_call`` in this module —
+    their defs count as Pallas kernel bodies for
+    dtype-pallas-matmul-accum. Covers the bare form
+    (``pl.pallas_call(kernel, ...)``) and the partial-bound form
+    (``pl.pallas_call(functools.partial(kernel, decay=d), ...)``)
+    the in-tree kernels use to close over scalars."""
+    names = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _chain(node.func)
+        if not (chain and chain[-1] == 'pallas_call' and node.args):
+            continue
+        inner = node.args[0]
+        if isinstance(inner, ast.Name):
+            names.add(inner.id)
+        elif isinstance(inner, ast.Call) and inner.args:
+            head = _chain(inner.func)
+            if head and head[-1] == 'partial':
+                bound = inner.args[0]
+                if isinstance(bound, ast.Name):
+                    names.add(bound.id)
     return frozenset(names)
 
 
@@ -615,8 +690,10 @@ def lint_file(path: str, source: str, *, hot: bool | None = None,
                         f'file does not parse: {e.msg}')], []
     waivers, findings = parse_waivers(source, path)
     aliases = _Aliases(tree)
-    visitor = _RuleVisitor(path, aliases, hot=hot,
-                           jit_wrapped_names=_jit_wrapped_names(tree))
+    visitor = _RuleVisitor(
+        path, aliases, hot=hot,
+        jit_wrapped_names=_jit_wrapped_names(tree),
+        pallas_kernel_names=_pallas_kernel_names(tree))
     visitor.visit(tree)
     for f in visitor.findings:
         for w in waivers:

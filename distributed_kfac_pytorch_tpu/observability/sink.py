@@ -128,6 +128,12 @@ EVENT_KINDS = (
     'fleet_complete',       # a job ran to completion; data carries
                             # its SLO row (queue wait, run time,
                             # restarts, preemptions, gate verdict)
+    # r21 fused hot-path kernels (ops.pallas_kernels; README "Fused
+    # hot-path kernels"):
+    'pallas_fallback',      # a fused kernel's probe failed or its
+                            # dispatch degraded — the step runs the
+                            # stock XLA path; data names the kernel
+                            # and the reason (never a silent fallback)
 )
 # Dead incarnations kept per metrics path (<path>.prev.1 newest ..
 # .prev.N oldest); older ones are pruned on relaunch.

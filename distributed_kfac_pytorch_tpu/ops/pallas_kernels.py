@@ -22,6 +22,8 @@ Both paths are bit-compatible in structure (same iteration, fp32).
 from __future__ import annotations
 
 import functools
+import os
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +39,48 @@ _LANE = 128
 
 def _round_up(n: int, m: int) -> int:
     return (n + m - 1) // m * m
+
+
+# ---------------------------------------------------------------------------
+# Fallback events (r21)
+# ---------------------------------------------------------------------------
+#
+# Every probe failure and in-dispatch degradation is RECORDED, not
+# swallowed: a fleet run must be able to tell "ran fused" from
+# "silently fell back to XLA". Events accumulate here and are drained
+# into the step function's ``compile_events`` list (the same channel
+# the compile/retrace events ride — build_train_step drains after each
+# dispatch, engine.train_epoch forwards to the metrics sink).
+
+_PENDING_EVENTS: list = []
+
+#: block_batch floor for the fused patch-cov kernel: below this the
+#: per-grid-step matmul is too thin to amortize the patch assembly
+#: (block_batch=1 on a prime batch size was measured as the silent
+#: worst case) — the dispatcher falls back to XLA instead.
+MIN_FUSED_BLOCK_BATCH = 8
+
+
+def record_fallback(kernel: str, reason: str) -> None:
+    """Record (and warn about) one kernel's fallback to the XLA path."""
+    warnings.warn(
+        f'pallas kernel {kernel!r} falling back to XLA: {reason}',
+        RuntimeWarning, stacklevel=2)
+    _PENDING_EVENTS.append({'event': 'pallas_fallback', 'kernel': kernel,
+                            'reason': reason})
+
+
+def drain_pallas_events() -> list:
+    """Pop all pending fallback events (oldest first)."""
+    out = list(_PENDING_EVENTS)
+    _PENDING_EVENTS.clear()
+    return out
+
+
+def _forced_fallback() -> bool:
+    """KFAC_PALLAS_FALLBACK=1 forces every probe to fail (recorded):
+    the smoke test's forced-fallback leg and a field kill switch."""
+    return os.environ.get('KFAC_PALLAS_FALLBACK', '') not in ('', '0')
 
 
 def _ns_inverse_kernel(m_ref, out_ref, *, iters: int, n_pad: int,
@@ -378,6 +422,9 @@ def fused_patch_cov_supported() -> bool:
     itself is opt-in (KFAC_FUSED_PATCH_COV=1 at the dispatch site,
     factors.conv2d_a_factor) — not opting in is the only disable switch.
     """
+    if _forced_fallback():
+        record_fallback('patch_cov', 'forced by KFAC_PALLAS_FALLBACK')
+        return False
     if jax.default_backend() != 'tpu':
         return False
     try:
@@ -404,9 +451,35 @@ def fused_patch_cov_supported() -> bool:
             x, (3, 3), (1, 1), 'SAME', True, mult_bf16=True))
         rel = (np.abs(got - ref).max()
                / max(float(np.abs(ref).max()), 1e-30))
-        return bool(np.isfinite(got).all()) and rel < 5e-2
-    except Exception:
+        ok = bool(np.isfinite(got).all()) and rel < 5e-2
+        if not ok:
+            record_fallback('patch_cov',
+                            f'parity probe rel error {rel:.3g} >= 5e-2')
+        return ok
+    except Exception as e:
+        record_fallback('patch_cov',
+                        f'probe failed: {type(e).__name__}: {e}')
         return False
+
+
+def _fused_block_batch(b: int, bytes_per_img: int, budget: int) -> int:
+    """Largest divisor of ``b`` whose image block fits ``budget`` bytes.
+
+    Returns 0 when every fitting divisor sits below
+    ``MIN_FUSED_BLOCK_BATCH`` (prime batch sizes degrade all the way to
+    block_batch=1 — one image per grid step, a matmul far too thin to
+    amortize the patch assembly): the caller warns and falls back to
+    the XLA path rather than silently running the degenerate kernel.
+    Batches smaller than the floor are exempt (the whole batch is one
+    block; nothing was degraded).
+    """
+    block = max(1, budget // max(1, bytes_per_img))
+    block = min(block, b)
+    while b % block:
+        block -= 1
+    if block < min(b, MIN_FUSED_BLOCK_BATCH):
+        return 0
+    return block
 
 
 def conv_a_factor_fused(a: jax.Array, kernel_size, strides, padding,
@@ -449,9 +522,15 @@ def conv_a_factor_fused(a: jax.Array, kernel_size, strides, padding,
         # limit at (512,32,32,16)); target 4 MB so real usage stays
         # within limits in any surrounding program.
         budget = int(4e6) - fixed
-        block_batch = max(1, budget // max(1, bytes_per_img))
-        while b % block_batch:
-            block_batch -= 1
+        block_batch = _fused_block_batch(b, bytes_per_img, budget)
+        if not block_batch:
+            record_fallback(
+                'patch_cov',
+                f'batch {b} has no divisor >= {MIN_FUSED_BLOCK_BATCH} '
+                f'within the VMEM budget for shape {a.shape} — the '
+                'degraded block would destroy kernel efficiency')
+            raise ValueError(
+                f'no usable block_batch for batch {b} at this shape')
     spatial = oh * ow
     rows = b * spatial
     cov, colsum = _pallas_patch_cov(
@@ -492,3 +571,407 @@ def _canonical_pad(padding, kernel_size, spatial, strides):
         return ((padding, padding), (padding, padding))
     (a, b), (c, d) = padding
     return ((a, b), (c, d))
+
+
+# ---------------------------------------------------------------------------
+# Fused symmetric factor contraction + EMA kernel (r21)
+# ---------------------------------------------------------------------------
+#
+# The per-step factor cost every user pays is the rank-k contraction
+# A^T A plus the EMA blend against the running factor — stock XLA
+# writes the full (d, d) covariance to HBM, reads it back for the
+# blend, and writes the full (d, d) result. This kernel keeps the
+# accumulator in VMEM across the row blocks, folds the bias
+# row/column and the EMA blend into the finalize step, and writes only
+# the symmetry-packed (d/2+1, d) triangle to HBM (the block-symmetry
+# layout factors.pack_symmetric already uses on the wire): roughly
+# half the output traffic and no intermediate covariance round trip.
+# With decay=0 / old=None it degenerates to a packed contraction-only
+# kernel (the SPMD local-contribution path, where a collective sits
+# between contraction and EMA).
+
+def _factor_ema_kernel(x_ref, old_ref, decay_ref, out_ref, acc_ref,
+                       s_ref, *, nsteps: int, scale: float, rows: int,
+                       d_in: int, has_bias: bool, corner: float,
+                       d_pad: int, mult_dtype):
+    """One row block per grid step; finalize on the last step.
+
+    ``x_ref``: (block_rows, d_pad) zero-padded input rows. ``old_ref``:
+    (d_pad, d_pad) zero-padded running factor. ``decay_ref``: (1, 1)
+    SMEM EMA coefficient (alpha; the blend is
+    ``alpha * old + (1 - alpha) * cov``, factors.update_running_avg).
+    ``out_ref``: the (d_pad//2+1, d_pad) packed triangle.
+    ``acc_ref``/``s_ref``: VMEM scratch — the fp32 covariance
+    accumulator and the (8, d_pad) bias column-sum (row 0 meaningful).
+    """
+    from jax.experimental import pallas as pl
+
+    from distributed_kfac_pytorch_tpu.ops import factors as F
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        s_ref[...] = jnp.zeros_like(s_ref)
+
+    xb = x_ref[...].astype(mult_dtype)
+    # bf16 multiplicands ride the MXU fast path (the default covariance
+    # precision contract); fp32 multiplicands request HIGHEST for the
+    # strict-fp32 contract (ops.factors.get_cov).
+    prec = (None if mult_dtype == jnp.bfloat16
+            else jax.lax.Precision.HIGHEST)
+    acc_ref[...] += jnp.dot(xb.T, xb, preferred_element_type=jnp.float32,
+                            precision=prec)
+    if has_bias:
+        ones = jnp.ones((8, xb.shape[0]), mult_dtype)
+        s_ref[...] += jnp.dot(ones, xb,
+                              preferred_element_type=jnp.float32,
+                              precision=prec)
+
+    @pl.when(i == nsteps - 1)
+    def _finalize():
+        acc = acc_ref[...]
+        cov = (acc + acc.T) * (0.5 / scale)
+        if has_bias:
+            # The analytic bias assembly of F._assemble_bias_factor in
+            # padded space: the bias row/column live at index d_in
+            # (zero in the accumulator — the padded features are zero),
+            # written as the two rank-1 outer products via 2-D masks.
+            ri = jax.lax.broadcasted_iota(jnp.int32, (d_pad, d_pad), 0)
+            ci = jax.lax.broadcasted_iota(jnp.int32, (d_pad, d_pad), 1)
+            oh_r = (ri == d_in).astype(jnp.float32)
+            oh_c = (ci == d_in).astype(jnp.float32)
+            bias_row = s_ref[...][0:1, :] * (1.0 / rows)
+            b_cols = (jnp.broadcast_to(bias_row, (d_pad, d_pad))
+                      + (corner / 2.0) * oh_c)
+            cov = cov + oh_r * b_cols + oh_c * b_cols.T
+        dec = decay_ref[0, 0]
+        ema = dec * old_ref[...] + (1.0 - dec) * cov
+        # Only the packed triangle leaves VMEM. pack_symmetric is
+        # gather-free (triu/tril/slice/concat) so it traces inside the
+        # kernel; d_pad is lane-padded (even), so no internal repad.
+        out_ref[...] = F.pack_symmetric(ema)
+
+
+@functools.partial(
+    jax.jit, static_argnames=('scale', 'rows', 'd_in', 'has_bias',
+                              'corner', 'block_rows', 'mult_bf16',
+                              'interpret'))
+def _pallas_factor_ema(x: jax.Array, old: jax.Array, decay: jax.Array,
+                       *, scale: float, rows: int, d_in: int,
+                       has_bias: bool, corner: float, block_rows: int,
+                       mult_bf16: bool, interpret: bool = False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows_pad, d_pad = x.shape
+    nsteps = rows_pad // block_rows
+    k1 = d_pad // 2 + 1
+    mult_dtype = jnp.bfloat16 if mult_bf16 else jnp.float32
+    kernel = functools.partial(
+        _factor_ema_kernel, nsteps=nsteps, scale=scale, rows=rows,
+        d_in=d_in, has_bias=has_bias, corner=corner, d_pad=d_pad,
+        mult_dtype=mult_dtype)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((k1, d_pad), jnp.float32),
+        grid=(nsteps,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d_pad), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((d_pad, d_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((k1, d_pad), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((d_pad, d_pad), jnp.float32),
+                        pltpu.VMEM((8, d_pad), jnp.float32)],
+        interpret=interpret,
+    )(x, old, decay)
+
+
+def fused_factor_ema(x: jax.Array, old: jax.Array | None, decay, *,
+                     scale: float | None = None, has_bias: bool = False,
+                     corner: float = 1.0, compute_dtype=None,
+                     interpret: bool = False) -> jax.Array:
+    """Covariance factor + EMA blend in one packed-output VMEM kernel.
+
+    Drop-in for ``update_running_avg(linear_a_factor(x, has_bias), old,
+    decay)`` (and the G-side / conv-G analogues via ``scale``): ``x``
+    is the (rows, d_in) collapsed activation/grad tensor, ``old`` the
+    dense (d, d) running factor (``d = d_in + 1`` with bias), ``decay``
+    the EMA alpha (traced OK — it is a kernel input, not a variant
+    key). ``old=None`` means contraction-only (decay pinned to 0): the
+    SPMD local-contribution form, and the r14 accumulator fold reuses
+    the blend with ``old=accum``. Returns the dense (d, d) fp32 factor;
+    only the packed triangle crossed HBM out of the kernel.
+
+    ``compute_dtype`` follows the ops.factors.get_cov contract: None ->
+    backend-native multiplicands (bf16 on TPU), float32 -> strict fp32
+    at HIGHEST, bfloat16 -> explicit bf16 multiplicands. Accumulation
+    is always fp32.
+    """
+    from distributed_kfac_pytorch_tpu.ops import factors as F
+
+    x = x.reshape(-1, x.shape[-1])
+    rows, d_in = x.shape
+    n = d_in + 1 if has_bias else d_in
+    if scale is None:
+        scale = rows
+    d_pad = _round_up(max(n, 8), _LANE)
+    block_rows = 512 if rows >= 512 else _round_up(rows, 8)
+    rows_pad = _round_up(rows, block_rows)
+    mult_bf16 = (
+        (compute_dtype is not None
+         and jnp.dtype(compute_dtype) == jnp.bfloat16)
+        or (compute_dtype is None and jax.default_backend() == 'tpu'))
+    xp = jnp.pad(x.astype(jnp.float32),
+                 ((0, rows_pad - rows), (0, d_pad - d_in)))
+    if old is None:
+        oldp = jnp.zeros((d_pad, d_pad), jnp.float32)
+        decay = 0.0
+    else:
+        oldp = jnp.pad(old.astype(jnp.float32),
+                       ((0, d_pad - n), (0, d_pad - n)))
+    dec = jnp.asarray(decay, jnp.float32).reshape(1, 1)
+    packed = _pallas_factor_ema(
+        xp, oldp, dec, scale=float(scale), rows=rows, d_in=d_in,
+        has_bias=has_bias, corner=float(corner), block_rows=block_rows,
+        mult_bf16=mult_bf16, interpret=interpret)
+    return F.unpack_symmetric(packed, d_pad)[:n, :n]
+
+
+@functools.lru_cache(maxsize=1)
+def fused_factor_ema_supported() -> bool:
+    """Once-per-process gate for the fused contraction+EMA kernel.
+
+    Same contract as :func:`fused_patch_cov_supported`: Mosaic failures
+    surface at compile/run time, so the dispatchers (KFAC.update_factors
+    / accumulate_factors, parallel.distributed.local_factor_contribs)
+    call this once and fall back to the stock XLA factor path for good
+    if it fails — recorded via :func:`record_fallback`, never silent.
+    On non-TPU backends the kernel runs in interpret mode (the parity
+    tests and the CI smoke exercise the real kernel body on CPU), so
+    the probe passes trivially there; KFAC_PALLAS_FALLBACK=1 forces a
+    recorded failure everywhere.
+    """
+    if _forced_fallback():
+        record_fallback('factor_ema', 'forced by KFAC_PALLAS_FALLBACK')
+        return False
+    if jax.default_backend() != 'tpu':
+        return True
+    try:
+        import numpy as np
+
+        from distributed_kfac_pytorch_tpu.ops import factors as F
+        x = jnp.asarray(np.linspace(-1.0, 1.0, 16 * 12, dtype='float32')
+                        .reshape(16, 12))
+        old = jnp.eye(13, dtype=jnp.float32) * 0.5
+        ref = F.update_running_avg(
+            F.linear_a_factor(x, True), old, 0.9)
+        got = fused_factor_ema(x, old, 0.9, has_bias=True)
+        got_h, ref_h = np.asarray(got), np.asarray(ref)
+        rel = (np.abs(got_h - ref_h).max()
+               / max(float(np.abs(ref_h).max()), 1e-30))
+        ok = bool(np.isfinite(got_h).all()) and rel < 5e-2
+        if not ok:
+            record_fallback('factor_ema',
+                            f'parity probe rel error {rel:.3g} >= 5e-2')
+        return ok
+    except Exception as e:
+        record_fallback('factor_ema',
+                        f'probe failed: {type(e).__name__}: {e}')
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Fused bucketed precondition kernel with KL-clip epilogue (r21)
+# ---------------------------------------------------------------------------
+#
+# The bucketed precondition path stacks same-shape layer grads and
+# vmaps the two-sided inverse application; the r6 KL-clip then pays a
+# separate full-tensor pass re-reading every preconditioned matrix to
+# reduce sum(v * g). This kernel keeps one bucket slice resident in
+# VMEM for the whole chain — eigen (QG^T g QA rescale) or baked
+# (G_inv g A_inv) — and reduces the slice's v·g partial in the
+# epilogue while v is still on-chip, so the clip pass costs zero extra
+# HBM reads. Truncated r19 eigen bases are not eligible (static
+# ``_truncated_side`` check at the dispatch sites).
+
+def _bucket_precond_kernel(g_ref, right_ref, left_ref, da_ref, dg_ref,
+                           damp_ref, v_ref, vg_ref, *, eigen: bool,
+                           mult_dtype):
+    """One bucket slice per grid cell.
+
+    ``right_ref``/``left_ref``: QA/QG (eigen) or A_inv/G_inv (baked).
+    ``da_ref``: (1, 8, a_pad) eigenvalue row (row 0 meaningful, padded
+    with ones); ``dg_ref``: (1, g_pad, 128) eigenvalue column (lane 0
+    meaningful, padded with ones) — both ignored on the baked branch.
+    ``damp_ref``: (1, 1) SMEM damping. ``v_ref``: the preconditioned
+    slice; ``vg_ref``: (1, 8, 128) sublane/lane-replicated
+    ``sum(v * g)`` KL-clip partial (caller reads [0, 0]).
+    """
+    prec = (None if mult_dtype == jnp.bfloat16
+            else jax.lax.Precision.HIGHEST)
+    dot = functools.partial(jnp.dot,
+                            preferred_element_type=jnp.float32,
+                            precision=prec)
+    g32 = g_ref[0].astype(jnp.float32)
+    g = g32.astype(mult_dtype)
+    if eigen:
+        qa = right_ref[0].astype(mult_dtype)
+        qg = left_ref[0].astype(mult_dtype)
+        v1 = dot(dot(qg.T, g), qa)
+        da = da_ref[0][0:1, :]                    # (1, a_pad)
+        dg = dg_ref[0][:, 0:1]                    # (g_pad, 1)
+        v2 = v1 / (dg * da + damp_ref[0, 0])
+        v = dot(dot(qg, v2.astype(mult_dtype)), qa.T)
+    else:
+        a_inv = right_ref[0].astype(mult_dtype)
+        g_inv = left_ref[0].astype(mult_dtype)
+        v = dot(dot(g_inv, g), a_inv)
+    v_ref[0] = v
+    # Zero feature padding keeps the padded entries of v exactly zero
+    # (zero rows/cols of Q and the inverses), so the full-block
+    # reduction equals the unpadded v.g partial.
+    vg_ref[0] = jnp.broadcast_to(jnp.sum(v * g32), (8, 128))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=('eigen', 'mult_bf16', 'interpret'))
+def _pallas_bucket_precond(gstack, left, right, dg, da, damping, *,
+                           eigen: bool, mult_bf16: bool,
+                           interpret: bool = False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    s, gp, ap = gstack.shape
+    mult_dtype = jnp.bfloat16 if mult_bf16 else jnp.float32
+    kernel = functools.partial(_bucket_precond_kernel, eigen=eigen,
+                               mult_dtype=mult_dtype)
+    v, vg = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((s, gp, ap), jnp.float32),
+                   jax.ShapeDtypeStruct((s, 8, 128), jnp.float32)),
+        grid=(s,),
+        in_specs=[
+            pl.BlockSpec((1, gp, ap), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, ap, ap), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, gp, gp), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, ap), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, gp, 128), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=(pl.BlockSpec((1, gp, ap), lambda i: (i, 0, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, 8, 128), lambda i: (i, 0, 0),
+                                memory_space=pltpu.VMEM)),
+        interpret=interpret,
+    )(gstack, right, left, da, dg, damping)
+    return v, vg[:, 0, 0]
+
+
+def fused_bucket_precondition(gstack: jax.Array, entry: dict, damping,
+                              *, compute_dtype=None,
+                              interpret: bool = False):
+    """Bucketed precondition with the KL-clip v·g partial fused in.
+
+    ``gstack`` is the (S, g_dim, a_dim) same-shape gradient stack;
+    ``entry`` the stacked inverse slots — baked ``{'A_inv', 'G_inv'}``
+    or full-rank eigen ``{'QA', 'dA', 'QG', 'dG'}`` (truncated r19
+    bases are NOT eligible; dispatch them to the stock XLA path).
+    Returns ``(vstack, vg)``: the (S, g_dim, a_dim) fp32 preconditioned
+    stack and the (S,) fp32 per-slice ``sum(v * grad)`` partials — the
+    KL-clip term before the caller's lr^2 factor.
+    """
+    s, g_dim, a_dim = gstack.shape
+    gp = _round_up(max(g_dim, 8), _LANE)
+    ap = _round_up(max(a_dim, 8), _LANE)
+    eigen = 'QA' in entry
+    gpad = jnp.pad(gstack.astype(jnp.float32),
+                   ((0, 0), (0, gp - g_dim), (0, ap - a_dim)))
+    if eigen:
+        right = jnp.pad(entry['QA'].astype(jnp.float32),
+                        ((0, 0), (0, ap - a_dim), (0, ap - a_dim)))
+        left = jnp.pad(entry['QG'].astype(jnp.float32),
+                       ((0, 0), (0, gp - g_dim), (0, gp - g_dim)))
+        # Eigenvalue padding is ONES so the padded denominators are
+        # 1 + damping (never 0/0); the padded v1 entries are zero, so
+        # the padded v2/v stay exactly zero.
+        da = jnp.pad(entry['dA'].astype(jnp.float32),
+                     ((0, 0), (0, ap - a_dim)), constant_values=1.0)
+        dg = jnp.pad(entry['dG'].astype(jnp.float32),
+                     ((0, 0), (0, gp - g_dim)), constant_values=1.0)
+        da = jnp.broadcast_to(da[:, None, :], (s, 8, ap))
+        dg = jnp.broadcast_to(dg[:, :, None], (s, gp, 128))
+    else:
+        right = jnp.pad(entry['A_inv'].astype(jnp.float32),
+                        ((0, 0), (0, ap - a_dim), (0, ap - a_dim)))
+        left = jnp.pad(entry['G_inv'].astype(jnp.float32),
+                       ((0, 0), (0, gp - g_dim), (0, gp - g_dim)))
+        da = jnp.zeros((s, 8, ap), jnp.float32)
+        dg = jnp.zeros((s, gp, 128), jnp.float32)
+    damp = jnp.asarray(damping, jnp.float32).reshape(1, 1)
+    mult_bf16 = (compute_dtype is not None
+                 and jnp.dtype(compute_dtype) == jnp.bfloat16)
+    v, vg = _pallas_bucket_precond(gpad, left, right, dg, da, damp,
+                                   eigen=eigen, mult_bf16=mult_bf16,
+                                   interpret=interpret)
+    return v[:, :g_dim, :a_dim], vg
+
+
+@functools.lru_cache(maxsize=1)
+def fused_precondition_supported() -> bool:
+    """Once-per-process gate for the fused bucket-precondition kernel
+    (same contract as :func:`fused_factor_ema_supported`)."""
+    if _forced_fallback():
+        record_fallback('bucket_precond',
+                        'forced by KFAC_PALLAS_FALLBACK')
+        return False
+    if jax.default_backend() != 'tpu':
+        return True
+    try:
+        import numpy as np
+
+        from distributed_kfac_pytorch_tpu.ops import linalg
+        rng = np.random.default_rng(0)
+        g = jnp.asarray(rng.normal(size=(2, 8, 12)).astype('float32'))
+        qa = jnp.asarray(np.linalg.qr(
+            rng.normal(size=(2, 12, 12)))[0].astype('float32'))
+        qg = jnp.asarray(np.linalg.qr(
+            rng.normal(size=(2, 8, 8)))[0].astype('float32'))
+        da = jnp.asarray(
+            rng.uniform(0.5, 2.0, (2, 12)).astype('float32'))
+        dg = jnp.asarray(
+            rng.uniform(0.5, 2.0, (2, 8)).astype('float32'))
+        entry = {'QA': qa, 'dA': da, 'QG': qg, 'dG': dg}
+        ref = jax.vmap(lambda gm, e: linalg.precondition_dispatch(
+            gm, e, 0.003))(g, entry)
+        got, vg = fused_bucket_precondition(g, entry, 0.003)
+        vg_ref = jnp.sum(ref * g, axis=(1, 2))
+        got_h, ref_h = np.asarray(got), np.asarray(ref)
+        vg_h, vg_ref_h = np.asarray(vg), np.asarray(vg_ref)
+        rel = (np.abs(got_h - ref_h).max()
+               / max(float(np.abs(ref_h).max()), 1e-30))
+        rel_vg = (np.abs(vg_h - vg_ref_h).max()
+                  / max(float(np.abs(vg_ref_h).max()), 1e-30))
+        ok = (bool(np.isfinite(got_h).all()) and rel < 5e-2
+              and rel_vg < 5e-2)
+        if not ok:
+            record_fallback(
+                'bucket_precond',
+                f'parity probe rel error v={rel:.3g} vg={rel_vg:.3g}')
+        return ok
+    except Exception as e:
+        record_fallback('bucket_precond',
+                        f'probe failed: {type(e).__name__}: {e}')
+        return False

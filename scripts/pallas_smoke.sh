@@ -1,0 +1,104 @@
+#!/usr/bin/env bash
+# Fast-tier fused hot-path kernel smoke (r21): both Pallas knobs end
+# to end on CPU (interpret mode) through the REAL LM entry point —
+#   1. one tiny synthetic-corpus epoch with --fused-factor-contraction
+#      AND --fused-precondition engaged, under the full runtime
+#      sanitizer (KFAC_SANITIZE=transfer,nan,retrace), metrics sink
+#      on; assert finite losses, inverse firings, ZERO retrace events
+#      and ZERO pallas_fallback events with both kernels live;
+#   2. observability-gate self-check over the stream (the CI plumbing
+#      path, like lowrank_smoke.sh's leg 2);
+#   3. forced-fallback leg: KFAC_PALLAS_FALLBACK=1 must still train
+#      (stock XLA path) AND surface the named pallas_fallback events
+#      in the stream — a failed probe is recorded, never silent.
+# The same contracts are pinned in tests/test_fused_kernels.py; this
+# wrapper is the standalone/CI-pipeline form (see lowrank_smoke.sh).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="$(mktemp -d)"
+trap 'rm -rf "$out"' EXIT
+
+run_lm() {  # $1 = leg name, extra args follow
+    local leg="$1"; shift
+    JAX_PLATFORMS=cpu KFAC_COMPILE_CACHE=0 KFAC_SYNTHETIC_LM=2048 \
+    python examples/train_language_model.py \
+        --arch transformer --emsize 64 --nlayers 1 --nheads 2 \
+        --bptt 16 --batch-size 4 --epochs 1 --no-resume \
+        --kfac-update-freq 4 \
+        --log-dir "$out/logs-$leg" --checkpoint-dir "$out/ckpt-$leg" \
+        "$@"
+}
+
+# Leg 1: both kernels engaged (interpret mode on CPU) under the full
+# sanitizer, metrics at interval 1.
+KFAC_SANITIZE=transfer,nan,retrace \
+run_lm fused \
+    --fused-factor-contraction --fused-precondition \
+    --kfac-metrics "$out/fused.jsonl" --metrics-interval 1
+
+python - "$out/fused.jsonl" <<'EOF'
+import math
+import sys
+
+from distributed_kfac_pytorch_tpu.observability import sink as obs_sink
+
+path = sys.argv[1]
+records, _ = obs_sink.read_jsonl_tolerant(path)
+steps = [r for r in records if r.get('kind') == 'step']
+assert steps, 'no step records in the metrics stream'
+fired = [r.get('fired') for r in steps]
+assert 'inverse' in fired, fired
+assert all(math.isfinite(float(r['loss'])) for r in steps
+           if 'loss' in r), 'non-finite loss with fused kernels'
+retraces = [r for r in records if r.get('event') == 'retrace']
+assert not retraces, retraces           # zero retraces, kernels live
+fallbacks = [r for r in records
+             if r.get('event') == 'pallas_fallback']
+assert not fallbacks, fallbacks         # probes passed: no fallback
+print(f'fused kernels OK ({len(steps)} steps, zero retraces, '
+      'zero fallbacks)')
+EOF
+
+# Leg 2: gate self-check (stream is gate-clean against itself).
+python -m distributed_kfac_pytorch_tpu.observability.gate \
+    "$out/fused.jsonl" --write-baseline "$out/B.json"
+python -m distributed_kfac_pytorch_tpu.observability.gate \
+    "$out/fused.jsonl" --baseline "$out/B.json" --allow-missing \
+    --json > "$out/gate.json"
+python - "$out/gate.json" <<'EOF'
+import json, sys
+v = json.load(open(sys.argv[1]))
+assert v['pass'] is True, v
+print('gate self-check OK')
+EOF
+
+# Leg 3: forced fallback — the kill switch must keep training on the
+# stock XLA path and record NAMED pallas_fallback events in the
+# stream (never a silent degrade).
+KFAC_SANITIZE=transfer,nan,retrace KFAC_PALLAS_FALLBACK=1 \
+run_lm fallback \
+    --fused-factor-contraction --fused-precondition \
+    --kfac-metrics "$out/fallback.jsonl" --metrics-interval 1
+
+python - "$out/fallback.jsonl" <<'EOF'
+import math
+import sys
+
+from distributed_kfac_pytorch_tpu.observability import sink as obs_sink
+
+records, _ = obs_sink.read_jsonl_tolerant(sys.argv[1])
+steps = [r for r in records if r.get('kind') == 'step']
+assert steps, 'no step records in the forced-fallback stream'
+assert all(math.isfinite(float(r['loss'])) for r in steps
+           if 'loss' in r), 'non-finite loss on the fallback path'
+fallbacks = [r for r in records
+             if r.get('event') == 'pallas_fallback']
+kernels = sorted({r.get('data', {}).get('kernel')
+                  for r in fallbacks})
+assert 'factor_ema' in kernels and 'bucket_precond' in kernels, (
+    'forced fallback did not record both kernels', kernels)
+print(f'forced-fallback leg OK (events for {kernels})')
+EOF
+
+echo 'pallas_smoke: all legs OK'

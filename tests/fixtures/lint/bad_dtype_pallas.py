@@ -1,0 +1,40 @@
+"""Seeded Pallas-kernel dtype violations: matmuls inside kernel
+bodies without fp32 accumulation pinned. Inside a kernel the
+requirement is unconditional — no bf16-flavored name is needed for
+the rule to fire, because Mosaic accumulates at the operand dtype."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def contraction_kernel(x_ref, o_ref):
+    xb = x_ref[...]
+    o_ref[...] = jnp.dot(xb.T, xb)       # dtype-pallas-matmul-accum
+
+
+def ema_kernel(decay, x_ref, old_ref, o_ref):
+    xb = x_ref[...]
+    cov = jnp.matmul(xb.T, xb)           # dtype-pallas-matmul-accum
+    o_ref[...] = decay * old_ref[...] + (1.0 - decay) * cov
+
+
+def wrapped_kernel(a_ref, b_ref, o_ref):
+    # Never named at a pallas_call site in this module (handed over
+    # through a wrapper) — caught by the *_ref signature fallback.
+    o_ref[...] = jnp.einsum(
+        'ij,jk->ik', a_ref[...], b_ref[...]
+    )                                    # dtype-pallas-matmul-accum
+
+
+def launch(x, old, decay):
+    cov = pl.pallas_call(
+        contraction_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+    )(x)
+    ema = pl.pallas_call(
+        functools.partial(ema_kernel, decay),
+        out_shape=jax.ShapeDtypeStruct(old.shape, jnp.float32),
+    )(x, old)
+    return cov, ema

@@ -1,0 +1,44 @@
+"""The same Pallas kernel bodies with fp32 accumulation pinned via
+preferred_element_type, plus a plain fp32 helper outside any kernel
+that must NOT trip the unconditional in-kernel rule."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def contraction_kernel(x_ref, o_ref):
+    xb = x_ref[...]
+    o_ref[...] = jnp.dot(xb.T, xb,
+                         preferred_element_type=jnp.float32)
+
+
+def ema_kernel(decay, x_ref, old_ref, o_ref):
+    xb = x_ref[...]
+    cov = jnp.matmul(xb.T, xb,
+                     preferred_element_type=jnp.float32)
+    o_ref[...] = decay * old_ref[...] + (1.0 - decay) * cov
+
+
+def wrapped_kernel(a_ref, b_ref, o_ref):
+    o_ref[...] = jnp.einsum('ij,jk->ik', a_ref[...], b_ref[...],
+                            preferred_element_type=jnp.float32)
+
+
+def host_side_helper(a):
+    # fp32 operands outside a kernel body: the generic bf16-flavor
+    # rule does not apply and the Pallas rule is out of scope.
+    return jnp.matmul(a.T, a)
+
+
+def launch(x, old, decay):
+    cov = pl.pallas_call(
+        contraction_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+    )(x)
+    ema = pl.pallas_call(
+        functools.partial(ema_kernel, decay),
+        out_shape=jax.ShapeDtypeStruct(old.shape, jnp.float32),
+    )(x, old)
+    return cov, ema

@@ -62,7 +62,9 @@ def test_space_enumeration_respects_constraints():
          'kfac_approx': ['expand'],
          'deferred_factor_reduction': [False],
          'inv_staleness': [0],
-         'inv_lowrank_rank': [0]})
+         'inv_lowrank_rank': [0],
+         'fused_factor_contraction': [False],
+         'fused_precondition': [False]})
     base = _base_knobs()  # inv freq 4: chunks 3 cannot divide
     cands = space.enumerate(base)
     assert all(c['inv_pipeline_chunks'] in (1, 2) for c in cands)
@@ -436,7 +438,9 @@ def test_driver_halving_commits_full_length_winner(tmp_path,
                          'kfac_approx': ['expand'],
                          'deferred_factor_reduction': [False],
                          'inv_staleness': [0],
-                         'inv_lowrank_rank': [0]},
+                         'inv_lowrank_rank': [0],
+                         'fused_factor_contraction': [False],
+                         'fused_precondition': [False]},
         mesh=_one_dev_mesh(), self_check=True, self_check_tol=0.5,
         log=lambda *a: None)
     # The halving survivor (bf16=False, which won its short rungs) was
@@ -451,7 +455,9 @@ def test_driver_halving_commits_full_length_winner(tmp_path,
              'kfac_cov_update_freq': 1, 'inv_pipeline_chunks': 1,
              'kfac_approx': 'expand',
              'deferred_factor_reduction': False, 'inv_staleness': 0,
-             'inv_lowrank_rank': 0},
+             'inv_lowrank_rank': 0,
+             'fused_factor_contraction': False,
+             'fused_precondition': False},
             8) in probed
     # Short-rung rows survive in the table as provenance, with their
     # n_steps making them self-describing.

@@ -1,0 +1,499 @@
+"""r21 fused hot-path Pallas kernels (interpret-mode on CPU).
+
+Contracts pinned here:
+
+- **kernel parity**: the symmetry-packed contraction kernel equals the
+  dense ``ops.factors.get_cov`` basis (bias assembly, conv-G scaling
+  included) to 1e-5; the fused EMA equals the eager
+  ``update_running_avg`` blend; the fused bucket-precondition kernel
+  equals the vmapped ``linalg.precondition_dispatch`` on eigen AND
+  baked entries, and its v·g epilogue equals the separate reduction;
+- **knobs off = bit-identical**: both r21 knobs False produce the
+  byte-identical per-step losses of a config without them, single chip
+  and 8-dev SPMD;
+- **fused tracks stock**: with the knobs ON the trajectory matches the
+  stock XLA path to matmul-reassociation tolerance, including the
+  KL-clip scale fed by the fused v·g partials (single chip, and the
+  KAISA row-sharded SPMD dispatch);
+- **zero retraces** with the kernels engaged (trace_counts guard),
+  incl. composed with r6 bf16, r9 chunks, r14 deferred reduction, r19
+  low-rank (whose rectangular Q stacks must bounce to stock dispatch,
+  not crash), and the r20 hierarchical 2-slice mesh;
+- **fail loudly**: the block_batch floor returns 0 on degenerate
+  divisors and the dispatcher records a ``pallas_fallback`` event
+  (never silently runs the degraded kernel); KFAC_PALLAS_FALLBACK=1
+  forces every probe to fail with a recorded, drainable event.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_kfac_pytorch_tpu import KFAC, launch
+from distributed_kfac_pytorch_tpu.models import transformer_lm
+from distributed_kfac_pytorch_tpu.multislice import mesh as ms_mesh
+from distributed_kfac_pytorch_tpu.ops import factors as F
+from distributed_kfac_pytorch_tpu.ops import linalg, pallas_kernels
+from distributed_kfac_pytorch_tpu.parallel import distributed as D
+from distributed_kfac_pytorch_tpu.preconditioner import CommMethod
+from distributed_kfac_pytorch_tpu.training import engine
+
+VOCAB = 50
+
+
+# ---------------------------------------------------------------------------
+# Kernel-level parity (interpret mode)
+# ---------------------------------------------------------------------------
+
+class TestFusedFactorEMA:
+    def test_contraction_matches_get_cov(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(32, 24)).astype('float32'))
+        ref = F.get_cov(x)
+        got = pallas_kernels.fused_factor_ema(x, None, 0.0,
+                                              interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_bias_column_assembly(self):
+        # Non-multiple-of-8 output dim (12+1) exercises the padding
+        # and the iota-based bias row/col assembly.
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(16, 12)).astype('float32'))
+        ref = F.linear_a_factor(x, True)
+        got = pallas_kernels.fused_factor_ema(x, None, 0.0,
+                                              has_bias=True,
+                                              interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_conv_g_scaling(self):
+        # The conv-G covariance divides by batch*spatial^2, not rows:
+        # the explicit scale override must reproduce conv2d_g_factor.
+        rng = np.random.default_rng(2)
+        g = jnp.asarray(rng.normal(size=(4, 5, 5, 8)).astype('float32'))
+        ref = F.conv2d_g_factor(g)
+        x2d = g.reshape(-1, g.shape[-1])
+        scale = float(x2d.shape[0]) * (5 * 5) ** 2
+        got = pallas_kernels.fused_factor_ema(x2d, None, 0.0,
+                                              scale=scale,
+                                              interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_fused_ema_matches_eager_blend(self):
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.normal(size=(16, 12)).astype('float32'))
+        old = jnp.asarray(
+            np.eye(13, dtype='float32') * 0.5)
+        ref = F.update_running_avg(F.linear_a_factor(x, True), old,
+                                   0.9)
+        got = pallas_kernels.fused_factor_ema(x, old, 0.9,
+                                              has_bias=True,
+                                              interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def _eigen_entry(s, a_dim, g_dim, seed=0):
+    rng = np.random.default_rng(seed)
+    qa = np.linalg.qr(rng.normal(size=(s, a_dim, a_dim)))[0]
+    qg = np.linalg.qr(rng.normal(size=(s, g_dim, g_dim)))[0]
+    return {'QA': jnp.asarray(qa.astype('float32')),
+            'dA': jnp.asarray(rng.uniform(
+                0.1, 2.0, (s, a_dim)).astype('float32')),
+            'QG': jnp.asarray(qg.astype('float32')),
+            'dG': jnp.asarray(rng.uniform(
+                0.1, 2.0, (s, g_dim)).astype('float32'))}
+
+
+class TestFusedBucketPrecondition:
+    @pytest.mark.parametrize('dims', [(12, 8), (13, 9)],
+                             ids=['aligned', 'ragged'])
+    def test_eigen_stack_parity(self, dims):
+        a_dim, g_dim = dims
+        rng = np.random.default_rng(4)
+        g = jnp.asarray(rng.normal(
+            size=(3, g_dim, a_dim)).astype('float32'))
+        entry = _eigen_entry(3, a_dim, g_dim)
+        ref = jax.vmap(lambda gm, e: linalg.precondition_dispatch(
+            gm, e, 0.003))(g, entry)
+        got, vg = pallas_kernels.fused_bucket_precondition(
+            g, entry, 0.003, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(vg),
+            np.asarray(jnp.sum(ref * g, axis=(1, 2))),
+            rtol=1e-5, atol=1e-6)
+
+    def test_baked_stack_parity(self):
+        rng = np.random.default_rng(5)
+        g = jnp.asarray(rng.normal(size=(2, 8, 12)).astype('float32'))
+
+        def spd(n, seed):
+            r = np.random.default_rng(seed)
+            m = r.normal(size=(2, n, n))
+            return jnp.asarray(
+                (m @ m.transpose(0, 2, 1)
+                 + 0.5 * np.eye(n)).astype('float32'))
+
+        entry = {'A_inv': spd(12, 6), 'G_inv': spd(8, 7)}
+        ref = jax.vmap(lambda gm, a, gi: gi @ gm @ a)(
+            g, entry['A_inv'], entry['G_inv'])
+        got, vg = pallas_kernels.fused_bucket_precondition(
+            g, entry, 0.003, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(vg),
+            np.asarray(jnp.sum(ref * g, axis=(1, 2))),
+            rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# block_batch floor + fallback events (satellites 1/2)
+# ---------------------------------------------------------------------------
+
+class TestBlockBatchFloor:
+    def test_prime_batch_degrades_to_zero(self):
+        # Budget fits 2 images; 17 is prime so the only divisors are
+        # 17 (too big) and 1 (degenerate) -> refuse, don't degrade.
+        assert pallas_kernels._fused_block_batch(
+            17, 10 ** 6, 2 * 10 ** 6) == 0
+
+    def test_small_batch_exempt_from_floor(self):
+        # b=4 < MIN_FUSED_BLOCK_BATCH: the whole batch is one block,
+        # nothing was degraded.
+        assert pallas_kernels._fused_block_batch(
+            4, 10, 10 ** 6) == 4
+
+    def test_divisor_within_budget(self):
+        assert pallas_kernels._fused_block_batch(512, 1, 32) == 32
+
+    def test_degenerate_dispatch_records_fallback(self):
+        # A prime batch at a shape whose VMEM budget forces a thin
+        # block: the dispatcher warns, records the event, and raises
+        # (the factors.py caller catches and runs XLA).
+        pallas_kernels.drain_pallas_events()
+        x = jnp.zeros((13, 32, 32, 16), jnp.float32)
+        with pytest.warns(RuntimeWarning, match='falling back'):
+            with pytest.raises(ValueError, match='block_batch'):
+                pallas_kernels.conv_a_factor_fused(
+                    x, (3, 3), (1, 1), 'SAME', True, interpret=True)
+        events = pallas_kernels.drain_pallas_events()
+        assert [e['kernel'] for e in events] == ['patch_cov']
+        assert 'no divisor' in events[0]['reason']
+
+
+class TestForcedFallbackProbes:
+    @pytest.fixture(autouse=True)
+    def _fresh_probe_caches(self):
+        for probe in (pallas_kernels.fused_factor_ema_supported,
+                      pallas_kernels.fused_precondition_supported,
+                      pallas_kernels.fused_patch_cov_supported):
+            probe.cache_clear()
+        pallas_kernels.drain_pallas_events()
+        yield
+        for probe in (pallas_kernels.fused_factor_ema_supported,
+                      pallas_kernels.fused_precondition_supported,
+                      pallas_kernels.fused_patch_cov_supported):
+            probe.cache_clear()
+        pallas_kernels.drain_pallas_events()
+
+    def test_forced_fallback_records_named_events(self, monkeypatch):
+        monkeypatch.setenv('KFAC_PALLAS_FALLBACK', '1')
+        with pytest.warns(RuntimeWarning, match='falling back'):
+            assert not pallas_kernels.fused_factor_ema_supported()
+            assert not pallas_kernels.fused_precondition_supported()
+        events = pallas_kernels.drain_pallas_events()
+        assert {e['kernel'] for e in events} == {'factor_ema',
+                                                'bucket_precond'}
+        assert all(e['event'] == 'pallas_fallback' for e in events)
+        assert all('KFAC_PALLAS_FALLBACK' in e['reason']
+                   for e in events)
+
+    def test_probes_pass_on_cpu_interpret(self, monkeypatch):
+        monkeypatch.delenv('KFAC_PALLAS_FALLBACK', raising=False)
+        assert pallas_kernels.fused_factor_ema_supported()
+        assert pallas_kernels.fused_precondition_supported()
+        assert pallas_kernels.drain_pallas_events() == []
+
+
+# ---------------------------------------------------------------------------
+# Single-chip integration (the test_lowrank harness idiom)
+# ---------------------------------------------------------------------------
+
+def _model(d_model=32):
+    return transformer_lm.TransformerLM(
+        vocab_size=VOCAB, d_model=d_model, num_layers=1, num_heads=2,
+        max_len=16, dropout=0.0, tie_weights=True)
+
+
+def _batch(b=2):
+    x = jax.random.randint(jax.random.PRNGKey(1), (b, 16), 0, VOCAB)
+    y = jax.random.randint(jax.random.PRNGKey(2), (b, 16), 0, VOCAB)
+    return x, y
+
+
+def _run_single(kw, steps=9, i_freq=4):
+    model = _model()
+    x, y = _batch()
+
+    def loss_of(out):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            out, y).mean()
+
+    kfac = KFAC(model, factor_update_freq=1, inv_update_freq=i_freq,
+                damping=0.003, lr=0.1, **kw)
+    variables, kstate = kfac.init(jax.random.PRNGKey(0), x,
+                                  train=False)
+    params = variables['params']
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt = tx.init(params)
+    losses = []
+    for i in range(steps):
+        l, _, grads, caps, _ = kfac.capture.loss_and_grads(
+            loss_of, params, x, train=False)
+        g, kstate = kfac.step(kstate, grads, caps, factor_update=True,
+                              inv_update=(i % i_freq == 0))
+        up, opt = tx.update(g, opt, params)
+        params = optax.apply_updates(params, up)
+        losses.append(float(l))
+    return losses, kfac, kstate, params
+
+
+FUSED = dict(fused_factor_contraction=True, fused_precondition=True)
+
+
+class TestKFACFused:
+    def test_knobs_off_bit_identical(self):
+        base, *_ = _run_single({})
+        off, *_ = _run_single(dict(fused_factor_contraction=False,
+                                   fused_precondition=False))
+        assert off == base
+
+    def test_fused_tracks_stock(self):
+        stock, *_ = _run_single({})
+        fused, *_ = _run_single(FUSED)
+        np.testing.assert_allclose(fused, stock, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_single_update_parity(self):
+        """One preconditioned update fused vs stock — the per-step
+        oracle (incl. the KL clip fed by the fused v·g), before
+        trajectory drift accumulates. Tolerance is looser than the raw
+        kernel parity because the ~1e-7 contraction reassociation
+        passes through an eigendecomposition before the update."""
+        model = _model()
+        x, y = _batch()
+
+        def loss_of(out):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                out, y).mean()
+
+        outs = {}
+        for tag, kw in (('stock', {}), ('fused', FUSED)):
+            kfac = KFAC(model, factor_update_freq=1,
+                        inv_update_freq=1, damping=0.003, lr=0.1,
+                        **kw)
+            variables, kstate = kfac.init(jax.random.PRNGKey(0), x,
+                                          train=False)
+            _, _, grads, caps, _ = kfac.capture.loss_and_grads(
+                loss_of, variables['params'], x, train=False)
+            g, _ = kfac.step(kstate, grads, caps, factor_update=True,
+                             inv_update=True)
+            outs[tag] = g
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-5),
+            outs['fused'], outs['stock'])
+
+    def test_fused_composes_with_bf16_pipeline(self):
+        losses, *_ = _run_single(
+            dict(precond_compute_dtype=jnp.bfloat16, **FUSED),
+            steps=6)
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_fused_eligibility_excludes_lowrank_buckets(self):
+        # r19 rectangular Q stacks must bounce to stock dispatch (the
+        # _fused_bucket_ok gate), not crash or mis-shape.
+        losses, *_ = _run_single(
+            dict(inv_lowrank_rank=8, inv_lowrank_dim_threshold=64,
+                 **FUSED), steps=6)
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# 8-dev SPMD (conftest forces 8 virtual CPU devices)
+# ---------------------------------------------------------------------------
+
+def _run_spmd(kw, steps=9, chunks=1, comm=CommMethod.HYBRID_OPT,
+              i_freq=4, deferred=False):
+    model = _model()
+    x = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, VOCAB)
+    y = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0, VOCAB)
+
+    def loss_fn(out, batch):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            out, batch[1]).mean()
+
+    kfac = KFAC(model, factor_update_freq=1, inv_update_freq=i_freq,
+                damping=0.003, lr=0.1, comm_method=comm,
+                grad_worker_fraction=0.25,
+                inv_pipeline_chunks=chunks,
+                deferred_factor_reduction=deferred, **kw)
+    variables, _ = kfac.init(jax.random.PRNGKey(0), x[:1],
+                             train=False)
+    params = variables['params']
+    mesh = D.make_kfac_mesh(comm_method=comm,
+                            grad_worker_fraction=0.25)
+    dkfac = D.DistributedKFAC(kfac, mesh, params)
+    kstate = dkfac.init_state(params)
+    tx = optax.sgd(0.1, momentum=0.9)
+    step = dkfac.build_train_step(
+        loss_fn, tx, model_args_fn=lambda b: (b[0],),
+        model_kwargs_fn=lambda b: {'train': False})
+    state = engine.TrainState(params, tx.init(params), kstate, {})
+    hyper = {'lr': 0.1, 'damping': 0.003}
+    losses = []
+    for i in range(steps):
+        flags = engine.cadence_flags(i, 1, i_freq, chunks,
+                                     deferred_reduce=deferred)
+        out = step(state.params, state.opt_state, state.kfac_state,
+                   state.extra_vars, (x, y), hyper, **flags)
+        (state.params, state.opt_state, state.kfac_state,
+         state.extra_vars, m) = out
+        losses.append(float(m['loss']))
+    return losses, step, dkfac, state
+
+
+class TestSPMDFused:
+    def test_fused_engaged_zero_retraces(self):
+        losses, step, *_ = _run_spmd(FUSED)
+        assert all(np.isfinite(losses))
+        retraced = {k: n for k, n in step.trace_counts.items()
+                    if n != 1}
+        assert not retraced, retraced
+
+    @pytest.mark.slow
+    def test_knob_off_bit_identical_spmd(self):
+        base, *_ = _run_spmd({})
+        off, *_ = _run_spmd(dict(fused_factor_contraction=False,
+                                 fused_precondition=False))
+        assert off == base
+
+    def test_kaisa_rowsharded_tracks_stock(self):
+        # HYBRID_OPT @ gwf=0.25 engages the row-sharded bucket
+        # dispatch: the fused kernel's masked v·g partials must feed
+        # the same global clip scale through the psum.
+        stock, *_ = _run_spmd({})
+        fused, *_ = _run_spmd(FUSED)
+        np.testing.assert_allclose(fused, stock, rtol=1e-4,
+                                   atol=1e-4)
+
+    @pytest.mark.slow
+    def test_composes_with_chunks_zero_retraces(self):
+        losses, step, *_ = _run_spmd(FUSED, chunks=2)
+        assert all(np.isfinite(losses))
+        retraced = {k: n for k, n in step.trace_counts.items()
+                    if n != 1}
+        assert not retraced, retraced
+
+    def test_composes_with_deferred_reduction(self):
+        # The r14 window fold is where the contraction+EMA fusion
+        # engages on SPMD: parity against the stock deferred run AND
+        # the zero-retrace pin, one knob composition.
+        stock, *_ = _run_spmd({}, deferred=True)
+        fused, step, *_ = _run_spmd(FUSED, deferred=True)
+        np.testing.assert_allclose(fused, stock, rtol=1e-4,
+                                   atol=1e-4)
+        retraced = {k: n for k, n in step.trace_counts.items()
+                    if n != 1}
+        assert not retraced, retraced
+
+    @pytest.mark.slow
+    def test_composes_with_lowrank_zero_retraces(self):
+        losses, step, *_ = _run_spmd(
+            dict(inv_lowrank_rank=8, inv_lowrank_dim_threshold=64,
+                 **FUSED))
+        assert all(np.isfinite(losses))
+        retraced = {k: n for k, n in step.trace_counts.items()
+                    if n != 1}
+        assert not retraced, retraced
+
+
+# ---------------------------------------------------------------------------
+# r20 hierarchical 2-slice composition
+# ---------------------------------------------------------------------------
+
+class _Net(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(16)(x)
+        x = nn.relu(x)
+        return nn.Dense(4)(x)
+
+
+@pytest.mark.slow
+class TestHierarchicalFused:
+    def test_composes_with_hierarchical_reduce(self):
+        # hierarchical_reduce keeps the factor fold on the stock path
+        # (an intra-slice pmean sits between contraction and EMA), but
+        # the contraction-only kernel and the fused precondition still
+        # engage: parity vs the non-fused hierarchical run + the
+        # zero-retrace pin on the 2-slice mesh.
+        def build(kw):
+            kfac = KFAC(_Net(), factor_update_freq=1,
+                        inv_update_freq=4, damping=0.003, lr=0.1,
+                        comm_method=CommMethod.HYBRID_OPT,
+                        grad_worker_fraction=0.5,
+                        hierarchical_reduce=True, **kw)
+            variables, _ = kfac.init(jax.random.PRNGKey(0),
+                                     jnp.zeros((2, 8)))
+            mesh = ms_mesh.make_multislice_mesh(
+                jax.devices()[:8], num_slices=2,
+                comm_method=CommMethod.HYBRID_OPT,
+                grad_worker_fraction=0.5)
+            params = launch.replicate_on_mesh(mesh,
+                                              variables['params'])
+            dkfac = D.DistributedKFAC(kfac, mesh, params)
+            tx = optax.sgd(0.05, momentum=0.9)
+            step = dkfac.build_train_step(
+                lambda out, b: jnp.mean((out - b[1]) ** 2), tx,
+                donate=False)
+            return dkfac, tx, step, params
+
+        rng = np.random.default_rng(0)
+        batches = [(rng.normal(size=(32, 8)).astype(np.float32),
+                    rng.normal(size=(32, 4)).astype(np.float32))
+                   for _ in range(8)]
+        hyper = {'lr': 0.05, 'damping': 0.003,
+                 'factor_update_freq': 1, 'inv_update_freq': 4}
+        results = {}
+        for tag, kw in (('stock', {}), ('fused', FUSED)):
+            dkfac, tx, step, params = build(kw)
+            state = dict(params=params, opt=tx.init(params),
+                         kstate=dkfac.init_state(params), extra={})
+            losses = []
+            for i, b in enumerate(batches):
+                flags = engine.cadence_flags(i, 1, 4,
+                                             deferred_reduce=True)
+                (state['params'], state['opt'], state['kstate'],
+                 state['extra'], m) = step(
+                    state['params'], state['opt'], state['kstate'],
+                    state['extra'], b, hyper, **flags)
+                losses.append(float(jax.device_get(m['loss'])))
+            results[tag] = (losses, step)
+        np.testing.assert_allclose(results['fused'][0],
+                                   results['stock'][0],
+                                   rtol=1e-4, atol=1e-5)
+        retraced = {k: n for k, n
+                    in results['fused'][1].trace_counts.items()
+                    if n != 1}
+        assert not retraced, retraced

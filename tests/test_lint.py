@@ -127,6 +127,20 @@ class TestRuleMatrix:
     def test_dtype_lowrank_sketch_negative(self):
         assert active_rules(run_rules('good_dtype_lowrank.py')) == []
 
+    def test_dtype_pallas_positive(self):
+        # r21: inside a Pallas kernel body the pinning requirement is
+        # unconditional — no bf16-flavored operand name needed. One
+        # finding per kernel: named pallas_call arg, partial-bound
+        # pallas_call arg, and the *_ref signature fallback.
+        findings = run_rules('bad_dtype_pallas.py')
+        assert active_rules(findings) == ['dtype-pallas-matmul-accum']
+        assert len(findings) == 3
+
+    def test_dtype_pallas_negative(self):
+        # Pinned kernel bodies are clean, and the fp32 host-side
+        # matmul outside any kernel does not trip the in-kernel rule.
+        assert active_rules(run_rules('good_dtype_pallas.py')) == []
+
     def test_surface_positive(self):
         findings, skipped = surface.check_surface(
             FIXTURES / 'surface_pkg_bad',
